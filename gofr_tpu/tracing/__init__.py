@@ -1,0 +1,311 @@
+"""Tracing: W3C-traceparent distributed tracing with pluggable span exporters.
+
+Capability parity with the reference's tracing (gofr `pkg/gofr/gofr.go:307-422`,
+`pkg/gofr/exporter.go`): a process-global tracer initialized from config
+(``TRACE_EXPORTER`` = none|console|zipkin|otlp), per-request server spans with
+traceparent extraction, child spans per datasource call and per user
+``ctx.trace(name)``, and a background-batched HTTP span exporter (Zipkin JSON v2
+— the format the reference's custom exporter also emits, `exporter.go:49-125`).
+
+Self-contained by design: spans are plain objects + contextvars, so tracing adds
+no hot-path dependency; the TPU engine reuses the same spans to stitch
+enqueue → batch → device-step timelines.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import queue
+import random
+import threading
+import time
+import urllib.request
+from typing import Any, Iterator
+from contextlib import contextmanager
+
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "gofr_tpu_current_span", default=None
+)
+
+
+def _rand_hex(nbytes: int) -> str:
+    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+
+
+class Span:
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start", "end",
+        "attributes", "status", "kind", "sampled", "_tracer", "_token",
+    )
+
+    def __init__(self, name: str, trace_id: str, span_id: str, parent_id: str | None,
+                 tracer: "Tracer | None", kind: str = "INTERNAL", sampled: bool = True):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.sampled = sampled
+        self.start = time.time()
+        self.end: float | None = None
+        self.attributes: dict[str, Any] = {}
+        self.status: str = "OK"
+        self.kind = kind
+        self._tracer = tracer
+        self._token: contextvars.Token | None = None
+
+    def set_attribute(self, key: str, value: Any) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def set_status(self, status: str) -> "Span":
+        self.status = status
+        return self
+
+    def finish(self) -> None:
+        if self.end is not None:
+            return
+        self.end = time.time()
+        if self._token is not None:
+            try:
+                _current_span.reset(self._token)
+            except ValueError:
+                _current_span.set(None)
+            self._token = None
+        if self._tracer is not None:
+            self._tracer._on_finish(self)
+
+    # context-manager sugar
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.status = "ERROR"
+            self.attributes.setdefault("error", repr(exc))
+        self.finish()
+
+    @property
+    def duration_us(self) -> int:
+        end = self.end if self.end is not None else time.time()
+        return int((end - self.start) * 1e6)
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{'01' if self.sampled else '00'}"
+
+
+class SpanExporter:
+    def export(self, spans: list[Span]) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class NoopExporter(SpanExporter):
+    def export(self, spans: list[Span]) -> None:
+        pass
+
+
+class ConsoleExporter(SpanExporter):
+    def __init__(self, logger):
+        self._logger = logger
+
+    def export(self, spans: list[Span]) -> None:
+        for s in spans:
+            self._logger.debug({
+                "span": s.name, "trace_id": s.trace_id, "span_id": s.span_id,
+                "parent_id": s.parent_id, "duration_us": s.duration_us,
+                "status": s.status, **{f"attr.{k}": v for k, v in s.attributes.items()},
+            })
+
+
+class MemoryExporter(SpanExporter):
+    """Collects finished spans for test assertions."""
+
+    def __init__(self):
+        self.spans: list[Span] = []
+        self._lock = threading.Lock()
+
+    def export(self, spans: list[Span]) -> None:
+        with self._lock:
+            self.spans.extend(spans)
+
+    def by_name(self, name: str) -> list[Span]:
+        with self._lock:
+            return [s for s in self.spans if s.name == name]
+
+
+class ZipkinExporter(SpanExporter):
+    """POSTs Zipkin v2 JSON batches (the wire format the reference's hosted
+    exporter also produces)."""
+
+    def __init__(self, endpoint: str, service_name: str, timeout: float = 5.0):
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.timeout = timeout
+
+    def export(self, spans: list[Span]) -> None:
+        payload = [self._to_zipkin(s) for s in spans]
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body, headers={"Content-Type": "application/json"}, method="POST"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout).close()
+        except Exception:  # noqa: BLE001 - tracing must never break serving
+            pass
+
+    def _to_zipkin(self, s: Span) -> dict[str, Any]:
+        return {
+            "id": s.span_id,
+            "traceId": s.trace_id,
+            "parentId": s.parent_id,
+            "name": s.name,
+            "timestamp": int(s.start * 1e6),
+            "duration": s.duration_us,
+            "kind": "SERVER" if s.kind == "SERVER" else "CLIENT" if s.kind == "CLIENT" else None,
+            "localEndpoint": {"serviceName": self.service_name},
+            "tags": {str(k): str(v) for k, v in s.attributes.items()},
+        }
+
+
+class Tracer:
+    """Process tracer with background batch export."""
+
+    def __init__(self, exporter: SpanExporter | None = None,
+                 batch_size: int = 64, flush_interval: float = 2.0):
+        self._exporter = exporter or NoopExporter()
+        self._queue: queue.SimpleQueue[Span | None] = queue.SimpleQueue()
+        self._batch_size = batch_size
+        self._flush_interval = flush_interval
+        self._worker: threading.Thread | None = None
+        self._closed = False
+        if not isinstance(self._exporter, (NoopExporter, MemoryExporter, ConsoleExporter)):
+            self._worker = threading.Thread(target=self._run, name="gofr-span-export", daemon=True)
+            self._worker.start()
+
+    def start_span(self, name: str, parent: Span | None = None,
+                   traceparent: str | None = None, kind: str = "INTERNAL",
+                   set_current: bool = True) -> Span:
+        if parent is None:
+            parent = _current_span.get()
+        trace_id: str | None = None
+        parent_id: str | None = None
+        sampled = True
+        if parent is not None:
+            trace_id, parent_id, sampled = parent.trace_id, parent.span_id, parent.sampled
+        elif traceparent:
+            parsed = parse_traceparent(traceparent)
+            if parsed:
+                trace_id, parent_id, sampled = parsed
+        if trace_id is None:
+            trace_id = _rand_hex(16)
+        span = Span(name, trace_id, _rand_hex(8), parent_id, self, kind=kind, sampled=sampled)
+        if set_current:
+            span._token = _current_span.set(span)
+        return span
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Span]:
+        s = self.start_span(name)
+        s.attributes.update(attrs)
+        try:
+            yield s
+        except Exception as exc:
+            s.status = "ERROR"
+            s.attributes.setdefault("error", repr(exc))
+            raise
+        finally:
+            s.finish()
+
+    def _on_finish(self, span: Span) -> None:
+        if isinstance(self._exporter, (MemoryExporter, ConsoleExporter)):
+            self._exporter.export([span])
+        elif self._worker is not None and not self._closed:
+            self._queue.put(span)
+
+    def _run(self) -> None:
+        batch: list[Span] = []
+        deadline = time.monotonic() + self._flush_interval
+        while True:
+            timeout = max(0.01, deadline - time.monotonic())
+            try:
+                item = self._queue.get(timeout=timeout)
+                if item is None:
+                    break
+                batch.append(item)
+            except Exception:  # noqa: BLE001 - queue.Empty
+                pass
+            if batch and (len(batch) >= self._batch_size or time.monotonic() >= deadline):
+                self._safe_export(batch)
+                batch = []
+                deadline = time.monotonic() + self._flush_interval
+            elif time.monotonic() >= deadline:
+                deadline = time.monotonic() + self._flush_interval
+        if batch:
+            self._safe_export(batch)
+
+    def _safe_export(self, batch: list[Span]) -> None:
+        # a faulty exporter must not kill the export thread (spans would then
+        # accumulate unbounded in the queue with no consumer)
+        try:
+            self._exporter.export(batch)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def shutdown(self) -> None:
+        self._closed = True
+        if self._worker is not None:
+            self._queue.put(None)
+            self._worker.join(timeout=5)
+        self._exporter.shutdown()
+
+
+def current_span() -> Span | None:
+    return _current_span.get()
+
+
+def parse_traceparent(header: str) -> tuple[str, str, bool] | None:
+    """Parse a W3C traceparent ``00-<32hex traceid>-<16hex spanid>-<flags>``.
+
+    Returns ``(trace_id, parent_span_id, sampled)`` — the sampled flag is
+    preserved so an unsampled upstream trace is not upgraded on propagation.
+    """
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_id, flags = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+        sampled = bool(int(flags, 16) & 0x01) if flags else True
+    except ValueError:
+        return None
+    return trace_id, span_id, sampled
+
+
+def tracer_from_config(config, logger, service_name: str) -> Tracer:
+    """Exporter selected by TRACE_EXPORTER config (gofr `gofr.go:365-380`)."""
+    exporter_name = (config.get("TRACE_EXPORTER") or "none").lower()
+    if exporter_name in ("", "none"):
+        return Tracer(NoopExporter())
+    if exporter_name == "console":
+        return Tracer(ConsoleExporter(logger))
+    if exporter_name == "otlp":
+        # OTLP/HTTP is a distinct wire format; silently POSTing Zipkin JSON at an
+        # OTLP collector would drop every span with zero diagnostics.
+        logger.warn("TRACE_EXPORTER=otlp is not implemented yet; use zipkin. Tracing disabled")
+        return Tracer(NoopExporter())
+    if exporter_name in ("zipkin", "gofr"):
+        url = config.get("TRACER_URL") or config.get("TRACER_HOST")
+        if not url:
+            logger.warn("TRACE_EXPORTER set but TRACER_URL missing; tracing disabled")
+            return Tracer(NoopExporter())
+        if not url.startswith("http"):
+            port = config.get_or_default("TRACER_PORT", "9411") if hasattr(config, "get_or_default") else "9411"
+            url = f"http://{url}:{port}/api/v2/spans"
+        return Tracer(ZipkinExporter(url, service_name))
+    logger.warnf("unknown TRACE_EXPORTER %r; tracing disabled", exporter_name)
+    return Tracer(NoopExporter())

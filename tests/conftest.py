@@ -1,0 +1,22 @@
+"""Test harness: force JAX onto CPU with 8 virtual devices BEFORE jax imports.
+
+This is the analog of the reference's MockContainer strategy (SURVEY.md §4): unit
+tests run hermetically against a fake 8-chip mesh so every sharding/collective
+path is exercised without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mock_logger():
+    from gofr_tpu.logging import MockLogger
+
+    return MockLogger()

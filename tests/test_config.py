@@ -1,0 +1,75 @@
+import os
+
+from gofr_tpu.config import DictConfig, EnvConfig, parse_dotenv
+
+
+def test_parse_dotenv_basics():
+    text = """
+# comment
+APP_NAME=svc
+HTTP_PORT = 8000
+QUOTED="hello world"
+SINGLE='x y'
+export EXPORTED=1
+INLINE=value # trailing comment
+EMPTY=
+NOEQ
+"""
+    values = parse_dotenv(text)
+    assert values["APP_NAME"] == "svc"
+    assert values["HTTP_PORT"] == "8000"
+    assert values["QUOTED"] == "hello world"
+    assert values["SINGLE"] == "x y"
+    assert values["EXPORTED"] == "1"
+    assert values["INLINE"] == "value"
+    assert values["EMPTY"] == ""
+    assert "NOEQ" not in values
+
+
+def test_parse_dotenv_quoted_with_inline_comment():
+    values = parse_dotenv('PASS="p@ss word" # secret\nURL="http://x" #c\n')
+    assert values["PASS"] == "p@ss word"
+    assert values["URL"] == "http://x"
+
+
+def test_env_file_layering(tmp_path):
+    configs = tmp_path / "configs"
+    configs.mkdir()
+    (configs / ".env").write_text("A=base\nB=base\nAPP_ENV=stage\n")
+    (configs / ".stage.env").write_text("B=stage\n")
+    cfg = EnvConfig(folder=str(configs), environ={})
+    assert cfg.get("A") == "base"
+    assert cfg.get("B") == "stage"  # overlay wins
+
+
+def test_local_overlay_when_no_app_env(tmp_path):
+    configs = tmp_path / "configs"
+    configs.mkdir()
+    (configs / ".env").write_text("A=base\n")
+    (configs / ".local.env").write_text("A=local\n")
+    cfg = EnvConfig(folder=str(configs), environ={})
+    assert cfg.get("A") == "local"
+
+
+def test_real_environ_wins(tmp_path):
+    configs = tmp_path / "configs"
+    configs.mkdir()
+    (configs / ".env").write_text("A=file\n")
+    cfg = EnvConfig(folder=str(configs), environ={"A": "env"})
+    assert cfg.get("A") == "env"
+
+
+def test_typed_getters():
+    cfg = DictConfig({"N": "5", "F": "2.5", "B": "true", "BAD": "x"})
+    assert cfg.get_int("N", 0) == 5
+    assert cfg.get_int("BAD", 7) == 7
+    assert cfg.get_int("MISSING", 3) == 3
+    assert cfg.get_float("F", 0.0) == 2.5
+    assert cfg.get_bool("B") is True
+    assert cfg.get_bool("MISSING", True) is True
+    assert cfg.get_or_default("MISSING", "d") == "d"
+
+
+def test_missing_folder_ok(tmp_path):
+    cfg = EnvConfig(folder=str(tmp_path / "nope"), environ={})
+    assert cfg.get("ANYTHING") is None

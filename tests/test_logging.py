@@ -1,0 +1,79 @@
+import io
+import json
+
+from gofr_tpu.logging import Level, Logger, MockLogger
+
+
+def test_level_filtering():
+    log = MockLogger(level=Level.WARN)
+    log.debug("d")
+    log.info("i")
+    log.warn("w")
+    log.error("e")
+    levels = [r["level"] for r in log.records]
+    assert levels == ["WARN", "ERROR"]
+
+
+def test_json_output_shape():
+    log = MockLogger()
+    log.infof("hello %s %d", "world", 42)
+    rec = log.records[0]
+    assert rec["level"] == "INFO"
+    assert rec["message"] == "hello world 42"
+    assert rec["time"].endswith("Z")
+
+
+def test_structured_dict_merged():
+    log = MockLogger()
+    log.info({"method": "GET", "status": 200})
+    rec = log.records[0]
+    assert rec["method"] == "GET"
+    assert rec["status"] == 200
+
+
+def test_reserved_keys_not_overwritten():
+    log = MockLogger()
+    log.info("real message", {"level": "SPOOF", "time": "bad", "message": "spoof"})
+    rec = log.records[0]
+    assert rec["level"] == "INFO"
+    assert rec["message"] == "real message"
+    assert rec["time"].endswith("Z")
+
+
+def test_change_level_live():
+    log = MockLogger(level=Level.ERROR)
+    log.info("hidden")
+    log.change_level(Level.DEBUG)
+    log.debug("visible")
+    assert len(log.records) == 1
+    assert log.records[0]["message"] == "visible"
+
+
+def test_pretty_print_on_terminal():
+    class Record:
+        def pretty_print(self, w):
+            w.write("CUSTOM-RENDER")
+
+    out = io.StringIO()
+    log = Logger(level=Level.DEBUG, out=out, err=out, terminal=True)
+    log.info(Record())
+    assert "CUSTOM-RENDER" in out.getvalue()
+
+
+def test_errors_go_to_stderr():
+    out, err = io.StringIO(), io.StringIO()
+    log = Logger(level=Level.DEBUG, out=out, err=err, terminal=False)
+    log.info("a")
+    log.error("b")
+    assert json.loads(out.getvalue())["message"] == "a"
+    assert json.loads(err.getvalue())["message"] == "b"
+
+
+def test_log_exception_includes_stack():
+    log = MockLogger()
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        log.log_exception(e, "handler panic")
+    msg = log.records[0]["message"]
+    assert "boom" in msg and "ValueError" in msg

@@ -1,0 +1,78 @@
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.metrics import Registry, sample_runtime_metrics
+
+
+def test_counter_inc_and_expose():
+    reg = Registry()
+    c = reg.new_counter("app_requests_total", "total requests")
+    c.inc()
+    c.inc(2, path="/a")
+    text = reg.expose_text()
+    assert "# TYPE app_requests_total counter" in text
+    assert "app_requests_total 1" in text
+    assert 'app_requests_total{path="/a"} 2' in text
+
+
+def test_histogram_buckets():
+    reg = Registry()
+    h = reg.new_histogram("lat", "latency", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose_text()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 3' in text
+    assert "lat_count 3" in text
+    assert h.count() == 3
+    assert abs(h.sum() - 5.55) < 1e-9
+
+
+def test_gauge_set():
+    reg = Registry()
+    g = reg.new_gauge("hbm", "hbm bytes")
+    g.set(1024, device="0")
+    assert 'hbm{device="0"} 1024' in reg.expose_text()
+
+
+def test_register_idempotent_and_type_conflict():
+    reg = Registry()
+    a = reg.new_counter("x")
+    b = reg.new_counter("x")
+    assert a is b
+    try:
+        reg.new_gauge("x")
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_record_by_name():
+    reg = Registry()
+    reg.new_counter("c")
+    reg.new_histogram("h")
+    reg.new_gauge("g")
+    reg.increment_counter("c", 3)
+    reg.record_histogram("h", 0.01)
+    reg.set_gauge("g", 7)
+    text = reg.expose_text()
+    assert "c 3" in text and "g 7" in text and "h_count 1" in text
+    # unknown names are silently ignored (feature-off ergonomics)
+    reg.increment_counter("missing")
+
+
+def test_cardinality_warning():
+    log = MockLogger()
+    reg = Registry(logger=log)
+    reg.new_counter("many")
+    for i in range(25):
+        reg.increment_counter("many", 1, k=str(i))
+    assert any("cardinality" in r.get("message", "") for r in log.records)
+
+
+def test_runtime_collect_hook():
+    reg = Registry()
+    reg.add_collect_hook(sample_runtime_metrics)
+    text = reg.expose_text()
+    assert "app_threads" in text
+    assert "app_sys_memory_rss_bytes" in text

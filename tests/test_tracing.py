@@ -1,0 +1,95 @@
+from gofr_tpu.config import DictConfig
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.tracing import (
+    MemoryExporter,
+    NoopExporter,
+    Tracer,
+    current_span,
+    parse_traceparent,
+    tracer_from_config,
+)
+
+
+def test_span_parenting():
+    exp = MemoryExporter()
+    tracer = Tracer(exp)
+    with tracer.span("parent") as p:
+        with tracer.span("child") as c:
+            assert c.trace_id == p.trace_id
+            assert c.parent_id == p.span_id
+    assert len(exp.spans) == 2
+    assert current_span() is None
+
+
+def test_traceparent_roundtrip():
+    tracer = Tracer(MemoryExporter())
+    s = tracer.start_span("server", traceparent="00-" + "a" * 32 + "-" + "b" * 16 + "-01")
+    assert s.trace_id == "a" * 32
+    assert s.parent_id == "b" * 16
+    header = s.traceparent()
+    parsed = parse_traceparent(header)
+    assert parsed == (s.trace_id, s.span_id, True)
+    s.finish()
+
+
+def test_unsampled_flag_preserved():
+    tracer = Tracer(MemoryExporter())
+    s = tracer.start_span("server", traceparent="00-" + "a" * 32 + "-" + "b" * 16 + "-00")
+    assert s.sampled is False
+    assert s.traceparent().endswith("-00")
+    child = tracer.start_span("child", parent=s)
+    assert child.sampled is False
+    child.finish()
+    s.finish()
+
+
+def test_faulty_exporter_does_not_kill_worker():
+    from gofr_tpu.tracing import SpanExporter
+
+    class FlakyExporter(SpanExporter):
+        def __init__(self):
+            self.calls = 0
+
+        def export(self, spans):
+            self.calls += 1
+            if self.calls == 1:
+                raise RuntimeError("transient")
+
+    exp = FlakyExporter()
+    tracer = Tracer(exp, batch_size=1, flush_interval=0.01)
+    tracer.start_span("a").finish()
+    import time
+
+    time.sleep(0.1)
+    tracer.start_span("b").finish()
+    tracer.shutdown()
+    assert exp.calls >= 2  # worker survived the first raise
+
+
+def test_parse_traceparent_rejects_garbage():
+    assert parse_traceparent("") is None
+    assert parse_traceparent("00-short-short-01") is None
+    assert parse_traceparent("00-" + "z" * 32 + "-" + "b" * 16 + "-01") is None
+
+
+def test_span_error_status():
+    exp = MemoryExporter()
+    tracer = Tracer(exp)
+    try:
+        with tracer.span("failing"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    assert exp.spans[0].status == "ERROR"
+
+
+def test_tracer_from_config_none():
+    t = tracer_from_config(DictConfig({}), MockLogger(), "svc")
+    assert isinstance(t._exporter, NoopExporter)
+
+
+def test_tracer_from_config_zipkin_requires_url():
+    log = MockLogger()
+    t = tracer_from_config(DictConfig({"TRACE_EXPORTER": "zipkin"}), log, "svc")
+    assert isinstance(t._exporter, NoopExporter)
+    assert any("TRACER_URL" in r.get("message", "") for r in log.records)
