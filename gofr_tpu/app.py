@@ -1,0 +1,464 @@
+"""App facade: build servers from config, register routes, run everything.
+
+Parity with gofr `pkg/gofr/gofr.go`: ``App`` owns the HTTP server (with the
+5-stage middleware chain), the metrics server on its own port, the gRPC server,
+the pub/sub subscription manager, the cron table, and the CLI runtime — all fed
+by one Container and serving handlers through one transport-neutral Context.
+
+TPU-first: ``app.serve_model(...)`` registers a continuous-batching engine on
+the container; handlers then call ``ctx.infer``/``ctx.generate``. ``run()`` adds
+graceful shutdown (absent in the reference, `gofr.go:211`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import signal
+import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable
+
+from aiohttp import web, WSMsgType
+
+from gofr_tpu.config import DictConfig, EnvConfig
+from gofr_tpu.container import Container
+from gofr_tpu.context import Context
+from gofr_tpu.http.errors import RequestTimeout
+from gofr_tpu.http.middleware import (
+    SPAN_KEY,
+    cors_middleware,
+    logging_middleware,
+    metrics_middleware,
+    tracer_middleware,
+)
+from gofr_tpu.http.request import HTTPRequest
+from gofr_tpu.http.responder import respond, to_json
+from gofr_tpu.websocket import ConnectionHub, WSConnection
+
+Handler = Callable[[Context], Any]
+
+
+class App:
+    def __init__(self, config_folder: str = "./configs", config=None, container: Container | None = None):
+        self.config = config if config is not None else EnvConfig(folder=config_folder)
+        self.container = container if container is not None else Container.create(self.config)
+        self.logger = self.container.logger
+
+        self.http_port = self.config.get_int("HTTP_PORT", 8000)
+        self.metrics_port = self.config.get_int("METRICS_PORT", 2121)
+        self.grpc_port = self.config.get_int("GRPC_PORT", 9000)
+        self.request_timeout = self.config.get_float("REQUEST_TIMEOUT", 0.0)
+
+        self._routes: list[tuple[str, str, Handler]] = []
+        self._ws_routes: list[tuple[str, Handler]] = []
+        self._static: list[tuple[str, str]] = []
+        self._auth_middlewares: list[Any] = []
+        self._subscriptions: dict[str, Handler] = {}
+        self._grpc_services: list[Any] = []
+        self._executor = ThreadPoolExecutor(
+            max_workers=self.config.get_int("HANDLER_THREADS", 32), thread_name_prefix="gofr-handler"
+        )
+        self.ws_hub = ConnectionHub()
+
+        from gofr_tpu.cron import Crontab
+
+        self.cron = Crontab(self.container)
+        self._shutdown = asyncio.Event()
+        self._runners: list[web.AppRunner] = []
+        self._sub_threads: list[threading.Thread] = []
+        self._sub_stop = threading.Event()
+
+    # -- route registration (gofr.go:244-276) ----------------------------------
+
+    def add_route(self, method: str, path: str, handler: Handler) -> None:
+        self._routes.append((method.upper(), path, handler))
+
+    def get(self, path: str, handler: Handler) -> None:
+        self.add_route("GET", path, handler)
+
+    def post(self, path: str, handler: Handler) -> None:
+        self.add_route("POST", path, handler)
+
+    def put(self, path: str, handler: Handler) -> None:
+        self.add_route("PUT", path, handler)
+
+    def patch(self, path: str, handler: Handler) -> None:
+        self.add_route("PATCH", path, handler)
+
+    def delete(self, path: str, handler: Handler) -> None:
+        self.add_route("DELETE", path, handler)
+
+    def websocket(self, path: str, handler: Handler) -> None:
+        self._ws_routes.append((path, handler))
+
+    def add_static_files(self, route: str, directory: str) -> None:
+        self._static.append((route if route.startswith("/") else f"/{route}", directory))
+
+    def add_rest_handlers(self, entity: type, table: str | None = None, path: str | None = None) -> None:
+        """Reflect a dataclass into CRUD routes (gofr `crud_handlers.go`)."""
+        from gofr_tpu.crud import register_crud_routes
+
+        register_crud_routes(self, entity, table=table, path=path)
+
+    # -- auth (gofr.go:436-507) ------------------------------------------------
+
+    def enable_basic_auth(self, users: dict[str, str]) -> None:
+        from gofr_tpu.http.middleware.auth import basic_auth_middleware
+
+        self._auth_middlewares.append(basic_auth_middleware(users=users))
+
+    def enable_basic_auth_with_validator(self, validator: Callable[..., bool]) -> None:
+        from gofr_tpu.http.middleware.auth import basic_auth_middleware
+
+        self._auth_middlewares.append(basic_auth_middleware(validator=validator, container=self.container))
+
+    def enable_api_key_auth(self, *keys: str) -> None:
+        from gofr_tpu.http.middleware.auth import apikey_auth_middleware
+
+        self._auth_middlewares.append(apikey_auth_middleware(keys=list(keys)))
+
+    def enable_api_key_auth_with_validator(self, validator: Callable[..., bool]) -> None:
+        from gofr_tpu.http.middleware.auth import apikey_auth_middleware
+
+        self._auth_middlewares.append(apikey_auth_middleware(validator=validator, container=self.container))
+
+    def enable_oauth(self, jwks_url: str, refresh_interval: float = 300.0,
+                     audience: str | None = None, issuer: str | None = None) -> None:
+        from gofr_tpu.http.middleware.auth import JWKSCache, oauth_middleware
+
+        jwks = JWKSCache(jwks_url, refresh_interval)
+        jwks.start()
+        self._auth_middlewares.append(oauth_middleware(jwks=jwks, audience=audience, issuer=issuer))
+
+    def enable_jwt_hs256(self, secret: bytes | str, audience: str | None = None,
+                         issuer: str | None = None) -> None:
+        from gofr_tpu.http.middleware.auth import oauth_middleware
+
+        secret_b = secret.encode() if isinstance(secret, str) else secret
+        self._auth_middlewares.append(oauth_middleware(hs_secret=secret_b, audience=audience, issuer=issuer))
+
+    # -- other entrypoints -----------------------------------------------------
+
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        if self.container.pubsub is None:
+            self.logger.error(f"subscribe({topic!r}) ignored: no PUBSUB_BACKEND configured")
+            return
+        self._subscriptions[topic] = handler
+
+    def add_cron_job(self, schedule: str, name: str, handler: Handler) -> None:
+        self.cron.add_job(schedule, name, handler)
+
+    def register_grpc_service(self, adder: Callable[[Any], None] | Any, servicer: Any = None) -> None:
+        """Register a gRPC service: either ``(add_fn, servicer)`` from generated
+        code, or an object handled by the gofr_tpu.grpc server."""
+        self._grpc_services.append((adder, servicer))
+
+    def register_service(self, name: str, base_url: str, *options: Any):
+        """Register an inter-service HTTP client (circuit breaker/retry/auth
+        via options, gofr `service/new.go` decorator pattern)."""
+        from gofr_tpu.service import new_http_service
+
+        client = new_http_service(base_url, self.logger, self.container.metrics, *options)
+        self.container.register_service(name, client)
+        return client
+
+    def migrate(self, migrations: dict[int, Any]) -> None:
+        from gofr_tpu.migration import run_migrations
+
+        run_migrations(migrations, self.container)
+
+    # -- TPU model serving (the new capability) --------------------------------
+
+    def serve_model(self, name: str, spec: Any = None, *, engine: Any = None, **engine_kw: Any):
+        """Attach a model to the app behind a continuous-batching engine.
+
+        ``spec`` is a ModelSpec (see gofr_tpu.models); alternatively pass a
+        prebuilt ``engine``. The engine starts with ``app.run()`` (or
+        immediately when the app is already running) and is reachable from any
+        handler via ``ctx.infer(name, ...)`` / ``ctx.generate(name, ...)``.
+        """
+        if engine is None:
+            from gofr_tpu.tpu.engine import build_engine
+
+            engine = build_engine(spec, self.container, **engine_kw)
+        self.container.register_engine(name, engine)
+        return engine
+
+    # -- assembly --------------------------------------------------------------
+
+    def _registered_methods(self) -> list[str]:
+        methods = sorted({m for m, _, _ in self._routes} | {"OPTIONS"})
+        return methods
+
+    def _build_http_app(self) -> web.Application:
+        middlewares = [
+            tracer_middleware(self.container.tracer),
+            logging_middleware(self.logger),
+            cors_middleware(self.config, self._registered_methods),
+            metrics_middleware(self.container.metrics),
+            *self._auth_middlewares,
+        ]
+        http_app = web.Application(middlewares=middlewares, client_max_size=64 * 1024 * 1024)
+
+        # well-known routes (gofr.go:155-163)
+        http_app.router.add_get("/.well-known/health", self._health_handler)
+        http_app.router.add_get("/.well-known/alive", self._alive_handler)
+        http_app.router.add_get("/favicon.ico", self._favicon_handler)
+        self._add_openapi_routes(http_app)
+
+        for method, path, handler in self._routes:
+            http_app.router.add_route(method, path, self._wrap(handler))
+        for path, handler in self._ws_routes:
+            http_app.router.add_get(path, self._wrap_ws(handler))
+        for route, directory in self._static:
+            http_app.router.add_static(route, directory)
+        # catch-all 404 with the JSON envelope (gofr handler.go:95-119)
+        http_app.router.add_route("*", "/{tail:.*}", self._not_found_handler)
+        return http_app
+
+    def _build_metrics_app(self) -> web.Application:
+        metrics_app = web.Application()
+
+        async def metrics_handler(_request: web.Request) -> web.Response:
+            text = self.container.metrics.expose_text()
+            return web.Response(text=text, content_type="text/plain", charset="utf-8")
+
+        metrics_app.router.add_get("/metrics", metrics_handler)
+        return metrics_app
+
+    # -- request pipeline ------------------------------------------------------
+
+    async def _materialize(self, request: web.Request) -> HTTPRequest:
+        body = await request.read()
+        route = request.match_info.route
+        template = getattr(route.resource, "canonical", request.path) if route and route.resource else request.path
+        req = HTTPRequest(
+            method=request.method,
+            path=request.path,
+            query_string=request.rel_url.query_string,
+            headers=dict(request.headers),
+            body=body,
+            path_params=dict(request.match_info),
+            remote=request.remote or "",
+            route_template=template,
+        )
+        auth = request.get("gofr_auth")
+        if auth:
+            req.context().update(auth)
+        return req
+
+    def _wrap(self, handler: Handler):
+        is_coro = inspect.iscoroutinefunction(handler)
+
+        async def aio_handler(request: web.Request) -> web.Response:
+            req = await self._materialize(request)
+            ctx = Context(req, self.container, span=request.get(SPAN_KEY))
+            result, err = None, None
+            try:
+                if is_coro:
+                    coro = handler(ctx)
+                else:
+                    loop = asyncio.get_running_loop()
+                    coro = loop.run_in_executor(self._executor, handler, ctx)
+                if self.request_timeout > 0:
+                    result = await asyncio.wait_for(coro, timeout=self.request_timeout)
+                else:
+                    result = await coro
+            except asyncio.TimeoutError:
+                err = RequestTimeout()
+            except Exception as e:  # noqa: BLE001
+                err = e
+                if not hasattr(e, "status_code"):
+                    self.logger.log_exception(e, f"handler {request.method} {request.path}")
+            wire = respond(result, err, request.method)
+            return web.Response(
+                body=wire.body,
+                status=wire.status,
+                content_type=wire.content_type,
+                headers=wire.headers,
+            )
+
+        return aio_handler
+
+    def _wrap_ws(self, handler: Handler):
+        is_coro = inspect.iscoroutinefunction(handler)
+
+        async def ws_handler(request: web.Request) -> web.StreamResponse:
+            ws = web.WebSocketResponse()
+            if not ws.can_prepare(request).ok:
+                return await self._not_found_handler(request)
+            await ws.prepare(request)
+            # server-generated id: the Sec-WebSocket-Key header is client
+            # controlled and duplicates would cross-wire hub entries
+            conn_id = uuid.uuid4().hex
+            self.ws_hub.add(conn_id, ws)
+            loop = asyncio.get_running_loop()
+            try:
+                async for msg in ws:
+                    if msg.type not in (WSMsgType.TEXT, WSMsgType.BINARY):
+                        continue
+                    conn = WSConnection(conn_id, ws, msg.data, loop)
+                    ctx = Context(conn, self.container)
+                    try:
+                        if is_coro:
+                            result = await handler(ctx)
+                        else:
+                            result = await loop.run_in_executor(self._executor, handler, ctx)
+                    except Exception as e:  # noqa: BLE001
+                        self.logger.log_exception(e, "websocket handler")
+                        await ws.send_str(to_json({"error": {"message": "handler error"}}).decode())
+                        continue
+                    if result is not None:
+                        payload = result if isinstance(result, str) else to_json(result).decode()
+                        await ws.send_str(payload)
+            finally:
+                self.ws_hub.remove(conn_id)
+            return ws
+
+        return ws_handler
+
+    # -- built-in handlers -----------------------------------------------------
+
+    async def _health_handler(self, _request: web.Request) -> web.Response:
+        health = await asyncio.get_running_loop().run_in_executor(self._executor, self.container.health)
+        status = 200 if health["status"] != "DOWN" else 503
+        return web.Response(body=to_json({"data": health}), status=status, content_type="application/json")
+
+    async def _alive_handler(self, _request: web.Request) -> web.Response:
+        return web.json_response({"data": {"status": "UP"}})
+
+    async def _favicon_handler(self, _request: web.Request) -> web.Response:
+        return web.Response(body=b"", content_type="image/x-icon")
+
+    async def _not_found_handler(self, _request: web.Request) -> web.Response:
+        return web.json_response({"error": {"message": "route not registered"}}, status=404)
+
+    def _add_openapi_routes(self, http_app: web.Application) -> None:
+        from gofr_tpu.swagger import openapi_handler, swagger_ui_handler
+
+        http_app.router.add_get("/.well-known/openapi.json", openapi_handler(self))
+        http_app.router.add_get("/.well-known/swagger", swagger_ui_handler(self))
+
+    # -- subscription manager (gofr subscriber.go) -----------------------------
+
+    def _start_subscribers(self) -> None:
+        for topic, handler in self._subscriptions.items():
+            t = threading.Thread(
+                target=self._subscribe_loop, args=(topic, handler),
+                name=f"gofr-sub-{topic}", daemon=True,
+            )
+            t.start()
+            self._sub_threads.append(t)
+
+    def _subscribe_loop(self, topic: str, handler: Handler) -> None:
+        container = self.container
+        group = self.config.get_or_default("CONSUMER_GROUP", container.app_name)
+        while not self._sub_stop.is_set():
+            try:
+                msg = container.pubsub.subscribe(topic, group=group, timeout=0.5)
+            except Exception as e:  # noqa: BLE001
+                container.logger.errorf("subscribe %s failed: %r", topic, e)
+                self._sub_stop.wait(1.0)
+                continue
+            if msg is None:
+                continue
+            container.metrics.increment_counter("app_pubsub_subscribe_total_count", 1, topic=topic)
+            span = container.tracer.start_span(f"subscribe {topic}", kind="CONSUMER", set_current=False)
+            ctx = Context(msg, container, span=span)
+            try:
+                result = handler(ctx)
+                if inspect.iscoroutine(result):
+                    raise TypeError("subscribe handlers must be synchronous (they run on a consumer thread)")
+                msg.commit()  # at-least-once: commit only on success (subscriber.go:54-56)
+                container.metrics.increment_counter("app_pubsub_subscribe_success_count", 1, topic=topic)
+                span.set_status("OK")
+            except Exception as e:  # noqa: BLE001
+                span.set_status("ERROR")
+                container.logger.errorf("subscriber for %s failed: %r", topic, e)
+            finally:
+                span.finish()
+
+    # -- run -------------------------------------------------------------------
+
+    def run(self) -> None:
+        """Start every configured server; blocks until SIGINT/SIGTERM."""
+        try:
+            asyncio.run(self.arun())
+        except KeyboardInterrupt:
+            pass
+
+    async def arun(self, ready: asyncio.Event | None = None) -> None:
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self._shutdown.set)
+            except (NotImplementedError, RuntimeError):
+                pass
+
+        # engines first (device warm-up), then servers
+        for name, engine in self.container.engines.items():
+            if hasattr(engine, "start"):
+                engine.start()
+                self.logger.infof("model engine %s started", name)
+
+        metrics_runner = web.AppRunner(self._build_metrics_app())
+        await metrics_runner.setup()
+        await web.TCPSite(metrics_runner, host="0.0.0.0", port=self.metrics_port).start()
+        self._runners.append(metrics_runner)
+        self.logger.infof("metrics server on :%d/metrics", self.metrics_port)
+
+        if self._routes or self._ws_routes or self._static:
+            http_runner = web.AppRunner(self._build_http_app())
+            await http_runner.setup()
+            await web.TCPSite(http_runner, host="0.0.0.0", port=self.http_port).start()
+            self._runners.append(http_runner)
+            self.logger.infof("HTTP server on :%d", self.http_port)
+
+        grpc_server = None
+        if self._grpc_services:
+            from gofr_tpu.grpc.server import start_grpc_server
+
+            grpc_server = start_grpc_server(self)
+            self.logger.infof("gRPC server on :%d", self.grpc_port)
+
+        self._start_subscribers()
+        self.cron.start()
+
+        if ready is not None:
+            ready.set()
+        await self._shutdown.wait()
+        self.logger.info("shutting down")
+        self._sub_stop.set()
+        self.cron.stop()
+        if grpc_server is not None:
+            grpc_server.stop(grace=2)
+        for runner in self._runners:
+            await runner.cleanup()
+        self.container.close()
+        self._executor.shutdown(wait=False, cancel_futures=True)
+
+    def stop(self) -> None:
+        self._shutdown.set()
+
+
+def new(config_folder: str = "./configs", config=None) -> App:
+    """gofr.New() analog."""
+    return App(config_folder=config_folder, config=config)
+
+
+def new_cmd(config_folder: str = "./configs", config=None):
+    """gofr.NewCMD() analog: a CLI app sharing the container/Context model."""
+    from gofr_tpu.cli import CmdApp
+
+    cfg = config if config is not None else EnvConfig(folder=config_folder)
+    return CmdApp(Container.create(cfg))
+
+
+def new_testing(config: dict[str, str] | None = None) -> App:
+    """App wired to a mock container for tests."""
+    from gofr_tpu.container import new_mock_container
+
+    cfg = DictConfig(config or {})
+    return App(config=cfg, container=new_mock_container(config))

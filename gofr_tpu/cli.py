@@ -1,0 +1,153 @@
+"""CLI runtime: subcommand apps sharing the same Context/handler model.
+
+Parity with gofr `pkg/gofr/cmd.go` + `pkg/gofr/cmd/`: ``new_cmd()`` apps route
+on the first non-flag argument (regex match supported, `cmd.go:92-107`), flags
+``-k=v`` / ``--k=v`` / ``-k v`` become params (`cmd/request.go:25-67`),
+``bind`` maps flags into dataclasses (`cmd/request.go:90-117`), ``-h/--help``
+output is generated from registered descriptions (`cmd.go:137-151`), and
+results/errors print to stdout/stderr (`cmd/responder.go`).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import Any, Callable
+
+from gofr_tpu.utils import bind as binder
+
+
+class CmdRequest:
+    """Request implementation over argv."""
+
+    def __init__(self, argv: list[str]):
+        self.argv = argv
+        self.subcommand = ""
+        self._params: dict[str, list[str]] = {}
+        self._positional: list[str] = []
+        self._parse(argv)
+        self._ctx: dict[str, Any] = {}
+
+    def _parse(self, argv: list[str]) -> None:
+        i = 0
+        while i < len(argv):
+            arg = argv[i]
+            if arg.startswith("-"):
+                name = arg.lstrip("-")
+                if "=" in name:
+                    key, _, value = name.partition("=")
+                    self._params.setdefault(key, []).append(value)
+                elif i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+                    self._params.setdefault(name, []).append(argv[i + 1])
+                    i += 1
+                else:
+                    self._params.setdefault(name, []).append("true")
+            elif not self.subcommand:
+                self.subcommand = arg
+            else:
+                self._positional.append(arg)
+            i += 1
+
+    def param(self, key: str) -> str:
+        values = self._params.get(key)
+        return values[0] if values else ""
+
+    def params(self, key: str) -> list[str]:
+        return list(self._params.get(key, []))
+
+    def path_param(self, key: str) -> str:
+        if key == "subcommand":
+            return self.subcommand
+        try:
+            return self._positional[int(key)]
+        except (ValueError, IndexError):
+            return ""
+
+    @property
+    def positional(self) -> list[str]:
+        return list(self._positional)
+
+    def bind(self, target: Any = dict) -> Any:
+        flat = {k: v[0] if len(v) == 1 else v for k, v in self._params.items()}
+        return binder.bind(flat, target)
+
+    def host_name(self) -> str:
+        return "cli"
+
+    def context(self) -> dict[str, Any]:
+        return self._ctx
+
+
+class CmdResponder:
+    def __init__(self, out=None, err=None):
+        self._out = out or sys.stdout
+        self._err = err or sys.stderr
+
+    def write(self, *args: Any) -> None:
+        self._out.write(" ".join(str(a) for a in args) + "\n")
+
+    def respond(self, result: Any, err: BaseException | None) -> int:
+        if err is not None:
+            self._err.write(f"error: {err}\n")
+            return 1
+        if result is not None:
+            self._out.write(f"{result}\n")
+        return 0
+
+
+class Route:
+    def __init__(self, pattern: str, handler: Callable, description: str = "", help_text: str = ""):
+        self.pattern = pattern
+        self.handler = handler
+        self.description = description
+        self.help_text = help_text
+
+    def matches(self, subcommand: str) -> bool:
+        return re.fullmatch(self.pattern, subcommand) is not None
+
+
+class CmdApp:
+    """The CLI entrypoint runtime; created via ``gofr_tpu.new_cmd()``."""
+
+    def __init__(self, container):
+        self.container = container
+        self._routes: list[Route] = []
+
+    def sub_command(self, pattern: str, handler: Callable, description: str = "", help_text: str = "") -> None:
+        self._routes.append(Route(pattern, handler, description, help_text))
+
+    def run(self, argv: list[str] | None = None, out=None, err=None) -> int:
+        from gofr_tpu.context import Context
+
+        argv = list(sys.argv[1:] if argv is None else argv)
+        responder = CmdResponder(out, err)
+        request = CmdRequest(argv)
+
+        if request.subcommand in ("", "help") or request.param("h") or request.param("help"):
+            responder.write(self._help())
+            return 0
+
+        route = next((r for r in self._routes if r.matches(request.subcommand)), None)
+        if route is None:
+            responder._err.write(f"unknown subcommand {request.subcommand!r}\n\n{self._help()}\n")
+            return 1
+
+        span = self.container.tracer.start_span(f"cmd {request.subcommand}", set_current=False)
+        ctx = Context(request, self.container, responder=responder, span=span)
+        try:
+            result = route.handler(ctx)
+            span.finish()
+            return responder.respond(result, None)
+        except Exception as e:  # noqa: BLE001
+            span.set_status("ERROR")
+            span.finish()
+            return responder.respond(None, e)
+
+    def _help(self) -> str:
+        lines = ["Available commands:"]
+        for r in self._routes:
+            desc = f"  {r.pattern:<20} {r.description}".rstrip()
+            lines.append(desc)
+            if r.help_text:
+                lines.append(f"{'':<24}{r.help_text}")
+        return "\n".join(lines)

@@ -1,0 +1,286 @@
+"""Container: the dependency-injection hub (gofr `pkg/gofr/container/container.go`).
+
+One Container per App. It materializes every infrastructure dependency from
+config at boot — logger (with remote level polling), metrics registry, tracer,
+datasources, inter-service HTTP clients — and exposes them through narrow
+attributes. Everything is config-gated: an unset host/backend means the feature
+is simply not wired (`container.go:91-122` semantics).
+
+TPU-first: the device mesh is itself a datasource (``container.tpu``), exactly
+parallel to how the reference wraps a Redis pool — created lazily, health-checked,
+surfaced in metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from gofr_tpu.config import DictConfig
+from gofr_tpu.logging import Level, Logger, MockLogger, new_logger
+from gofr_tpu.metrics import Registry, sample_runtime_metrics
+from gofr_tpu.tracing import Tracer, tracer_from_config
+from gofr_tpu import version
+
+
+class Container:
+    def __init__(self, config, logger: Logger | None = None):
+        self.config = config
+        self.app_name = config.get_or_default("APP_NAME", "gofr-tpu-app")
+        self.app_version = config.get_or_default("APP_VERSION", "dev")
+
+        self.logger: Logger = logger or new_logger(config.get_or_default("LOG_LEVEL", "INFO"))
+        self.metrics: Registry = Registry(logger=self.logger)
+        self.tracer: Tracer = Tracer()
+
+        # datasource slots (None = not wired; config decides)
+        self.sql = None
+        self.redis = None
+        self.mongo = None
+        self.cassandra = None
+        self.clickhouse = None
+        self.kv = None
+        self.file = None
+        self.pubsub = None
+        self._tpu = None
+        self._tpu_lock = threading.Lock()
+        self.services: dict[str, Any] = {}
+        self._engines: dict[str, Any] = {}
+        self._remote_level_poller = None
+
+    # -- boot ------------------------------------------------------------------
+
+    @classmethod
+    def create(cls, config) -> "Container":
+        c = cls(config)
+        c._register_framework_metrics()
+        c.metrics.add_collect_hook(sample_runtime_metrics)
+        c.tracer = tracer_from_config(config, c.logger, c.app_name)
+        c._maybe_remote_log_level()
+        c._maybe_sql()
+        c._maybe_redis()
+        c._maybe_pubsub()
+        c._wire_file()
+        c._maybe_kv()
+        return c
+
+    def _register_framework_metrics(self) -> None:
+        m = self.metrics
+        g = m.new_gauge("app_info", "application info")
+        g.set(1, app=self.app_name, version=self.app_version, framework=f"gofr_tpu-{version.FRAMEWORK}")
+        m.new_histogram("app_http_response", "HTTP handler latency (s)")
+        m.new_histogram("app_http_service_response", "outbound HTTP client latency (s)")
+        m.new_histogram("app_sql_stats", "SQL query latency (s)")
+        m.new_histogram("app_redis_stats", "redis command latency (s)")
+        m.new_histogram("app_kv_stats", "kv store op latency (s)")
+        m.new_counter("app_pubsub_publish_total_count", "pubsub publish attempts")
+        m.new_counter("app_pubsub_publish_success_count", "pubsub publish successes")
+        m.new_counter("app_pubsub_subscribe_total_count", "pubsub messages received")
+        m.new_counter("app_pubsub_subscribe_success_count", "pubsub messages handled ok")
+        # TPU serving metrics (north-star observability: HBM + compile cache + batching)
+        m.new_gauge("app_tpu_device_count", "visible TPU devices")
+        m.new_gauge("app_tpu_hbm_used_bytes", "per-device HBM in use")
+        m.new_gauge("app_tpu_hbm_limit_bytes", "per-device HBM capacity")
+        m.new_counter("app_tpu_compile_total", "XLA compilations triggered")
+        m.new_counter("app_tpu_compile_cache_hits", "batch steps served from compile cache")
+        m.new_histogram("app_tpu_batch_occupancy", "occupied fraction of each device batch",
+                        buckets=[0.1, 0.25, 0.5, 0.75, 0.9, 1.0])
+        m.new_histogram("app_tpu_step_seconds", "device step wall time (s)")
+        m.new_gauge("app_tpu_queue_depth", "requests waiting for a device step")
+        m.new_counter("app_tpu_tokens_total", "tokens processed (prefill+decode)")
+
+    def _maybe_remote_log_level(self) -> None:
+        url = self.config.get("REMOTE_LOG_URL")
+        if not url:
+            return
+        from gofr_tpu.logging.remote import RemoteLevelPoller
+
+        interval = self.config.get_float("REMOTE_LOG_FETCH_INTERVAL", 15.0)
+        self._remote_level_poller = RemoteLevelPoller(self.logger, url, interval)
+        self._remote_level_poller.start()
+
+    def _maybe_sql(self) -> None:
+        dialect = (self.config.get("DB_DIALECT") or "").lower()
+        host = self.config.get("DB_HOST")
+        if not dialect and not host:
+            return
+        from gofr_tpu.datasource.sql import connect_sql
+
+        self.sql = connect_sql(self.config, self.logger, self.metrics)
+
+    def _maybe_redis(self) -> None:
+        host = self.config.get("REDIS_HOST")
+        if not host:
+            return
+        from gofr_tpu.datasource.redis import connect_redis
+
+        self.redis = connect_redis(self.config, self.logger, self.metrics)
+
+    def _maybe_pubsub(self) -> None:
+        backend = (self.config.get("PUBSUB_BACKEND") or "").lower()
+        if not backend:
+            return
+        from gofr_tpu.pubsub import connect_pubsub
+
+        self.pubsub = connect_pubsub(backend, self.config, self.logger, self.metrics)
+
+    def _wire_file(self) -> None:
+        from gofr_tpu.datasource.file import LocalFileSystem
+
+        self.file = LocalFileSystem()
+
+    def _maybe_kv(self) -> None:
+        path = self.config.get("KV_PATH")
+        if not path:
+            return
+        from gofr_tpu.datasource.kv import KVStore
+
+        self.kv = KVStore(path, self.logger, self.metrics)
+
+    # -- external-plugin injection (gofr `external_db.go` pattern) -------------
+
+    def add_mongo(self, client: Any) -> None:
+        self.mongo = self._wire_plugin(client)
+
+    def add_cassandra(self, client: Any) -> None:
+        self.cassandra = self._wire_plugin(client)
+
+    def add_clickhouse(self, client: Any) -> None:
+        self.clickhouse = self._wire_plugin(client)
+
+    def add_kv_store(self, client: Any) -> None:
+        self.kv = self._wire_plugin(client)
+
+    def _wire_plugin(self, client: Any) -> Any:
+        if hasattr(client, "use_logger"):
+            client.use_logger(self.logger)
+        if hasattr(client, "use_metrics"):
+            client.use_metrics(self.metrics)
+        if hasattr(client, "connect"):
+            client.connect()
+        return client
+
+    # -- TPU device datasource (lazy; a feature like any other) ----------------
+
+    @property
+    def tpu(self):
+        if self._tpu is None:
+            with self._tpu_lock:
+                if self._tpu is None:
+                    from gofr_tpu.tpu.device import TPUDevices
+
+                    self._tpu = TPUDevices(self.config, self.logger, self.metrics)
+        return self._tpu
+
+    @property
+    def tpu_wired(self) -> bool:
+        return self._tpu is not None
+
+    # -- model engines ---------------------------------------------------------
+
+    def register_engine(self, name: str, engine: Any) -> None:
+        self._engines[name] = engine
+
+    def engine(self, name: str):
+        try:
+            return self._engines[name]
+        except KeyError:
+            raise KeyError(
+                f"no model {name!r} served; registered: {sorted(self._engines)}"
+            ) from None
+
+    @property
+    def engines(self) -> dict[str, Any]:
+        return dict(self._engines)
+
+    def infer(self, model: str, inputs: Any, **kw: Any):
+        return self.engine(model).infer(inputs, **kw)
+
+    def generate(self, model: str, prompt: Any, **kw: Any):
+        return self.engine(model).generate(prompt, **kw)
+
+    # -- inter-service HTTP clients -------------------------------------------
+
+    def register_service(self, name: str, client: Any) -> None:
+        self.services[name] = client
+
+    def http_service(self, name: str):
+        try:
+            return self.services[name]
+        except KeyError:
+            raise KeyError(f"no HTTP service registered as {name!r}") from None
+
+    # -- pubsub convenience ----------------------------------------------------
+
+    def publish(self, topic: str, payload: Any) -> None:
+        if self.pubsub is None:
+            raise RuntimeError("no pubsub backend configured (set PUBSUB_BACKEND)")
+        self.metrics.increment_counter("app_pubsub_publish_total_count", 1, topic=topic)
+        self.pubsub.publish(topic, payload)
+        self.metrics.increment_counter("app_pubsub_publish_success_count", 1, topic=topic)
+
+    # -- health aggregation (gofr `container/health.go`) -----------------------
+
+    def health(self) -> dict[str, Any]:
+        services: dict[str, Any] = {}
+        down = 0
+
+        def check(name: str, obj: Any) -> None:
+            nonlocal down
+            if obj is None:
+                return
+            try:
+                h = obj.health_check() if hasattr(obj, "health_check") else {"status": "UP"}
+            except Exception as e:  # noqa: BLE001
+                h = {"status": "DOWN", "details": {"error": str(e)}}
+            services[name] = h
+            if h.get("status") != "UP":
+                down += 1
+
+        check("sql", self.sql)
+        check("redis", self.redis)
+        check("pubsub", self.pubsub)
+        check("kv", self.kv)
+        check("mongo", self.mongo)
+        check("cassandra", self.cassandra)
+        check("clickhouse", self.clickhouse)
+        check("tpu", self._tpu)
+        for name, engine in self._engines.items():
+            check(f"model:{name}", engine)
+        for name, svc in self.services.items():
+            check(f"service:{name}", svc)
+
+        status = "UP" if down == 0 else ("DEGRADED" if down < max(len(services), 1) else "DOWN")
+        return {
+            "status": status,
+            "name": self.app_name,
+            "version": self.app_version,
+            "services": services,
+        }
+
+    # -- shutdown --------------------------------------------------------------
+
+    def close(self) -> None:
+        if self._remote_level_poller is not None:
+            self._remote_level_poller.stop()
+        for engine in self._engines.values():
+            if hasattr(engine, "stop"):
+                engine.stop()
+        for ds in (self.sql, self.redis, self.pubsub, self.kv, self.mongo, self.cassandra, self.clickhouse):
+            if ds is not None and hasattr(ds, "close"):
+                try:
+                    ds.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        self.tracer.shutdown()
+
+
+def new_mock_container(config: dict[str, str] | None = None) -> Container:
+    """Hermetic container for handler tests (gofr `NewMockContainer`): mock
+    logger, real metrics registry, no datasources wired, in-memory pubsub."""
+    from gofr_tpu.pubsub.inmemory import InMemoryBroker
+
+    c = Container(DictConfig(config or {}), logger=MockLogger(level=Level.DEBUG))
+    c._register_framework_metrics()
+    c.pubsub = InMemoryBroker()
+    return c

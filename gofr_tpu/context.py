@@ -1,0 +1,139 @@
+"""Context: the transport-neutral handler context (gofr `pkg/gofr/context.go`).
+
+Every entrypoint — HTTP, gRPC, pub/sub message, cron firing, CLI invocation,
+websocket — constructs a Context from (request, container) and passes it to the
+user handler ``def handler(ctx) -> result``. Handlers reach infrastructure only
+through the context: ``ctx.sql``, ``ctx.redis``, ``ctx.tpu``, ``ctx.infer``,
+``ctx.http_service(name)``, never a transport.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any
+
+from gofr_tpu.tracing import Span
+
+if TYPE_CHECKING:
+    from gofr_tpu.container import Container
+
+
+class Context:
+    __slots__ = ("request", "container", "responder", "span", "_values")
+
+    def __init__(self, request: Any, container: "Container", responder: Any = None, span: Span | None = None):
+        self.request = request
+        self.container = container
+        self.responder = responder
+        self.span = span
+        self._values: dict[str, Any] = {}
+
+    # -- request passthrough ---------------------------------------------------
+
+    def param(self, key: str) -> str:
+        return self.request.param(key)
+
+    def params(self, key: str) -> list[str]:
+        return self.request.params(key)
+
+    def path_param(self, key: str) -> str:
+        return self.request.path_param(key)
+
+    def bind(self, target: Any = dict) -> Any:
+        return self.request.bind(target)
+
+    def header(self, key: str) -> str | None:
+        headers = getattr(self.request, "headers", None)
+        return headers.get(key) if headers else None
+
+    @property
+    def claims(self) -> dict[str, Any]:
+        """JWT claims injected by the OAuth middleware (empty when unauthenticated)."""
+        ctx = self.request.context() if hasattr(self.request, "context") else {}
+        return ctx.get("jwt_claims", {})
+
+    @property
+    def auth_user(self) -> str | None:
+        ctx = self.request.context() if hasattr(self.request, "context") else {}
+        return ctx.get("auth_user")
+
+    # -- container passthrough -------------------------------------------------
+
+    @property
+    def logger(self):
+        return self.container.logger
+
+    @property
+    def config(self):
+        return self.container.config
+
+    @property
+    def metrics(self):
+        return self.container.metrics
+
+    @property
+    def sql(self):
+        return self.container.sql
+
+    @property
+    def redis(self):
+        return self.container.redis
+
+    @property
+    def kv(self):
+        return self.container.kv
+
+    @property
+    def file(self):
+        return self.container.file
+
+    @property
+    def mongo(self):
+        return self.container.mongo
+
+    @property
+    def cassandra(self):
+        return self.container.cassandra
+
+    @property
+    def clickhouse(self):
+        return self.container.clickhouse
+
+    @property
+    def tpu(self):
+        return self.container.tpu
+
+    def http_service(self, name: str):
+        return self.container.http_service(name)
+
+    def publish(self, topic: str, payload: Any) -> None:
+        self.container.publish(topic, payload)
+
+    # -- model inference (the TPU-native capability) ---------------------------
+
+    def infer(self, model: str, inputs: Any, **kw: Any):
+        """Enqueue ``inputs`` on a served model's continuous-batching engine and
+        block until the result is ready. Works from sync handlers (the engine
+        runs in its own device thread)."""
+        return self.container.infer(model, inputs, **kw)
+
+    def generate(self, model: str, prompt: Any, max_new_tokens: int = 64, **kw: Any):
+        return self.container.generate(model, prompt, max_new_tokens=max_new_tokens, **kw)
+
+    # -- tracing & scratch values ---------------------------------------------
+
+    def trace(self, name: str) -> Span:
+        """Open a user span as a child of the request span (gofr `context.go:45-55`).
+        Use as a context manager: ``with ctx.trace("work"): ...``"""
+        return self.container.tracer.start_span(name, parent=self.span)
+
+    def set_value(self, key: str, value: Any) -> None:
+        self._values[key] = value
+
+    def get_value(self, key: str, default: Any = None) -> Any:
+        return self._values.get(key, default)
+
+    # -- CLI output (cmd responder) -------------------------------------------
+
+    def out(self, *args: Any) -> None:
+        if self.responder is not None and hasattr(self.responder, "write"):
+            self.responder.write(*args)

@@ -1,0 +1,84 @@
+"""File datasource: local filesystem with typed row readers.
+
+Parity with gofr `pkg/gofr/datasource/file/`: Create/Mkdir/Open/Remove/Rename
+surface plus ``read_rows`` returning JSON/CSV/text row iterators selected by
+extension (`file/file.go:50-56`). Remote filesystems plug in by implementing
+the same methods (FileSystemProvider pattern).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import os
+import shutil
+from typing import Any, Iterator
+
+
+class LocalFileSystem:
+    def __init__(self, root: str = "."):
+        self.root = root
+
+    def _p(self, name: str) -> str:
+        return name if os.path.isabs(name) else os.path.join(self.root, name)
+
+    def create(self, name: str, data: bytes = b"") -> None:
+        with open(self._p(name), "wb") as f:
+            f.write(data)
+
+    def read(self, name: str) -> bytes:
+        with open(self._p(name), "rb") as f:
+            return f.read()
+
+    def open(self, name: str, mode: str = "rb"):
+        return open(self._p(name), mode)
+
+    def mkdir(self, name: str) -> None:
+        os.mkdir(self._p(name))
+
+    def mkdir_all(self, name: str) -> None:
+        os.makedirs(self._p(name), exist_ok=True)
+
+    def remove(self, name: str) -> None:
+        os.remove(self._p(name))
+
+    def remove_all(self, name: str) -> None:
+        shutil.rmtree(self._p(name), ignore_errors=True)
+
+    def rename(self, old: str, new: str) -> None:
+        os.replace(self._p(old), self._p(new))
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self._p(name))
+
+    def list(self, name: str = ".") -> list[str]:
+        return sorted(os.listdir(self._p(name)))
+
+    def stat(self, name: str) -> os.stat_result:
+        return os.stat(self._p(name))
+
+    # -- row readers (extension-dispatched) ------------------------------------
+
+    def read_rows(self, name: str) -> Iterator[Any]:
+        """Yield rows: dicts for .json/.jsonl, dicts for .csv (header row),
+        stripped lines for anything else."""
+        ext = os.path.splitext(name)[1].lower()
+        data = self.read(name)
+        if ext == ".json":
+            parsed = json.loads(data)
+            yield from (parsed if isinstance(parsed, list) else [parsed])
+        elif ext == ".jsonl":
+            for line in data.splitlines():
+                if line.strip():
+                    yield json.loads(line)
+        elif ext == ".csv":
+            reader = csv.DictReader(io.StringIO(data.decode()))
+            yield from reader
+        else:
+            for line in data.decode(errors="replace").splitlines():
+                yield line
+
+    def health_check(self) -> dict[str, Any]:
+        usage = shutil.disk_usage(self.root)
+        return {"status": "UP", "details": {"root": os.path.abspath(self.root), "free_bytes": usage.free}}

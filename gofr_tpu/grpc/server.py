@@ -1,0 +1,133 @@
+"""gRPC server with logging/tracing/panic-recovery interceptor.
+
+Parity with gofr `pkg/gofr/grpc.go:22-27` (chained interceptors: recovery +
+logging/tracing) and `pkg/gofr/grpc/log.go` (per-RPC span + structured RPCLog
+with method/status/µs). Servicers are generated-protobuf classes registered via
+``app.register_grpc_service(add_fn, servicer)``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any
+
+import grpc
+
+from gofr_tpu.context import Context
+
+_grpc_ctx: contextvars.ContextVar[Context | None] = contextvars.ContextVar("gofr_grpc_ctx", default=None)
+
+
+def current_grpc_context() -> Context | None:
+    """The framework Context for the in-flight RPC (same surface as HTTP
+    handlers get — closes the reference's gRPC asymmetry)."""
+    return _grpc_ctx.get()
+
+
+class RPCLog:
+    def __init__(self, method: str, status_code: int, duration_us: int, trace_id: str):
+        self.method = method
+        self.status_code = status_code
+        self.duration_us = duration_us
+        self.trace_id = trace_id
+
+    def to_log_dict(self) -> dict[str, Any]:
+        return {
+            "message": "rpc",
+            "method": self.method,
+            "status_code": self.status_code,
+            "duration_us": self.duration_us,
+            "trace_id": self.trace_id,
+        }
+
+    def pretty_print(self, w) -> None:
+        w.write(f"  RPC {self.method} status={self.status_code} {self.duration_us}µs\n")
+
+
+class GofrGrpcInterceptor(grpc.ServerInterceptor):
+    def __init__(self, container):
+        self._container = container
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or not handler.unary_unary:
+            return handler
+        container = self._container
+        method = handler_call_details.method
+        metadata = dict(handler_call_details.invocation_metadata or ())
+        inner = handler.unary_unary
+
+        def wrapped(request, servicer_context):
+            span = container.tracer.start_span(
+                f"grpc {method}", traceparent=metadata.get("traceparent"), kind="SERVER",
+                set_current=False,
+            )
+            ctx = Context(_GRPCRequestAdapter(request, metadata), container, span=span)
+            token = _grpc_ctx.set(ctx)
+            start = time.perf_counter()
+            status = 0
+            try:
+                return inner(request, servicer_context)
+            except Exception as e:  # noqa: BLE001 - panic recovery → INTERNAL
+                status = 13  # grpc INTERNAL
+                span.set_status("ERROR")
+                container.logger.log_exception(e, f"grpc handler {method}")
+                servicer_context.abort(grpc.StatusCode.INTERNAL, "internal error")
+            finally:
+                _grpc_ctx.reset(token)
+                span.finish()
+                container.logger.info(
+                    RPCLog(method, status, int((time.perf_counter() - start) * 1e6), span.trace_id)
+                )
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
+class _GRPCRequestAdapter:
+    """Request-interface adapter over a protobuf message."""
+
+    def __init__(self, message, metadata: dict[str, str]):
+        self.message = message
+        self.metadata = metadata
+        self._ctx: dict[str, Any] = {}
+
+    def param(self, key: str) -> str:
+        return str(self.metadata.get(key, ""))
+
+    def params(self, key: str) -> list[str]:
+        v = self.param(key)
+        return [v] if v else []
+
+    def path_param(self, key: str) -> str:
+        return ""
+
+    def bind(self, target: Any = None) -> Any:
+        return self.message
+
+    def host_name(self) -> str:
+        return "grpc"
+
+    def context(self) -> dict[str, Any]:
+        return self._ctx
+
+
+def start_grpc_server(app) -> grpc.Server:
+    server = grpc.server(
+        ThreadPoolExecutor(max_workers=app.config.get_int("GRPC_THREADS", 16),
+                           thread_name_prefix="gofr-grpc"),
+        interceptors=[GofrGrpcInterceptor(app.container)],
+    )
+    for adder, servicer in app._grpc_services:
+        if servicer is not None:
+            adder(servicer, server)
+        elif callable(adder):
+            adder(server)
+    server.add_insecure_port(f"[::]:{app.grpc_port}")
+    server.start()
+    return server

@@ -1,0 +1,33 @@
+"""HTTP layer: request/responder abstractions, typed errors, middleware chain.
+
+Parity with the reference's `pkg/gofr/http` package (router, request binding,
+JSON envelope responder, typed status-carrying errors, middleware chain) built
+on asyncio/aiohttp instead of gorilla/mux + goroutine-per-request.
+"""
+
+from gofr_tpu.http.errors import (
+    EntityAlreadyExists,
+    EntityNotFound,
+    HTTPError,
+    InvalidParam,
+    InvalidRoute,
+    MissingParam,
+    PanicRecovery,
+    RequestTimeout,
+)
+from gofr_tpu.http.responses import File, Raw, Redirect, Response
+
+__all__ = [
+    "HTTPError",
+    "EntityNotFound",
+    "EntityAlreadyExists",
+    "InvalidParam",
+    "MissingParam",
+    "InvalidRoute",
+    "RequestTimeout",
+    "PanicRecovery",
+    "Raw",
+    "File",
+    "Redirect",
+    "Response",
+]

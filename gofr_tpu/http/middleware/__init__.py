@@ -1,0 +1,165 @@
+"""HTTP middleware chain (gofr `pkg/gofr/http/middleware/`).
+
+Order (outermost first), matching the reference (`http_server.go:25-31`):
+ws-upgrade → tracer → logging → CORS → metrics → auth (optional) → handler.
+Implemented as aiohttp middlewares; each receives the shared App wiring via
+``request.app``.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+import uuid
+from typing import Any, Callable
+
+from aiohttp import web
+
+from gofr_tpu.tracing import Tracer
+
+
+CONTAINER_KEY = web.AppKey("gofr_container", object)
+SPAN_KEY = "gofr_span"
+AUTH_KEY = "gofr_auth"
+
+
+def tracer_middleware(tracer: Tracer):
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        traceparent = request.headers.get("traceparent")
+        span = tracer.start_span(
+            f"{request.method} {request.path}", traceparent=traceparent, kind="SERVER",
+            set_current=False,
+        )
+        span.set_attribute("http.method", request.method)
+        span.set_attribute("http.target", request.path_qs)
+        request[SPAN_KEY] = span
+        try:
+            response = await handler(request)
+            span.set_attribute("http.status_code", getattr(response, "status", 0))
+            return response
+        except Exception:
+            span.set_status("ERROR")
+            raise
+        finally:
+            span.finish()
+
+    return mw
+
+
+class RequestLog:
+    """Structured request record with custom terminal rendering
+    (gofr `middleware/logger.go:110-122`)."""
+
+    def __init__(self, trace_id: str, span_id: str, method: str, uri: str,
+                 status: int, duration_us: int, ip: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.method = method
+        self.uri = uri
+        self.status = status
+        self.duration_us = duration_us
+        self.ip = ip
+
+    def to_log_dict(self) -> dict[str, Any]:
+        return {
+            "message": "request",
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "method": self.method,
+            "uri": self.uri,
+            "status": self.status,
+            "duration_us": self.duration_us,
+            "ip": self.ip,
+        }
+
+    def pretty_print(self, w) -> None:
+        color = 32 if self.status < 400 else (33 if self.status < 500 else 31)
+        w.write(
+            f"  \x1b[{color}m{self.status}\x1b[0m {self.method:<7} {self.uri} "
+            f"{self.duration_us}µs trace={self.trace_id}\n"
+        )
+
+
+def logging_middleware(logger):
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        start = time.perf_counter()
+        span = request.get(SPAN_KEY)
+        trace_id = span.trace_id if span else ""
+        span_id = span.span_id if span else ""
+        correlation = trace_id or uuid.uuid4().hex
+        ip = request.headers.get("X-Forwarded-For", request.remote or "")
+        if "," in ip:
+            ip = ip.split(",")[0].strip()
+        try:
+            response = await handler(request)
+        except web.HTTPException as http_err:
+            # aiohttp routing errors (404/405) pass through as responses
+            logger.info(RequestLog(trace_id, span_id, request.method, request.path_qs,
+                                   http_err.status, int((time.perf_counter() - start) * 1e6), ip))
+            raise
+        except Exception as exc:  # panic recovery → JSON 500 (logger.go:129-152)
+            logger.error({
+                "message": "panic recovered",
+                "error": repr(exc),
+                "stack": traceback.format_exc(),
+                "trace_id": trace_id,
+                "uri": request.path_qs,
+            })
+            response = web.json_response(
+                {"error": {"message": "some unexpected error has occurred"}}, status=500
+            )
+        response.headers["X-Correlation-ID"] = correlation
+        duration_us = int((time.perf_counter() - start) * 1e6)
+        log_fn = logger.info if response.status < 500 else logger.error
+        log_fn(RequestLog(trace_id, span_id, request.method, request.path_qs,
+                          response.status, duration_us, ip))
+        return response
+
+    return mw
+
+
+def cors_middleware(config, registered_methods: Callable[[], list[str]]):
+    def _hdr(name: str, default: str) -> str:
+        return config.get_or_default(name, default) if config else default
+
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        if request.method == "OPTIONS":
+            response = web.Response(status=200)
+        else:
+            response = await handler(request)
+        methods = _hdr("ACCESS_CONTROL_ALLOW_METHODS", ", ".join(registered_methods()))
+        response.headers.setdefault("Access-Control-Allow-Origin", _hdr("ACCESS_CONTROL_ALLOW_ORIGIN", "*"))
+        response.headers.setdefault("Access-Control-Allow-Methods", methods)
+        response.headers.setdefault(
+            "Access-Control-Allow-Headers",
+            _hdr("ACCESS_CONTROL_ALLOW_HEADERS", "Authorization, Content-Type, x-requested-with, X-API-KEY"),
+        )
+        return response
+
+    return mw
+
+
+def metrics_middleware(metrics):
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        start = time.perf_counter()
+        status = 500
+        try:
+            response = await handler(request)
+            status = response.status
+            return response
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        finally:
+            route = request.match_info.route
+            template = getattr(route.resource, "canonical", request.path) if route and route.resource else request.path
+            metrics.record_histogram(
+                "app_http_response", time.perf_counter() - start,
+                path=template, method=request.method, status=str(status),
+            )
+
+    return mw

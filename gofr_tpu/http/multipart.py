@@ -1,0 +1,123 @@
+"""Multipart form parsing + struct binding (gofr `pkg/gofr/http/multipart_file_bind.go`).
+
+Parses ``multipart/form-data`` bodies without external deps and binds parts into
+a user dataclass: ``UploadFile``-annotated fields receive files, ``Zip`` fields
+receive zip archives expanded in memory (100MB cap, mirroring
+`pkg/gofr/file/zip.go:13-17`), and other fields receive coerced form values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import re
+import typing
+import zipfile
+from dataclasses import dataclass, field
+
+from gofr_tpu.utils import bind as binder
+from gofr_tpu.utils.bind import BindError
+
+_MAX_ZIP_BYTES = 100 * 1024 * 1024
+
+
+@dataclass
+class UploadFile:
+    filename: str
+    content: bytes
+    content_type: str = "application/octet-stream"
+
+    def read(self) -> bytes:
+        return self.content
+
+
+@dataclass
+class Zip:
+    """An uploaded zip archive, expanded in memory."""
+
+    files: dict[str, bytes] = field(default_factory=dict)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Zip":
+        out: dict[str, bytes] = {}
+        total = 0
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            for info in zf.infolist():
+                if info.is_dir():
+                    continue
+                total += info.file_size
+                if total > _MAX_ZIP_BYTES:
+                    raise BindError("zip contents exceed 100MB limit")
+                out[info.filename] = zf.read(info)
+        return cls(files=out)
+
+
+def parse_multipart(content_type: str, body: bytes) -> list[tuple[str, str | None, str, bytes]]:
+    """Return list of (name, filename, part_content_type, data)."""
+    m = re.search(r'boundary="?([^";]+)"?', content_type)
+    if not m:
+        raise BindError("multipart body missing boundary")
+    boundary = m.group(1).encode()
+    parts: list[tuple[str, str | None, str, bytes]] = []
+    for chunk in body.split(b"--" + boundary):
+        # strip exactly the delimiter CRLFs, never trailing newlines that are
+        # part of the uploaded content
+        if chunk.startswith(b"\r\n"):
+            chunk = chunk[2:]
+        if chunk.endswith(b"\r\n"):
+            chunk = chunk[:-2]
+        if not chunk or chunk in (b"--", b"--\r\n"):
+            continue
+        if b"\r\n\r\n" in chunk:
+            raw_headers, data = chunk.split(b"\r\n\r\n", 1)
+        else:
+            raw_headers, data = chunk, b""
+        headers: dict[str, str] = {}
+        for line in raw_headers.decode(errors="replace").split("\r\n"):
+            if ":" in line:
+                k, _, v = line.partition(":")
+                headers[k.strip().lower()] = v.strip()
+        disp = headers.get("content-disposition", "")
+        name_m = re.search(r'name="([^"]*)"', disp)
+        file_m = re.search(r'filename="([^"]*)"', disp)
+        if not name_m:
+            continue
+        parts.append(
+            (
+                name_m.group(1),
+                file_m.group(1) if file_m else None,
+                headers.get("content-type", "application/octet-stream"),
+                data,
+            )
+        )
+    return parts
+
+
+def bind_multipart(content_type: str, body: bytes, target: typing.Any) -> typing.Any:
+    parts = parse_multipart(content_type, body)
+    if target is dict:
+        return {
+            name: (UploadFile(filename, data, ptype) if filename is not None else data.decode(errors="replace"))
+            for name, filename, ptype, data in parts
+        }
+    if not (isinstance(target, type) and dataclasses.is_dataclass(target)):
+        raise BindError("multipart bind target must be a dataclass or dict")
+    hints = typing.get_type_hints(target)
+    by_name = {name: (filename, ptype, data) for name, filename, ptype, data in parts}
+    kwargs: dict[str, typing.Any] = {}
+    for f in dataclasses.fields(target):
+        if f.name not in by_name:
+            if f.default is dataclasses.MISSING and f.default_factory is dataclasses.MISSING:  # type: ignore[misc]
+                raise BindError(f"missing multipart field {f.name!r}")
+            continue
+        filename, ptype, data = by_name[f.name]
+        ann = hints.get(f.name, typing.Any)
+        if ann is UploadFile:
+            kwargs[f.name] = UploadFile(filename or f.name, data, ptype)
+        elif ann is Zip:
+            kwargs[f.name] = Zip.from_bytes(data)
+        elif ann is bytes:
+            kwargs[f.name] = data
+        else:
+            kwargs[f.name] = binder.bind_value(data.decode(errors="replace"), ann)
+    return target(**kwargs)

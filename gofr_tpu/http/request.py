@@ -1,0 +1,145 @@
+"""HTTP request wrapper: the HTTP implementation of the transport-neutral
+Request interface (gofr `pkg/gofr/http/request.go`).
+
+The server materializes the body BEFORE the handler runs, so ``Request`` is
+fully synchronous and safe to hand to sync handlers running in worker threads —
+the transport-neutral analog of the reference buffering/re-buffering the body
+(`request.go:86-95`).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Mapping
+from urllib.parse import parse_qs
+
+from gofr_tpu.http.errors import InvalidParam
+from gofr_tpu.utils import bind as binder
+
+
+class Request:
+    """Transport-neutral request interface (gofr `pkg/gofr/gofr.go` Request).
+
+    Implementations: HTTPRequest (here), cmd.Request, pubsub.Message,
+    websocket.Connection.
+    """
+
+    def param(self, key: str) -> str:
+        return ""
+
+    def params(self, key: str) -> list[str]:
+        return []
+
+    def path_param(self, key: str) -> str:
+        return ""
+
+    def bind(self, target: Any) -> Any:
+        raise NotImplementedError
+
+    def host_name(self) -> str:
+        return ""
+
+    def context(self) -> dict[str, Any]:
+        """Per-request values injected by middleware (auth claims etc.)."""
+        return {}
+
+
+class HTTPRequest(Request):
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        query_string: str = "",
+        headers: Mapping[str, str] | None = None,
+        body: bytes = b"",
+        path_params: Mapping[str, str] | None = None,
+        remote: str = "",
+        route_template: str = "",
+    ):
+        self.method = method
+        self.path = path
+        self.headers = _CIDict(headers or {})
+        self.body = body
+        self.remote = remote
+        self.route_template = route_template or path
+        self._query = parse_qs(query_string, keep_blank_values=True)
+        self._path_params = dict(path_params or {})
+        self._ctx: dict[str, Any] = {}
+
+    # -- Request interface -----------------------------------------------------
+
+    def param(self, key: str) -> str:
+        values = self._query.get(key)
+        return values[0] if values else ""
+
+    def params(self, key: str) -> list[str]:
+        # comma-split multi-values like the reference's query params
+        out: list[str] = []
+        for v in self._query.get(key, []):
+            out.extend(p for p in v.split(",") if p != "")
+        return out
+
+    def path_param(self, key: str) -> str:
+        return self._path_params.get(key, "")
+
+    def bind(self, target: Any = dict) -> Any:
+        content_type = (self.headers.get("Content-Type") or "").split(";")[0].strip().lower()
+        if content_type in ("", "application/json"):
+            if not self.body:
+                data: Any = {}
+            else:
+                try:
+                    data = json.loads(self.body)
+                except json.JSONDecodeError as e:
+                    raise InvalidParam("body") from e
+            return binder.bind(data, target)
+        if content_type == "application/x-www-form-urlencoded":
+            form = {k: v[0] if len(v) == 1 else v for k, v in parse_qs(self.body.decode(), keep_blank_values=True).items()}
+            return binder.bind(form, target)
+        if content_type.startswith("text/"):
+            if target in (str, bytes):
+                return self.body.decode() if target is str else self.body
+            return binder.bind(self.body.decode(), target)
+        if content_type == "multipart/form-data":
+            from gofr_tpu.http.multipart import bind_multipart
+
+            return bind_multipart(self.headers.get("Content-Type", ""), self.body, target)
+        raise InvalidParam("Content-Type")
+
+    def host_name(self) -> str:
+        proto = self.headers.get("X-Forwarded-Proto") or "http"
+        host = self.headers.get("Host") or ""
+        return f"{proto}://{host}" if host else ""
+
+    def context(self) -> dict[str, Any]:
+        return self._ctx
+
+    # -- extras ----------------------------------------------------------------
+
+    @property
+    def client_ip(self) -> str:
+        fwd = self.headers.get("X-Forwarded-For")
+        if fwd:
+            return fwd.split(",")[0].strip()
+        return self.remote
+
+
+class _CIDict(dict):
+    """Case-insensitive header map."""
+
+    def __init__(self, data: Mapping[str, str]):
+        super().__init__()
+        for k, v in data.items():
+            self[k] = v
+
+    def __setitem__(self, key: str, value: str) -> None:
+        super().__setitem__(key.lower(), value)
+
+    def __getitem__(self, key: str) -> str:
+        return super().__getitem__(key.lower())
+
+    def get(self, key: str, default: str | None = None) -> str | None:  # type: ignore[override]
+        return super().get(key.lower(), default)
+
+    def __contains__(self, key: object) -> bool:
+        return super().__contains__(str(key).lower())

@@ -1,0 +1,76 @@
+"""Remote log-level polling (gofr `pkg/gofr/logging/remotelogger/dynamic_level_logger.go`).
+
+A background thread GETs ``REMOTE_LOG_URL`` every ``REMOTE_LOG_FETCH_INTERVAL``
+seconds (default 15) and live-changes the logger level. Expected response:
+``{"data": [{"serviceName": ..., "logLevel": {"LOG_LEVEL": "DEBUG"}}]}`` or any
+JSON containing a ``LOG_LEVEL``-ish string — we accept ``{"level": "DEBUG"}``
+and plain ``DEBUG`` bodies too.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+from gofr_tpu.logging import Level, Logger
+
+
+def _extract_level(body: str) -> str | None:
+    body = body.strip()
+    try:
+        data = json.loads(body)
+    except json.JSONDecodeError:
+        return body if body.upper() in Level.__members__ else None
+    # walk the structure for a LOG_LEVEL / logLevel / level key
+    stack = [data]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            for key in ("LOG_LEVEL", "logLevel", "level"):
+                v = node.get(key)
+                if isinstance(v, str):
+                    return v
+                if isinstance(v, dict):
+                    stack.append(v)
+            stack.extend(node.values())
+        elif isinstance(node, list):
+            stack.extend(node)
+    return None
+
+
+class RemoteLevelPoller:
+    def __init__(self, logger: Logger, url: str, interval: float = 15.0, timeout: float = 5.0):
+        self._logger = logger
+        self._url = url
+        self._interval = max(1.0, interval)
+        self._timeout = timeout
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="gofr-remote-log-level", daemon=True)
+        self._thread.start()
+
+    def poll_once(self) -> None:
+        try:
+            with urllib.request.urlopen(self._url, timeout=self._timeout) as resp:
+                body = resp.read().decode(errors="replace")
+        except Exception:  # noqa: BLE001 - remote being down must not affect the app
+            return
+        name = _extract_level(body)
+        if not name:
+            return
+        new_level = Level.parse(name, default=self._logger.level)
+        if new_level != self._logger.level:
+            self._logger.infof("remote log level change: %s -> %s", self._logger.level.name, new_level.name)
+            self._logger.change_level(new_level)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.poll_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

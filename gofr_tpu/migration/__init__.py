@@ -1,0 +1,76 @@
+"""Versioned migrations (gofr `pkg/gofr/migration/`).
+
+User supplies ``{version:int -> Migration(up=fn)}``; the runner sorts versions,
+skips those at or below the last applied, wraps each in a per-datasource
+transaction, records completions in ``gofr_migrations`` (`sql.go:12-18`
+semantics), and rolls back on failure (`migration.go:28-91`). The datasource
+handle passed to ``up`` exposes sql/redis/kv/pubsub so migrations can touch any
+wired store (chain-of-responsibility per `interface.go:44-51`).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class Migration:
+    up: Callable[["MigrationDatasource"], Any]
+
+
+class MigrationDatasource:
+    """Narrow view of the container handed to each migration."""
+
+    def __init__(self, container, tx=None):
+        self._container = container
+        self.sql = tx if tx is not None else container.sql
+        self.redis = container.redis
+        self.kv = container.kv
+        self.pubsub = container.pubsub
+        self.logger = container.logger
+
+
+MIGRATION_TABLE_DDL = (
+    "CREATE TABLE IF NOT EXISTS gofr_migrations ("
+    "version INTEGER PRIMARY KEY, method TEXT, start_time TEXT, duration_ms INTEGER)"
+)
+
+
+def run_migrations(migrations: dict[int, Migration | Any], container) -> list[int]:
+    """Run pending migrations in version order; returns versions applied."""
+    logger = container.logger
+    if not migrations:
+        return []
+    db = container.sql
+    if db is None:
+        raise RuntimeError("migrations require a SQL datasource (set DB_DIALECT)")
+
+    db.execute(MIGRATION_TABLE_DDL)
+    row = db.query_row("SELECT MAX(version) AS v FROM gofr_migrations")
+    last = row["v"] if row and row["v"] is not None else 0
+
+    applied: list[int] = []
+    for version in sorted(migrations):
+        if version <= last:
+            continue
+        migration = migrations[version]
+        up = migration.up if isinstance(migration, Migration) else migration
+        start = time.time()
+        with db.begin() as tx:
+            try:
+                up(MigrationDatasource(container, tx=tx))
+                duration_ms = int((time.time() - start) * 1000)
+                tx.execute(
+                    "INSERT INTO gofr_migrations (version, method, start_time, duration_ms) VALUES (?, ?, ?, ?)",
+                    (version, "UP", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(start)), duration_ms),
+                )
+                tx.commit()
+            except Exception as e:
+                tx.rollback()
+                logger.errorf("migration %d failed, rolled back: %r", version, e)
+                raise
+        logger.infof("migration %d applied in %dms", version, int((time.time() - start) * 1000))
+        applied.append(version)
+    return applied

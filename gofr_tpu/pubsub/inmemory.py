@@ -1,0 +1,88 @@
+"""In-memory pub/sub broker: consumer groups, offsets, at-least-once redelivery.
+
+The in-tree broker (and the hermetic test double, like the reference's
+MockPubSub — but functional): per-topic append-only log, per-(topic, group)
+committed offset, blocking subscribe with timeout, uncommitted messages are
+redelivered — faithful at-least-once semantics so micro-batch commit logic can
+be tested without Kafka.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from gofr_tpu.pubsub import Message, encode_payload
+
+
+class InMemoryBroker:
+    def __init__(self):
+        self._logs: dict[str, list[bytes]] = {}
+        self._offsets: dict[tuple[str, str], int] = {}  # committed offset
+        self._cursor: dict[tuple[str, str], int] = {}  # next delivery position
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def publish(self, topic: str, payload: Any) -> None:
+        data = encode_payload(payload)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("broker closed")
+            self._logs.setdefault(topic, []).append(data)
+            self._cond.notify_all()
+
+    def subscribe(self, topic: str, group: str = "default", timeout: float | None = None) -> Message | None:
+        key = (topic, group)
+        with self._cond:
+            while True:
+                if self._closed:
+                    return None
+                log = self._logs.setdefault(topic, [])
+                pos = self._cursor.get(key, self._offsets.get(key, 0))
+                if pos < len(log):
+                    self._cursor[key] = pos + 1
+                    value = log[pos]
+                    return Message(
+                        topic,
+                        value,
+                        metadata={"offset": pos, "group": group},
+                        committer=lambda p=pos: self._commit(key, p + 1),
+                    )
+                if not self._cond.wait(timeout=timeout):
+                    return None
+
+    def _commit(self, key: tuple[str, str], offset: int) -> None:
+        with self._cond:
+            if offset > self._offsets.get(key, 0):
+                self._offsets[key] = offset
+
+    def rewind_uncommitted(self, topic: str, group: str = "default") -> None:
+        """Redeliver messages consumed but never committed (crash simulation)."""
+        key = (topic, group)
+        with self._cond:
+            self._cursor[key] = self._offsets.get(key, 0)
+            self._cond.notify_all()
+
+    def create_topic(self, topic: str) -> None:
+        with self._cond:
+            self._logs.setdefault(topic, [])
+
+    def delete_topic(self, topic: str) -> None:
+        with self._cond:
+            self._logs.pop(topic, None)
+
+    def topics(self) -> list[str]:
+        with self._cond:
+            return sorted(self._logs)
+
+    def health_check(self) -> dict[str, Any]:
+        with self._cond:
+            return {
+                "status": "UP" if not self._closed else "DOWN",
+                "details": {"backend": "inmemory", "topics": len(self._logs)},
+            }
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

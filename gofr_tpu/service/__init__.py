@@ -1,0 +1,344 @@
+"""Inter-service HTTP client: options-as-decorators with circuit breaker/retry/auth.
+
+Parity with gofr `pkg/gofr/service/`: ``new_http_service(addr, logger, metrics,
+*options)`` folds each option over the base client (`new.go:68-87`) — every
+option is itself a full client wrapping the next, so auth, retry and circuit
+breaking compose freely. Every request gets a client span, traceparent
+injection, a structured log and an ``app_http_service_response`` histogram
+(`new.go:140-197`). Health checks GET ``/.well-known/alive`` (`health.go:20-35`).
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+from typing import Any
+
+import httpx
+
+from gofr_tpu.tracing import current_span
+
+
+class ServiceResponse:
+    def __init__(self, status_code: int, body: bytes, headers: dict[str, str]):
+        self.status_code = status_code
+        self.body = body
+        self.headers = headers
+
+    def json(self) -> Any:
+        import json
+
+        return json.loads(self.body)
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status_code < 300
+
+
+class HTTPService:
+    """Base client (terminal element of the decorator chain)."""
+
+    def __init__(self, base_url: str, logger=None, metrics=None, tracer=None, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self._logger = logger
+        self._metrics = metrics
+        self._tracer = tracer
+        self._client = httpx.Client(timeout=timeout)
+
+    def request(self, method: str, path: str, params: dict | None = None,
+                body: bytes | None = None, headers: dict[str, str] | None = None) -> ServiceResponse:
+        url = f"{self.base_url}/{path.lstrip('/')}"
+        headers = dict(headers or {})
+        span = None
+        parent = current_span()
+        if self._tracer is not None:
+            span = self._tracer.start_span(f"HTTP {method} {self.base_url}", parent=parent,
+                                           kind="CLIENT", set_current=False)
+            headers.setdefault("traceparent", span.traceparent())
+        elif parent is not None:
+            headers.setdefault("traceparent", parent.traceparent())
+        start = time.perf_counter()
+        try:
+            resp = self._client.request(method, url, params=params, content=body, headers=headers)
+            result = ServiceResponse(resp.status_code, resp.content, dict(resp.headers))
+            return result
+        except httpx.HTTPError as e:
+            if span is not None:
+                span.set_status("ERROR").set_attribute("error", repr(e))
+            raise ServiceError(str(e)) from e
+        finally:
+            duration = time.perf_counter() - start
+            status = locals().get("result").status_code if locals().get("result") else 0
+            if span is not None:
+                span.set_attribute("http.status_code", status)
+                span.finish()
+            if self._metrics is not None:
+                self._metrics.record_histogram(
+                    "app_http_service_response", duration,
+                    service=self.base_url, method=method, status=str(status),
+                )
+            if self._logger is not None:
+                self._logger.debug({
+                    "message": "http service call", "service": self.base_url,
+                    "method": method, "path": path, "status": status,
+                    "duration_us": int(duration * 1e6),
+                })
+
+    # verb sugar (gofr new.go:35-64)
+    def get(self, path: str, params: dict | None = None, headers: dict | None = None) -> ServiceResponse:
+        return self.request("GET", path, params=params, headers=headers)
+
+    def post(self, path: str, body: bytes | None = None, params: dict | None = None,
+             headers: dict | None = None) -> ServiceResponse:
+        return self.request("POST", path, params=params, body=body, headers=headers)
+
+    def put(self, path: str, body: bytes | None = None, params: dict | None = None,
+            headers: dict | None = None) -> ServiceResponse:
+        return self.request("PUT", path, params=params, body=body, headers=headers)
+
+    def patch(self, path: str, body: bytes | None = None, params: dict | None = None,
+              headers: dict | None = None) -> ServiceResponse:
+        return self.request("PATCH", path, params=params, body=body, headers=headers)
+
+    def delete(self, path: str, body: bytes | None = None, headers: dict | None = None) -> ServiceResponse:
+        return self.request("DELETE", path, body=body, headers=headers)
+
+    def health_check(self, endpoint: str = "/.well-known/alive", timeout: float = 5.0) -> dict[str, Any]:
+        try:
+            resp = self._client.get(f"{self.base_url}{endpoint}", timeout=timeout)
+            up = 200 <= resp.status_code < 300
+            return {"status": "UP" if up else "DOWN", "details": {"host": self.base_url}}
+        except httpx.HTTPError as e:
+            return {"status": "DOWN", "details": {"host": self.base_url, "error": str(e)}}
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class ServiceError(Exception):
+    status_code = 503
+
+
+class _Wrapper:
+    """Base for decorating options: delegates everything to the inner client."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+        return self._inner.request(method, path, **kw)
+
+    def get(self, path: str, params: dict | None = None, headers: dict | None = None) -> ServiceResponse:
+        return self.request("GET", path, params=params, headers=headers)
+
+    def post(self, path: str, body: bytes | None = None, params: dict | None = None,
+             headers: dict | None = None) -> ServiceResponse:
+        return self.request("POST", path, params=params, body=body, headers=headers)
+
+    def put(self, path: str, body: bytes | None = None, params: dict | None = None,
+            headers: dict | None = None) -> ServiceResponse:
+        return self.request("PUT", path, params=params, body=body, headers=headers)
+
+    def patch(self, path: str, body: bytes | None = None, params: dict | None = None,
+              headers: dict | None = None) -> ServiceResponse:
+        return self.request("PATCH", path, params=params, body=body, headers=headers)
+
+    def delete(self, path: str, body: bytes | None = None, headers: dict | None = None) -> ServiceResponse:
+        return self.request("DELETE", path, body=body, headers=headers)
+
+    def health_check(self, **kw: Any) -> dict[str, Any]:
+        return self._inner.health_check(**kw)
+
+    def close(self) -> None:
+        self._inner.close()
+
+    @property
+    def base_url(self) -> str:
+        return self._inner.base_url
+
+
+# -- options -------------------------------------------------------------------
+
+
+class Retry:
+    """Retry on transport error or 5xx (gofr `retry.go:95-109`)."""
+
+    def __init__(self, max_retries: int = 3, backoff: float = 0.05):
+        self.max_retries = max_retries
+        self.backoff = backoff
+
+    def add_option(self, inner):
+        opt = self
+
+        class _Retry(_Wrapper):
+            def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+                last_exc: Exception | None = None
+                for attempt in range(opt.max_retries + 1):
+                    try:
+                        resp = self._inner.request(method, path, **kw)
+                        if resp.status_code < 500:
+                            return resp
+                        last_exc = ServiceError(f"server error {resp.status_code}")
+                    except ServiceError as e:
+                        last_exc = e
+                    if attempt < opt.max_retries:
+                        time.sleep(opt.backoff * (2 ** attempt))
+                if isinstance(last_exc, ServiceError):
+                    raise last_exc
+                raise ServiceError("retries exhausted")
+
+        return _Retry(inner)
+
+
+class CircuitBreaker:
+    """Two-state breaker with background health probing while open
+    (gofr `circuit_breaker.go`)."""
+
+    def __init__(self, threshold: int = 5, interval: float = 5.0):
+        self.threshold = threshold
+        self.interval = interval
+
+    def add_option(self, inner):
+        opt = self
+
+        class _CB(_Wrapper):
+            def __init__(self, inner):
+                super().__init__(inner)
+                self._failures = 0
+                self._open = False
+                self._lock = threading.Lock()
+                self._probe: threading.Thread | None = None
+
+            def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+                with self._lock:
+                    if self._open:
+                        raise ServiceError("circuit breaker is open")
+                try:
+                    resp = self._inner.request(method, path, **kw)
+                except ServiceError:
+                    self._record_failure()
+                    raise
+                if resp.status_code >= 500:
+                    self._record_failure()
+                else:
+                    with self._lock:
+                        self._failures = 0
+                return resp
+
+            def _record_failure(self) -> None:
+                with self._lock:
+                    self._failures += 1
+                    if self._failures >= opt.threshold and not self._open:
+                        self._open = True
+                        self._probe = threading.Thread(target=self._probe_loop, daemon=True,
+                                                       name="gofr-cb-probe")
+                        self._probe.start()
+
+            def _probe_loop(self) -> None:
+                while True:
+                    time.sleep(opt.interval)
+                    health = self._inner.health_check()
+                    if health.get("status") == "UP":
+                        with self._lock:
+                            self._open = False
+                            self._failures = 0
+                        return
+
+            @property
+            def is_open(self) -> bool:
+                with self._lock:
+                    return self._open
+
+            def health_check(self, **kw: Any) -> dict[str, Any]:
+                h = self._inner.health_check(**kw)
+                h.setdefault("details", {})["circuit_open"] = self.is_open
+                return h
+
+        return _CB(inner)
+
+
+class BasicAuthOption:
+    def __init__(self, username: str, password: str):
+        token = base64.b64encode(f"{username}:{password}".encode()).decode()
+        self._header = f"Basic {token}"
+
+    def add_option(self, inner):
+        return _HeaderInjector(inner, {"Authorization": self._header})
+
+
+class APIKeyOption:
+    def __init__(self, key: str):
+        self._key = key
+
+    def add_option(self, inner):
+        return _HeaderInjector(inner, {"X-API-KEY": self._key})
+
+
+class DefaultHeaders:
+    def __init__(self, **headers: str):
+        self._headers = {k.replace("_", "-"): v for k, v in headers.items()}
+
+    def add_option(self, inner):
+        return _HeaderInjector(inner, self._headers)
+
+
+class _HeaderInjector(_Wrapper):
+    def __init__(self, inner, headers: dict[str, str]):
+        super().__init__(inner)
+        self._headers = headers
+
+    def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+        headers = dict(kw.pop("headers", None) or {})
+        for k, v in self._headers.items():
+            headers.setdefault(k, v)
+        return self._inner.request(method, path, headers=headers, **kw)
+
+
+class OAuth2ClientCredentials:
+    """Client-credentials flow: fetches and caches a bearer token
+    (gofr `oauth.go:14-40`)."""
+
+    def __init__(self, token_url: str, client_id: str, client_secret: str, scopes: list[str] | None = None):
+        self.token_url = token_url
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.scopes = scopes or []
+        self._token: str | None = None
+        self._expiry = 0.0
+        self._lock = threading.Lock()
+
+    def _fetch(self) -> str:
+        with self._lock:
+            if self._token and time.time() < self._expiry - 30:
+                return self._token
+            resp = httpx.post(self.token_url, data={
+                "grant_type": "client_credentials",
+                "client_id": self.client_id,
+                "client_secret": self.client_secret,
+                "scope": " ".join(self.scopes),
+            }, timeout=10.0)
+            data = resp.json()
+            self._token = data["access_token"]
+            self._expiry = time.time() + float(data.get("expires_in", 3600))
+            return self._token
+
+    def add_option(self, inner):
+        opt = self
+
+        class _OAuth(_Wrapper):
+            def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+                headers = dict(kw.pop("headers", None) or {})
+                headers.setdefault("Authorization", f"Bearer {opt._fetch()}")
+                return self._inner.request(method, path, headers=headers, **kw)
+
+        return _OAuth(inner)
+
+
+def new_http_service(base_url: str, logger=None, metrics=None, *options: Any,
+                     tracer=None, timeout: float = 30.0):
+    """Build the decorated client: options fold outermost-last (gofr `new.go:68-87`)."""
+    client: Any = HTTPService(base_url, logger, metrics, tracer=tracer, timeout=timeout)
+    for option in options:
+        client = option.add_option(client)
+    return client

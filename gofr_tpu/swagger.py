@@ -1,0 +1,64 @@
+"""OpenAPI serving (gofr `pkg/gofr/swagger.go`).
+
+Serves ``./static/openapi.json`` at ``/.well-known/openapi.json`` when present;
+otherwise generates a minimal spec from the registered routes. ``/.well-known/
+swagger`` serves a self-contained Swagger-UI page loading assets from a CDN
+(the reference embeds the bundle; a CDN reference keeps the repo lean).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from aiohttp import web
+
+_SWAGGER_HTML = """<!DOCTYPE html>
+<html>
+<head>
+  <title>{title} — API docs</title>
+  <link rel="stylesheet" href="https://unpkg.com/swagger-ui-dist@5/swagger-ui.css">
+</head>
+<body>
+  <div id="swagger-ui"></div>
+  <script src="https://unpkg.com/swagger-ui-dist@5/swagger-ui-bundle.js"></script>
+  <script>
+    SwaggerUIBundle({{url: "/.well-known/openapi.json", dom_id: "#swagger-ui"}});
+  </script>
+</body>
+</html>"""
+
+
+def generate_spec(app) -> dict:
+    paths: dict[str, dict] = {}
+    for method, path, handler in app._routes:
+        openapi_path = path  # aiohttp {param} syntax == OpenAPI syntax
+        entry = paths.setdefault(openapi_path, {})
+        entry[method.lower()] = {
+            "summary": (handler.__doc__ or "").strip().split("\n")[0] or handler.__name__,
+            "responses": {"200": {"description": "JSON envelope {\"data\": ...}"}},
+        }
+    return {
+        "openapi": "3.0.0",
+        "info": {"title": app.container.app_name, "version": app.container.app_version},
+        "paths": paths,
+    }
+
+
+def openapi_handler(app):
+    async def handler(_request: web.Request) -> web.Response:
+        path = os.path.join("static", "openapi.json")
+        if os.path.isfile(path):
+            with open(path, "rb") as f:
+                return web.Response(body=f.read(), content_type="application/json")
+        return web.json_response(generate_spec(app))
+
+    return handler
+
+
+def swagger_ui_handler(app):
+    async def handler(_request: web.Request) -> web.Response:
+        html = _SWAGGER_HTML.format(title=app.container.app_name)
+        return web.Response(text=html, content_type="text/html")
+
+    return handler

@@ -1,0 +1,3 @@
+"""Framework version stamped into logs/metrics/traces (gofr `pkg/gofr/version/version.go:3`)."""
+
+FRAMEWORK = "0.1.0"

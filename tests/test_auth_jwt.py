@@ -1,0 +1,125 @@
+"""JWT verification + JWKS tests (real RSA keys via cryptography)."""
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from gofr_tpu.http.middleware.auth import JWKSCache, verify_jwt
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+@pytest.fixture(scope="module")
+def rsa_key():
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def make_rs256(private_key, claims: dict, kid: str = "k1") -> str:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    header = _b64url(json.dumps({"alg": "RS256", "typ": "JWT", "kid": kid}).encode())
+    payload = _b64url(json.dumps(claims).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = private_key.sign(signing_input, padding.PKCS1v15(), hashes.SHA256())
+    return f"{header}.{payload}.{_b64url(sig)}"
+
+
+def make_hs256(secret: bytes, claims: dict) -> str:
+    import hashlib
+    import hmac
+
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64url(json.dumps(claims).encode())
+    sig = hmac.new(secret, f"{header}.{payload}".encode(), hashlib.sha256).digest()
+    return f"{header}.{payload}.{_b64url(sig)}"
+
+
+@pytest.fixture
+def jwks_server(rsa_key):
+    pub = rsa_key.public_key().public_numbers()
+
+    def int_b64(n: int) -> str:
+        return _b64url(n.to_bytes((n.bit_length() + 7) // 8, "big"))
+
+    jwks = {"keys": [{"kty": "RSA", "kid": "k1", "n": int_b64(pub.n), "e": int_b64(pub.e)}]}
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps(jwks).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/jwks"
+    srv.shutdown()
+
+
+def test_rs256_via_jwks(rsa_key, jwks_server):
+    cache = JWKSCache(jwks_server)
+    cache.refresh()
+    token = make_rs256(rsa_key, {"sub": "alice", "exp": time.time() + 300})
+    claims = verify_jwt(token, jwks=cache)
+    assert claims["sub"] == "alice"
+
+
+def test_rs256_bad_signature_rejected(rsa_key, jwks_server):
+    cache = JWKSCache(jwks_server)
+    cache.refresh()
+    token = make_rs256(rsa_key, {"sub": "alice"})
+    tampered = token[:-6] + "aaaaaa"
+    with pytest.raises(ValueError):
+        verify_jwt(tampered, jwks=cache)
+
+
+def test_expired_token_rejected(rsa_key, jwks_server):
+    cache = JWKSCache(jwks_server)
+    cache.refresh()
+    token = make_rs256(rsa_key, {"sub": "a", "exp": time.time() - 600})
+    with pytest.raises(ValueError, match="expired"):
+        verify_jwt(token, jwks=cache)
+
+
+def test_audience_issuer_checks(rsa_key, jwks_server):
+    cache = JWKSCache(jwks_server)
+    cache.refresh()
+    token = make_rs256(rsa_key, {"sub": "a", "aud": "api", "iss": "me"})
+    assert verify_jwt(token, jwks=cache, audience="api", issuer="me")["iss"] == "me"
+    with pytest.raises(ValueError, match="audience"):
+        verify_jwt(token, jwks=cache, audience="other")
+    with pytest.raises(ValueError, match="issuer"):
+        verify_jwt(token, jwks=cache, issuer="them")
+
+
+def test_hs256_roundtrip():
+    token = make_hs256(b"secret", {"sub": "svc"})
+    assert verify_jwt(token, hs_secret=b"secret")["sub"] == "svc"
+    with pytest.raises(ValueError):
+        verify_jwt(token, hs_secret=b"wrong")
+
+
+def test_malformed_tokens_rejected():
+    for bad in ("", "a.b", "a.b.c.d", "!!!.@@@.###"):
+        with pytest.raises(ValueError):
+            verify_jwt(bad, hs_secret=b"s")
+
+
+def test_unknown_alg_rejected():
+    header = _b64url(json.dumps({"alg": "none"}).encode())
+    payload = _b64url(json.dumps({"sub": "x"}).encode())
+    with pytest.raises(ValueError, match="unsupported alg"):
+        verify_jwt(f"{header}.{payload}.", hs_secret=b"s")

@@ -1,0 +1,126 @@
+import io
+import time
+
+import pytest
+
+from gofr_tpu.cli import CmdApp, CmdRequest
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.cron import CronParseError, Crontab, Schedule
+
+
+# -- cron parser (gofr cron.go:86-224 semantics) -------------------------------
+
+
+def test_schedule_parse_star():
+    s = Schedule.parse("* * * * *")
+    assert len(s.minutes) == 60 and len(s.hours) == 24
+
+
+def test_schedule_parse_step_range_list():
+    s = Schedule.parse("*/15 1-5 1,15 */3 0-6/2")
+    assert s.minutes == frozenset({0, 15, 30, 45})
+    assert s.hours == frozenset({1, 2, 3, 4, 5})
+    assert s.days == frozenset({1, 15})
+    assert s.months == frozenset({1, 4, 7, 10})
+    assert s.weekdays == frozenset({0, 2, 4, 6})
+
+
+@pytest.mark.parametrize("bad", ["* * * *", "60 * * * *", "* 24 * * *", "x * * * *",
+                                 "*/0 * * * *", "5-1 * * * *", "* * 0 * *"])
+def test_schedule_parse_rejects(bad):
+    with pytest.raises(CronParseError):
+        Schedule.parse(bad)
+
+
+def test_schedule_matches():
+    s = Schedule.parse("30 14 * * *")
+    t = time.struct_time((2026, 7, 29, 14, 30, 0, 2, 210, -1))
+    assert s.matches(t)
+    t2 = time.struct_time((2026, 7, 29, 14, 31, 0, 2, 210, -1))
+    assert not s.matches(t2)
+
+
+def test_crontab_fires_matching_jobs():
+    c = new_mock_container()
+    cron = Crontab(c)
+    fired = []
+    cron.add_job("* * * * *", "always", lambda ctx: fired.append("always"))
+    cron.add_job("59 23 31 12 *", "never-today", lambda ctx: fired.append("nope"))
+    names = cron.tick(time.mktime((2026, 7, 29, 10, 0, 0, 0, 0, -1)))
+    assert names == ["always"]
+    # same minute → no double fire
+    assert cron.tick(time.mktime((2026, 7, 29, 10, 0, 30, 0, 0, -1))) == []
+    time.sleep(0.1)
+    assert fired == ["always"]
+
+
+def test_cron_job_failure_recovered():
+    c = new_mock_container()
+    cron = Crontab(c)
+
+    def bad(ctx):
+        raise RuntimeError("cron boom")
+
+    cron.add_job("* * * * *", "bad", bad)
+    cron.tick(time.time())
+    time.sleep(0.2)
+    assert any("cron job bad failed" in r.get("message", "") for r in c.logger.records)
+
+
+# -- CLI runtime ---------------------------------------------------------------
+
+
+def test_cmd_request_flag_parsing():
+    r = CmdRequest(["migrate", "-v", "--env=prod", "-n", "5", "extra"])
+    assert r.subcommand == "migrate"
+    assert r.param("v") == "true"
+    assert r.param("env") == "prod"
+    assert r.param("n") == "5"
+    assert r.positional == ["extra"]
+
+
+def test_cmd_app_routes_and_output():
+    app = CmdApp(new_mock_container())
+    app.sub_command("hello", lambda ctx: f"hi {ctx.param('name')}", description="greets")
+    out, err = io.StringIO(), io.StringIO()
+    code = app.run(["hello", "--name=x"], out=out, err=err)
+    assert code == 0
+    assert out.getvalue().strip() == "hi x"
+
+
+def test_cmd_app_unknown_subcommand():
+    app = CmdApp(new_mock_container())
+    app.sub_command("known", lambda ctx: "ok")
+    out, err = io.StringIO(), io.StringIO()
+    code = app.run(["nope"], out=out, err=err)
+    assert code == 1
+    assert "unknown subcommand" in err.getvalue()
+    assert "known" in err.getvalue()  # help listed
+
+
+def test_cmd_app_help():
+    app = CmdApp(new_mock_container())
+    app.sub_command("job", lambda ctx: "ok", description="runs the job")
+    out = io.StringIO()
+    assert app.run(["-h"], out=out) == 0
+    assert "runs the job" in out.getvalue()
+
+
+def test_cmd_app_error_exit_code():
+    app = CmdApp(new_mock_container())
+
+    def failing(ctx):
+        raise ValueError("bad input")
+
+    app.sub_command("fail", failing)
+    out, err = io.StringIO(), io.StringIO()
+    assert app.run(["fail"], out=out, err=err) == 1
+    assert "bad input" in err.getvalue()
+
+
+def test_cmd_regex_route():
+    app = CmdApp(new_mock_container())
+    app.sub_command("run-[0-9]+", lambda ctx: ctx.path_param("subcommand"))
+    out = io.StringIO()
+    app.run(["run-42"], out=out)
+    assert out.getvalue().strip() == "run-42"

@@ -1,0 +1,167 @@
+from dataclasses import dataclass
+
+import pytest
+
+from gofr_tpu.config import DictConfig
+from gofr_tpu.container import Container, new_mock_container
+from gofr_tpu.datasource import DatasourceError
+from gofr_tpu.datasource.file import LocalFileSystem
+from gofr_tpu.datasource.kv import KVStore
+from gofr_tpu.datasource.sql import connect_sql, insert_query, update_query
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.metrics import Registry
+from gofr_tpu.migration import Migration, run_migrations
+from gofr_tpu.pubsub.inmemory import InMemoryBroker
+
+
+def make_db():
+    reg = Registry()
+    reg.new_histogram("app_sql_stats")
+    return connect_sql(DictConfig({"DB_DIALECT": "sqlite"}), MockLogger(), reg), reg
+
+
+def test_sql_query_exec_and_metrics():
+    db, reg = make_db()
+    db.execute("CREATE TABLE t (id INTEGER PRIMARY KEY, name TEXT)")
+    db.execute("INSERT INTO t (id, name) VALUES (?, ?)", (1, "a"))
+    rows = db.query("SELECT * FROM t")
+    assert rows[0].name == "a"
+    assert reg.get("app_sql_stats").count(type="exec") == 2
+    assert reg.get("app_sql_stats").count(type="query") == 1
+    assert db.health_check()["status"] == "UP"
+
+
+def test_sql_select_into_dataclass():
+    db, _ = make_db()
+    db.execute("CREATE TABLE u (id INTEGER, name TEXT, extra TEXT)")
+    db.execute("INSERT INTO u VALUES (1, 'x', 'ignored')")
+
+    @dataclass
+    class U:
+        id: int
+        name: str
+
+    users = db.select_into(U, "SELECT * FROM u")
+    assert users == [U(1, "x")]
+
+
+def test_sql_transaction_rollback():
+    db, _ = make_db()
+    db.execute("CREATE TABLE t (id INTEGER PRIMARY KEY)")
+    with pytest.raises(RuntimeError):
+        with db.begin() as tx:
+            tx.execute("INSERT INTO t VALUES (1)")
+            raise RuntimeError("abort")
+    assert db.query("SELECT COUNT(*) AS n FROM t")[0].n == 0
+    with db.begin() as tx:
+        tx.execute("INSERT INTO t VALUES (2)")
+    assert db.query("SELECT COUNT(*) AS n FROM t")[0].n == 1
+
+
+def test_sql_error_wrapped():
+    db, _ = make_db()
+    with pytest.raises(DatasourceError):
+        db.query("SELECT * FROM missing_table")
+
+
+def test_query_builder_quoting():
+    assert insert_query("users", ["id", "name"], "sqlite") == 'INSERT INTO "users" ("id", "name") VALUES (?, ?)'
+    assert insert_query("users", ["id"], "mysql") == "INSERT INTO `users` (`id`) VALUES (?)"
+    # injection attempt is stripped from identifiers
+    assert '"userssemicolon"' not in update_query('users;drop', ["a"], "id", "sqlite")
+    assert "drop" in update_query("usersdrop", ["a"], "id", "sqlite")  # sanity
+
+
+def test_migrations_apply_once_and_rollback():
+    c = new_mock_container()
+    c.sql, _ = make_db()
+    ran = []
+    migrations = {
+        1: Migration(up=lambda d: (d.sql.execute("CREATE TABLE m1 (x INTEGER)"), ran.append(1))),
+        2: Migration(up=lambda d: ran.append(2)),
+    }
+    assert run_migrations(migrations, c) == [1, 2]
+    # idempotent second run
+    assert run_migrations(migrations, c) == []
+    assert ran == [1, 2]
+
+    def bad(d):
+        d.sql.execute("INSERT INTO m1 VALUES (9)")
+        raise RuntimeError("migration fails")
+
+    with pytest.raises(RuntimeError):
+        run_migrations({3: Migration(up=bad)}, c)
+    # rolled back: the insert from the failed migration is gone
+    assert c.sql.query("SELECT COUNT(*) AS n FROM m1")[0].n == 0
+    # version 3 not recorded
+    assert c.sql.query_row("SELECT MAX(version) AS v FROM gofr_migrations")["v"] == 2
+
+
+def test_kv_store_roundtrip(tmp_path):
+    kv = KVStore(str(tmp_path / "kv.db"))
+    kv.set("a", b"1")
+    kv.set("a", "2")
+    assert kv.get("a") == b"2"
+    assert kv.get("missing") is None
+    kv.delete("a")
+    assert kv.get("a") is None
+    assert kv.health_check()["status"] == "UP"
+
+
+def test_file_datasource_row_readers(tmp_path):
+    fs = LocalFileSystem(str(tmp_path))
+    fs.create("data.json", b'[{"a": 1}, {"a": 2}]')
+    assert list(fs.read_rows("data.json")) == [{"a": 1}, {"a": 2}]
+    fs.create("data.csv", b"x,y\n1,2\n3,4\n")
+    assert list(fs.read_rows("data.csv")) == [{"x": "1", "y": "2"}, {"x": "3", "y": "4"}]
+    fs.create("data.jsonl", b'{"b": 1}\n{"b": 2}\n')
+    assert list(fs.read_rows("data.jsonl")) == [{"b": 1}, {"b": 2}]
+    fs.create("plain.txt", b"l1\nl2\n")
+    assert list(fs.read_rows("plain.txt")) == ["l1", "l2"]
+    fs.mkdir_all("sub/dir")
+    assert fs.exists("sub/dir")
+    fs.rename("plain.txt", "renamed.txt")
+    assert fs.exists("renamed.txt") and not fs.exists("plain.txt")
+
+
+def test_inmemory_broker_at_least_once():
+    b = InMemoryBroker()
+    b.publish("t", {"n": 1})
+    b.publish("t", {"n": 2})
+    m1 = b.subscribe("t", "g", timeout=0.1)
+    assert m1.bind(dict) == {"n": 1}
+    # not committed → rewind redelivers
+    b.rewind_uncommitted("t", "g")
+    m1b = b.subscribe("t", "g", timeout=0.1)
+    assert m1b.bind(dict) == {"n": 1}
+    m1b.commit()
+    m2 = b.subscribe("t", "g", timeout=0.1)
+    assert m2.bind(dict) == {"n": 2}
+    # different group sees everything from the start
+    mg2 = b.subscribe("t", "other", timeout=0.1)
+    assert mg2.bind(dict) == {"n": 1}
+    # empty → timeout returns None
+    assert b.subscribe("empty", "g", timeout=0.05) is None
+
+
+def test_container_health_aggregation():
+    c = new_mock_container()
+    c.sql, _ = make_db()
+
+    class DownDS:
+        def health_check(self):
+            return {"status": "DOWN", "details": {}}
+
+    c.redis = DownDS()
+    h = c.health()
+    assert h["status"] == "DEGRADED"
+    assert h["services"]["sql"]["status"] == "UP"
+    assert h["services"]["redis"]["status"] == "DOWN"
+
+
+def test_container_config_gating():
+    c = Container.create(DictConfig({}))
+    assert c.sql is None and c.redis is None and c.pubsub is None and c.kv is None
+    assert c.file is not None  # always wired (container.go:123)
+    c2 = Container.create(DictConfig({"DB_DIALECT": "sqlite"}))
+    assert c2.sql is not None

@@ -1,0 +1,273 @@
+"""End-to-end HTTP tests: real aiohttp server on an ephemeral port, real client
+requests (the analog of the reference's example integration tests, SURVEY.md §4)."""
+
+import asyncio
+import json
+import threading
+import time
+from dataclasses import dataclass
+
+import httpx
+import pytest
+
+import gofr_tpu.app as appmod
+from gofr_tpu.config import DictConfig
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.http.errors import EntityNotFound
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class AppHarness:
+    """Runs an App's asyncio loop on a background thread."""
+
+    def __init__(self, app):
+        self.app = app
+        self._thread = None
+        self._loop = None
+
+    def __enter__(self):
+        started = threading.Event()
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            ready = asyncio.Event()
+
+            async def main():
+                task = asyncio.ensure_future(self.app.arun(ready=ready))
+                await ready.wait()
+                started.set()
+                await task
+
+            try:
+                self._loop.run_until_complete(main())
+            finally:
+                self._loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        assert started.wait(timeout=10), "app failed to start"
+        return self
+
+    def __exit__(self, *exc):
+        self._loop.call_soon_threadsafe(self.app.stop)
+        self._thread.join(timeout=10)
+
+    @property
+    def base(self) -> str:
+        return f"http://127.0.0.1:{self.app.http_port}"
+
+
+def make_app(extra_config=None, **kw):
+    config = {
+        "HTTP_PORT": str(_free_port()),
+        "METRICS_PORT": str(_free_port()),
+        **(extra_config or {}),
+    }
+    app = appmod.App(config=DictConfig(config), container=new_mock_container(config))
+    return app
+
+
+def test_end_to_end_routes_and_envelope():
+    app = make_app()
+
+    def greet(ctx):
+        return f"Hello {ctx.param('name') or 'World'}!"
+
+    def create_thing(ctx):
+        body = ctx.bind(dict)
+        return {"received": body}
+
+    def boom(ctx):
+        raise EntityNotFound("id", ctx.path_param("id"))
+
+    def crash(ctx):
+        raise RuntimeError("secret internals")
+
+    app.get("/greet", greet)
+    app.post("/things", create_thing)
+    app.get("/things/{id}", boom)
+    app.get("/crash", crash)
+
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as client:
+        r = client.get("/greet", params={"name": "gofr"})
+        assert r.status_code == 200
+        assert r.json() == {"data": "Hello gofr!"}
+        assert "X-Correlation-ID" in r.headers
+
+        r = client.post("/things", json={"a": 1})
+        assert r.status_code == 201  # POST → 201
+        assert r.json() == {"data": {"received": {"a": 1}}}
+
+        r = client.get("/things/42")
+        assert r.status_code == 404
+        assert r.json() == {"error": {"message": "No entity found with id: 42"}}
+
+        r = client.get("/crash")
+        assert r.status_code == 500
+        assert "secret internals" not in r.text  # no leak
+
+        r = client.get("/no/such/route")
+        assert r.status_code == 404
+        assert r.json() == {"error": {"message": "route not registered"}}
+
+        r = client.get("/.well-known/health")
+        assert r.status_code == 200
+        body = r.json()["data"]
+        assert body["status"] == "UP"
+
+        r = client.get("/.well-known/alive")
+        assert r.json() == {"data": {"status": "UP"}}
+
+        # metrics on the separate port
+        m = httpx.get(f"http://127.0.0.1:{app.metrics_port}/metrics")
+        assert m.status_code == 200
+        assert "app_http_response" in m.text
+        assert 'path="/greet"' in m.text
+
+
+def test_request_timeout_yields_408():
+    app = make_app({"REQUEST_TIMEOUT": "0.3"})
+
+    def slow(ctx):
+        time.sleep(2)
+        return "late"
+
+    app.get("/slow", slow)
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as client:
+        r = client.get("/slow", timeout=5)
+        assert r.status_code == 408
+        assert r.json()["error"]["message"] == "request timed out"
+
+
+def test_bind_dataclass_and_async_handler():
+    app = make_app()
+
+    @dataclass
+    class Order:
+        id: int
+        item: str
+        qty: int = 1
+
+    def create(ctx):
+        order = ctx.bind(Order)
+        return {"id": order.id, "item": order.item, "qty": order.qty}
+
+    async def async_route(ctx):
+        await asyncio.sleep(0.01)
+        return "async-ok"
+
+    app.post("/orders", create)
+    app.get("/async", async_route)
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as client:
+        r = client.post("/orders", json={"id": "7", "item": "tpu", "qty": 3})
+        assert r.status_code == 201
+        assert r.json()["data"] == {"id": 7, "item": "tpu", "qty": 3}
+
+        r = client.post("/orders", json={"item": "x"})
+        assert r.status_code == 400  # missing required field
+
+        r = client.get("/async")
+        assert r.json()["data"] == "async-ok"
+
+
+def test_basic_auth_and_apikey():
+    app = make_app()
+    app.enable_basic_auth({"admin": "secret"})
+    app.get("/private", lambda ctx: f"hi {ctx.auth_user}")
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as client:
+        assert client.get("/private").status_code == 401
+        r = client.get("/private", auth=("admin", "secret"))
+        assert r.status_code == 200
+        assert r.json()["data"] == "hi admin"
+        assert client.get("/private", auth=("admin", "wrong")).status_code == 401
+        # well-known endpoints skip auth
+        assert client.get("/.well-known/alive").status_code == 200
+
+
+def test_cors_preflight():
+    app = make_app()
+    app.get("/x", lambda ctx: "x")
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as client:
+        r = client.options("/x")
+        assert r.status_code == 200
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+        assert "GET" in r.headers["Access-Control-Allow-Methods"]
+
+
+def test_crud_generator_end_to_end():
+    @dataclass
+    class Book:
+        isbn: int
+        title: str = ""
+
+    app = make_app()
+    # wire a real sqlite datasource into the mock container
+    from gofr_tpu.datasource.sql import connect_sql
+
+    app.container.sql = connect_sql(DictConfig({"DB_DIALECT": "sqlite"}), app.logger, app.container.metrics)
+    app.add_rest_handlers(Book)
+
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as client:
+        r = client.post("/book", json={"isbn": 1, "title": "JAX"})
+        assert r.status_code == 201, r.text
+        r = client.get("/book/1")
+        assert r.json()["data"] == {"isbn": 1, "title": "JAX"}
+        r = client.put("/book/1", json={"isbn": 1, "title": "Pallas"})
+        assert r.status_code == 200
+        r = client.get("/book")
+        assert r.json()["data"] == [{"isbn": 1, "title": "Pallas"}]
+        r = client.delete("/book/1")
+        assert r.status_code == 204
+        assert client.get("/book/1").status_code == 404
+
+
+def test_websocket_roundtrip():
+    app = make_app()
+
+    def ws_handler(ctx):
+        data = ctx.bind(dict)
+        return {"echo": data.get("msg", "")}
+
+    app.websocket("/ws", ws_handler)
+
+    with AppHarness(app) as h:
+        async def talk():
+            import aiohttp
+
+            async with aiohttp.ClientSession() as session:
+                async with session.ws_connect(f"{h.base}/ws") as ws:
+                    await ws.send_str(json.dumps({"msg": "ping"}))
+                    reply = await ws.receive_json(timeout=5)
+                    return reply
+
+        reply = asyncio.run(talk())
+        assert reply == {"echo": "ping"}
+
+
+def test_pubsub_subscribe_commit_flow():
+    app = make_app()
+    received = []
+    done = threading.Event()
+
+    def on_msg(ctx):
+        received.append(ctx.bind(dict))
+        done.set()
+
+    app.subscribe("orders", on_msg)
+    with AppHarness(app):
+        app.container.publish("orders", {"id": 1})
+        assert done.wait(timeout=5)
+    assert received == [{"id": 1}]
+    # committed offset advanced (at-least-once: commit happened after success)
+    broker = app.container.pubsub
+    assert broker._offsets[("orders", app.container.app_name)] == 1

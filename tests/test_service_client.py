@@ -1,0 +1,129 @@
+"""Inter-service client tests against a real local HTTP server."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.metrics import Registry
+from gofr_tpu.service import (
+    APIKeyOption,
+    BasicAuthOption,
+    CircuitBreaker,
+    DefaultHeaders,
+    Retry,
+    ServiceError,
+    new_http_service,
+)
+
+
+class Backend(BaseHTTPRequestHandler):
+    fail_times = 0
+    requests: list = []
+
+    def do_GET(self):
+        Backend.requests.append((self.path, dict(self.headers)))
+        if self.path == "/.well-known/alive":
+            self._json(200, {"data": {"status": "UP"}})
+            return
+        if Backend.fail_times > 0:
+            Backend.fail_times -= 1
+            self._json(500, {"error": {"message": "boom"}})
+            return
+        self._json(200, {"data": "ok"})
+
+    def _json(self, status, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def backend():
+    Backend.fail_times = 0
+    Backend.requests = []
+    srv = HTTPServer(("127.0.0.1", 0), Backend)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_base_client_get_logs_and_metrics(backend):
+    log, reg = MockLogger(), Registry()
+    reg.new_histogram("app_http_service_response")
+    client = new_http_service(backend, log, reg)
+    resp = client.get("/data")
+    assert resp.ok and resp.json() == {"data": "ok"}
+    assert reg.get("app_http_service_response").count(service=backend, method="GET", status="200") == 1
+    assert any(r.get("message") == "http service call" for r in log.records)
+
+
+def test_retry_recovers_from_5xx(backend):
+    Backend.fail_times = 2
+    client = new_http_service(backend, None, None, Retry(max_retries=3, backoff=0.01))
+    resp = client.get("/flaky")
+    assert resp.status_code == 200
+
+
+def test_retry_exhausted_raises(backend):
+    Backend.fail_times = 10
+    client = new_http_service(backend, None, None, Retry(max_retries=1, backoff=0.01))
+    with pytest.raises(ServiceError):
+        client.get("/flaky")
+
+
+def test_circuit_breaker_opens_and_recovers(backend):
+    Backend.fail_times = 3
+    client = new_http_service(backend, None, None, CircuitBreaker(threshold=3, interval=0.1))
+    for _ in range(3):
+        r = client.get("/flaky")
+        assert r.status_code == 500
+    # breaker now open: requests rejected without hitting the backend
+    n = len(Backend.requests)
+    with pytest.raises(ServiceError, match="circuit breaker is open"):
+        client.get("/flaky")
+    assert len([r for r in Backend.requests[n:] if not r[0].startswith("/.well-known")]) == 0
+    # health probe recovers it (backend is healthy again)
+    import time
+
+    deadline = time.time() + 3
+    while client.is_open and time.time() < deadline:
+        time.sleep(0.05)
+    assert not client.is_open
+    assert client.get("/data").status_code == 200
+
+
+def test_auth_and_header_options_compose(backend):
+    client = new_http_service(
+        backend, None, None,
+        BasicAuthOption("u", "p"), APIKeyOption("k123"), DefaultHeaders(X_Env="prod"),
+    )
+    client.get("/who")
+    path, headers = Backend.requests[-1]
+    assert headers["Authorization"].startswith("Basic ")
+    assert headers["X-API-KEY"] == "k123"
+    assert headers["X-Env"] == "prod"
+
+
+def test_traceparent_propagation(backend):
+    from gofr_tpu.tracing import MemoryExporter, Tracer
+
+    tracer = Tracer(MemoryExporter())
+    client = new_http_service(backend, None, None)
+    with tracer.span("parent") as span:
+        client.get("/traced")
+    _, headers = Backend.requests[-1]
+    assert headers["traceparent"].split("-")[1] == span.trace_id
+
+
+def test_health_check(backend):
+    client = new_http_service(backend, None, None)
+    assert client.health_check()["status"] == "UP"
