@@ -63,6 +63,10 @@ class Schedule:
     days: frozenset[int]
     months: frozenset[int]
     weekdays: frozenset[int]
+    # Vixie-cron day rule: when BOTH day-of-month and day-of-week are
+    # restricted (field doesn't start with "*"), a day matching EITHER fires.
+    days_restricted: bool = True
+    weekdays_restricted: bool = True
 
     @classmethod
     def parse(cls, spec: str) -> "Schedule":
@@ -73,17 +77,27 @@ class Schedule:
             _parse_field(f, lo, hi, name)
             for f, (lo, hi), name in zip(fields, FIELD_RANGES, FIELD_NAMES)
         ]
-        return cls(*parsed)
+        return cls(
+            *parsed,
+            days_restricted=not fields[2].startswith("*"),
+            weekdays_restricted=not fields[4].startswith("*"),
+        )
 
     def matches(self, t: time.struct_time) -> bool:
         # dow: python tm_wday Mon=0..Sun=6; cron uses Sun=0..Sat=6
         cron_dow = (t.tm_wday + 1) % 7
+        dom_ok = t.tm_mday in self.days
+        dow_ok = cron_dow in self.weekdays
+        day_ok = (
+            (dom_ok or dow_ok)
+            if (self.days_restricted and self.weekdays_restricted)
+            else (dom_ok and dow_ok)
+        )
         return (
             t.tm_min in self.minutes
             and t.tm_hour in self.hours
-            and t.tm_mday in self.days
             and t.tm_mon in self.months
-            and cron_dow in self.weekdays
+            and day_ok
         )
 
 

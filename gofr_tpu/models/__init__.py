@@ -1,0 +1,38 @@
+"""Model families (functional JAX modules — see gofr_tpu.models.base).
+
+The reference has no model layer (SURVEY.md §2.9); this package is the new
+capability the TPU build adds: decoder LMs for /generate, encoders for
+embedding and classification endpoints, all shardable via logical axes.
+"""
+
+from gofr_tpu.models import bert, llama, vit
+from gofr_tpu.models.base import (
+    ModelSpec,
+    cast_floats,
+    get_family,
+    param_bytes,
+    param_count,
+    register_family,
+)
+from gofr_tpu.models.llama import LlamaConfig
+from gofr_tpu.models.bert import BertConfig
+from gofr_tpu.models.vit import ViTConfig
+
+register_family("llama", llama)
+register_family("bert", bert)
+register_family("vit", vit)
+
+__all__ = [
+    "ModelSpec",
+    "LlamaConfig",
+    "BertConfig",
+    "ViTConfig",
+    "llama",
+    "bert",
+    "vit",
+    "cast_floats",
+    "get_family",
+    "param_bytes",
+    "param_count",
+    "register_family",
+]

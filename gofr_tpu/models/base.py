@@ -1,0 +1,87 @@
+"""Model layer foundations.
+
+Models in gofr_tpu are *functional modules*: a frozen config dataclass plus
+pure functions ``init(cfg, key) → params``, ``param_axes(cfg) → logical
+axes pytree``, and jittable ``forward_*`` functions. No module classes, no
+framework state — params are plain pytrees the parallel layer can shard by
+logical axes (gofr_tpu.parallel.sharding) and orbax can checkpoint.
+
+Layer parameters are *stacked*: every per-layer weight carries a leading
+``layers`` dimension and the forward pass runs ``lax.scan`` over it — one
+traced block regardless of depth, which keeps XLA compile time flat and
+maps cleanly onto pipeline stages later.
+
+``ModelSpec`` is what users hand to ``app.serve_model`` — the serving-side
+description (family, config, weights source, task) that ``build_engine``
+turns into a running engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def truncated_normal(key, shape, stddev: float, dtype=jnp.float32):
+    return jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32).astype(dtype) * stddev
+
+
+def fan_in_init(key, shape, fan_in: int | None = None, dtype=jnp.float32):
+    fan = fan_in if fan_in is not None else shape[-2] if len(shape) >= 2 else shape[-1]
+    return truncated_normal(key, shape, 1.0 / math.sqrt(fan), dtype)
+
+
+def param_count(params: Any) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))
+
+
+def param_bytes(params: Any) -> int:
+    return sum(int(x.size) * x.dtype.itemsize for x in jax.tree.leaves(params))
+
+
+def cast_floats(params: Any, dtype) -> Any:
+    """Cast floating-point leaves (weights) to ``dtype``; leave ints alone."""
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, params
+    )
+
+
+@dataclass
+class ModelSpec:
+    """What ``app.serve_model`` consumes.
+
+    family: "llama" | "bert" | "vit" (extensible via ``models.register``)
+    config: the family's config dataclass (or dict of overrides)
+    task: "generate" | "embed" | "classify" — selects the engine path
+    weights: None (random init), a checkpoint path (orbax), or an HF model
+             id/path to convert (gofr_tpu.models.convert)
+    tokenizer: HF tokenizer id/path for text models (optional — the engine
+             also accepts pre-tokenized int arrays)
+    """
+
+    family: str
+    config: Any = None
+    task: str = "generate"
+    weights: str | None = None
+    tokenizer: str | None = None
+    dtype: Any = jnp.bfloat16
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+_FAMILIES: dict[str, Any] = {}
+
+
+def register_family(name: str, module: Any) -> None:
+    _FAMILIES[name] = module
+
+
+def get_family(name: str):
+    try:
+        return _FAMILIES[name]
+    except KeyError:
+        raise KeyError(f"unknown model family {name!r}; registered: {sorted(_FAMILIES)}") from None

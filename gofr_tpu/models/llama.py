@@ -1,0 +1,269 @@
+"""Llama-family decoder-only LM (the framework's flagship model).
+
+Covers Llama-2/3 shapes: RMSNorm, RoPE, grouped-query attention, SwiGLU
+MLP, optional tied embeddings. Pure-functional, stacked-layer params
+scanned with ``lax.scan`` (see gofr_tpu.models.base docstring).
+
+Three jittable entry points:
+- ``forward``          full causal pass, no cache (training / scoring)
+- ``prefill``          writes prompt K/V into SlotKVCache slots, returns
+                       last-position logits
+- ``decode_step``      one token per active slot, appends K/V in place
+
+TP sharding is expressed through logical axes (``param_axes``): heads /
+kv_heads / mlp / vocab shard over "tp", giving the standard Megatron-style
+column→row parallel layout per block — XLA inserts the psum on wo/w_down
+(reference capability map: SURVEY.md §2.9 — this subsystem is new, the
+reference has no model layer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gofr_tpu.models.base import fan_in_init, truncated_normal
+from gofr_tpu.ops import apply_rope, mha_attention, rms_norm, rope_table
+from gofr_tpu.ops.attention import decode_attention
+from gofr_tpu.ops.kvcache import SlotKVCache
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int | None = None
+    rope_theta: float = 500000.0
+    max_seq_len: int = 8192
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_size(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    # -- presets ---------------------------------------------------------------
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(**{**dict(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+        ), **kw})
+
+    @classmethod
+    def llama3_70b(cls, **kw) -> "LlamaConfig":
+        return cls(**{**dict(
+            vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+            num_layers=80, num_heads=64, num_kv_heads=8, rope_theta=500000.0,
+        ), **kw})
+
+    @classmethod
+    def one_b(cls, **kw) -> "LlamaConfig":
+        """~1B-param config that fits one v5e chip in bf16 with headroom."""
+        return cls(**{**dict(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=22, num_heads=32, num_kv_heads=4, rope_theta=10000.0,
+        ), **kw})
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test-sized config for the CPU mesh."""
+        return cls(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+            rope_theta=10000.0, dtype=jnp.float32,
+        ), **kw})
+
+
+# -- params --------------------------------------------------------------------
+
+
+def init(cfg: LlamaConfig, key: jax.Array) -> dict:
+    e, m, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    hq, hkv, d, nl = cfg.num_heads, cfg.num_kv_heads, cfg.head_size, cfg.num_layers
+    keys = jax.random.split(key, 9)
+    dt = cfg.dtype
+
+    params = {
+        "embed": truncated_normal(keys[0], (v, e), 0.02, dt),
+        "blocks": {
+            "attn_norm": jnp.ones((nl, e), dt),
+            "wq": fan_in_init(keys[1], (nl, e, hq * d), fan_in=e, dtype=dt),
+            "wk": fan_in_init(keys[2], (nl, e, hkv * d), fan_in=e, dtype=dt),
+            "wv": fan_in_init(keys[3], (nl, e, hkv * d), fan_in=e, dtype=dt),
+            "wo": fan_in_init(keys[4], (nl, hq * d, e), fan_in=hq * d, dtype=dt),
+            "mlp_norm": jnp.ones((nl, e), dt),
+            "w_gate": fan_in_init(keys[5], (nl, e, m), fan_in=e, dtype=dt),
+            "w_up": fan_in_init(keys[6], (nl, e, m), fan_in=e, dtype=dt),
+            "w_down": fan_in_init(keys[7], (nl, m, e), fan_in=m, dtype=dt),
+        },
+        "final_norm": jnp.ones((e,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = truncated_normal(keys[8], (e, v), 0.02, dt)
+    return params
+
+
+def param_axes(cfg: LlamaConfig) -> dict:
+    """Logical sharding axes matching ``init``'s pytree (see
+    gofr_tpu.parallel.sharding)."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": ("layers", None),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", None),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": (None,),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def _rope(cfg: LlamaConfig):
+    return rope_table(cfg.max_seq_len, cfg.head_size, theta=cfg.rope_theta)
+
+
+# -- block ---------------------------------------------------------------------
+
+
+def _qkv(cfg: LlamaConfig, lp: dict, x: jnp.ndarray):
+    """x [B,S,E] → q [B,S,Hq,D], k/v [B,S,Hkv,D] (post-norm, pre-rope)."""
+    b, s, _ = x.shape
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_size)
+    k = (h @ lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_size)
+    v = (h @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_size)
+    return q, k, v
+
+
+def _mlp(cfg: LlamaConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+    return gated @ lp["w_down"]
+
+
+# -- entry points --------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=0)
+def forward(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
+            lengths: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Full causal forward, no cache: tokens [B,S] → logits [B,S,V] (f32).
+    ``lengths`` masks padded positions out of attention."""
+    cos, sin = _rope(cfg)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    b, s = tokens.shape
+    positions = jnp.arange(s)[None]
+
+    def body(x, lp):
+        q, k, v = _qkv(cfg, lp, x)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
+        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        x = x + _mlp(cfg, lp, x)
+        return x, None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (x @ head).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=4)
+def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray,
+            cache: SlotKVCache, slots: jnp.ndarray) -> tuple[jnp.ndarray, SlotKVCache]:
+    """Prefill prompts into cache slots.
+
+    tokens [B,S] (padded), lengths [B], slots [B] → (last-token logits
+    [B,V] f32, updated cache). Each row b is written into cache slot
+    ``slots[b]`` at offsets 0..S.
+    """
+    cos, sin = _rope(cfg)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    b, s = tokens.shape
+    positions = jnp.arange(s)[None]
+    row = jnp.arange(b)
+
+    def body(x, xs):
+        lp, k_layer, v_layer = xs
+        q, k, v = _qkv(cfg, lp, x)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        # write the prompt K/V into each row's slot: [B,S] scatter
+        k_layer = k_layer.at[slots[:, None], jnp.arange(s)[None, :]].set(k.astype(k_layer.dtype))
+        v_layer = v_layer.at[slots[:, None], jnp.arange(s)[None, :]].set(v.astype(v_layer.dtype))
+        attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
+        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        x = x + _mlp(cfg, lp, x)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = x[row, lengths - 1]  # [B,E]
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (last @ head).astype(jnp.float32)
+    return logits, SlotKVCache(k=new_k, v=new_v)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=4)
+def decode_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, positions: jnp.ndarray,
+                cache: SlotKVCache) -> tuple[jnp.ndarray, SlotKVCache]:
+    """One decode step over every slot.
+
+    tokens [N] (next input token per slot), positions [N] (where it goes in
+    the cache = current sequence length), over the full slot batch
+    N == cache.num_slots. Returns (logits [N,V] f32, updated cache).
+    Inactive slots simply produce garbage logits the engine ignores —
+    uniform work keeps the step a single fixed XLA program.
+    """
+    cos, sin = _rope(cfg)
+    x = params["embed"][tokens].astype(cfg.dtype)  # [N,E]
+    n = tokens.shape[0]
+    row = jnp.arange(n)
+    pos1 = positions[:, None]  # [N,1]
+
+    def body(x, xs):
+        lp, k_layer, v_layer = xs
+        q, k, v = _qkv(cfg, lp, x[:, None])  # seq dim of 1
+        q = apply_rope(q, pos1, cos, sin)[:, 0]  # [N,Hq,D]
+        k = apply_rope(k, pos1, cos, sin)[:, 0]
+        v = v[:, 0]
+        k_layer = k_layer.at[row, positions].set(k.astype(k_layer.dtype))
+        v_layer = v_layer.at[row, positions].set(v.astype(v_layer.dtype))
+        attn = decode_attention(q, k_layer, v_layer, positions + 1)
+        x = x + attn.reshape(n, -1) @ lp["wo"]
+        x = x + _mlp(cfg, lp, x)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, SlotKVCache(k=new_k, v=new_v)
+
+
+def make_cache(cfg: LlamaConfig, slots: int, max_len: int | None = None) -> SlotKVCache:
+    return SlotKVCache.create(
+        cfg.num_layers, slots, max_len or cfg.max_seq_len, cfg.num_kv_heads,
+        cfg.head_size, dtype=cfg.dtype,
+    )

@@ -1,0 +1,26 @@
+"""Device-side ops: the compute kernels of the serving/training stack.
+
+Everything here is pure-functional jax designed around TPU constraints
+(SURVEY.md §7 design stance): static shapes, batched matmuls that tile onto
+the MXU, elementwise work left to XLA fusion. ``ops.attention`` has a
+backend switch — "xla" (einsum + softmax, fused by XLA) or "pallas"
+(hand-written flash kernels in gofr_tpu.ops.pallas) — selected per call or
+via the ``TPU_ATTENTION_BACKEND`` config.
+"""
+
+from gofr_tpu.ops.norms import layer_norm, rms_norm
+from gofr_tpu.ops.rope import apply_rope, rope_table
+from gofr_tpu.ops.attention import decode_attention, mha_attention
+from gofr_tpu.ops.kvcache import SlotKVCache
+from gofr_tpu.ops.sampling import sample_token
+
+__all__ = [
+    "layer_norm",
+    "rms_norm",
+    "apply_rope",
+    "rope_table",
+    "mha_attention",
+    "decode_attention",
+    "SlotKVCache",
+    "sample_token",
+]

@@ -1,0 +1,125 @@
+"""Attention ops: batched GQA prefill and single-token decode.
+
+TPU-first shape discipline: everything is [batch, seq, heads, head_dim]
+with static shapes; grouped-query attention is computed by folding query
+heads into groups ([B, S, Hkv, G, D]) so the contraction runs as one big
+einsum on the MXU instead of repeating K/V in HBM.
+
+``backend="xla"`` is plain einsum + masked softmax (XLA fuses this well at
+serving sizes); ``backend="pallas"`` dispatches to the flash kernels in
+``gofr_tpu.ops.pallas`` (blocked online-softmax; no S×S materialization).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _group_query_heads(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
+    """[B, S, Hq, D] → [B, S, Hkv, G, D]."""
+    b, s, hq, d = q.shape
+    if hq % num_kv_heads != 0:
+        raise ValueError(f"query heads {hq} not divisible by kv heads {num_kv_heads}")
+    return q.reshape(b, s, num_kv_heads, hq // num_kv_heads, d)
+
+
+def mha_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    q_offset: jnp.ndarray | int = 0,
+    kv_lengths: jnp.ndarray | None = None,
+    bias: jnp.ndarray | None = None,
+    scale: float | None = None,
+    backend: str = "xla",
+) -> jnp.ndarray:
+    """Full (prefill) attention.
+
+    q: [B, Sq, Hq, D]; k, v: [B, Skv, Hkv, D] → out [B, Sq, Hq, D].
+
+    ``q_offset`` shifts query positions (per-batch int array or scalar) so a
+    chunked prefill at cache offset t attends causally as positions t..t+Sq.
+    ``kv_lengths`` [B] masks padded key positions. ``bias`` is an additive
+    [B, 1|Hq, Sq, Skv] mask/ALiBi-style term.
+    """
+    if backend == "pallas":
+        from gofr_tpu.ops.pallas import flash_attention_available
+
+        if flash_attention_available():
+            from gofr_tpu.ops.pallas.flash_attention import flash_attention
+
+            return flash_attention(
+                q, k, v, causal=causal, q_offset=q_offset, kv_lengths=kv_lengths, scale=scale
+            )
+        backend = "xla"  # CPU/unsupported platform: fall back (kernels are TPU-only)
+    elif backend != "xla":
+        raise ValueError(f"unknown attention backend {backend!r}; use 'xla' or 'pallas'")
+
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+
+    qg = _group_query_heads(q, hkv)  # [B, Sq, Hkv, G, D]
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32) * scale
+
+    mask = None
+    if causal:
+        if isinstance(q_offset, jnp.ndarray) and q_offset.ndim == 1:
+            q_pos = jnp.arange(sq)[None, :] + q_offset[:, None]  # [B, Sq]
+            causal_mask = q_pos[:, :, None] >= jnp.arange(skv)[None, None, :]  # [B, Sq, Skv]
+            causal_mask = causal_mask[:, None, None]  # [B, 1, 1, Sq, Skv]
+        else:
+            q_pos = jnp.arange(sq)[:, None] + q_offset
+            causal_mask = (q_pos >= jnp.arange(skv)[None, :])[None, None, None]
+        mask = causal_mask
+    if kv_lengths is not None:
+        len_mask = jnp.arange(skv)[None, :] < kv_lengths[:, None]  # [B, Skv]
+        len_mask = len_mask[:, None, None, None, :]
+        mask = len_mask if mask is None else (mask & len_mask)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    if bias is not None:
+        # bias [B, H, Sq, Skv] → regroup to [B, Hkv, G, Sq, Skv]
+        bh = bias.shape[1]
+        bias5 = bias.reshape(b, hkv, bh // hkv, *bias.shape[2:]) if bh > 1 else bias[:, :, None]
+        scores = scores + bias5.astype(jnp.float32)
+
+    probs = _softmax(scores)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(v.dtype), v)
+    return out.reshape(b, sq, hq, d)
+
+
+def _softmax(scores: jnp.ndarray) -> jnp.ndarray:
+    """Softmax in f32 that returns zeros (not NaN) for fully-masked rows —
+    padded query rows have every key masked."""
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    unnorm = jnp.exp(scores - jnp.maximum(m, NEG_INF / 2))
+    denom = jnp.sum(unnorm, axis=-1, keepdims=True)
+    return unnorm / jnp.maximum(denom, 1e-20)
+
+
+def decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    backend: str = "xla",
+) -> jnp.ndarray:
+    """Single-step decode: q [B, Hq, D] against cache [B, Smax, Hkv, D],
+    attending to positions < lengths[b]. Returns [B, Hq, D]."""
+    out = mha_attention(
+        q[:, None],
+        k_cache,
+        v_cache,
+        causal=False,
+        kv_lengths=lengths,
+        scale=scale,
+        backend=backend,
+    )
+    return out[:, 0]

@@ -1,0 +1,82 @@
+"""Slot-based KV cache for continuous batching.
+
+One static buffer of shape [layers, slots, max_len, kv_heads, head_dim]
+per K and V. The serving engine owns slot assignment: an arriving request
+claims a free slot, prefill writes its prompt at offset 0, each decode
+step appends one token at ``positions[slot]``, and the slot is recycled on
+completion. Static shapes mean XLA compiles exactly one decode program for
+the whole serving lifetime — the continuous-batching analog of the
+reference's goroutine-per-request hot path (SURVEY.md §3.2).
+
+Layout note: layers lead so a ``lax.scan`` over layers can carry the cache
+as its xs/ys; [slots, max_len] next so per-slot scatters are contiguous.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SlotKVCache:
+    k: jnp.ndarray  # [L, B, Smax, Hkv, D]
+    v: jnp.ndarray  # [L, B, Smax, Hkv, D]
+
+    @classmethod
+    def create(
+        cls,
+        layers: int,
+        slots: int,
+        max_len: int,
+        kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "SlotKVCache":
+        shape = (layers, slots, max_len, kv_heads, head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def write_prompt(
+    k_layer: jnp.ndarray,
+    v_layer: jnp.ndarray,
+    slot: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write a prefilled prompt [S, Hkv, D] into one slot at offset 0.
+    ``k_layer``/``v_layer`` are per-layer views [B, Smax, Hkv, D]."""
+    k_layer = jax.lax.dynamic_update_slice(k_layer, k_new[None], (slot, 0, 0, 0))
+    v_layer = jax.lax.dynamic_update_slice(v_layer, v_new[None], (slot, 0, 0, 0))
+    return k_layer, v_layer
+
+
+def append_tokens(
+    k_layer: jnp.ndarray,
+    v_layer: jnp.ndarray,
+    positions: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Append one token's K/V per slot: k_new [B, Hkv, D] written at
+    ``positions`` [B] in each slot's sequence dimension."""
+    b = k_layer.shape[0]
+    idx = jnp.arange(b)
+    k_layer = k_layer.at[idx, positions].set(k_new)
+    v_layer = v_layer.at[idx, positions].set(v_new)
+    return k_layer, v_layer

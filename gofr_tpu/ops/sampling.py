@@ -1,0 +1,46 @@
+"""Token sampling with static shapes.
+
+``top_k``/``top_p``/``do_sample`` are static (they change the compiled
+program); ``temperature`` is a traced scalar so one compiled step serves
+any temperature. Fully-batched: one call samples every decode slot.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def sample_token(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    *,
+    temperature: jnp.ndarray | float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    do_sample: bool = True,
+) -> jnp.ndarray:
+    """Sample next tokens from ``logits`` [B, V] → [B] int32."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    logits = logits.astype(jnp.float32) / jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+
+    if top_k > 0 and top_k < logits.shape[-1]:
+        vals, _ = lax.top_k(logits, top_k)
+        kth = vals[..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative prob exceeds top_p (always keep the top-1)
+        keep_sorted = jnp.roll(cum, 1, axis=-1).at[..., 0].set(0.0) < top_p
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, NEG_INF, logits)
+
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

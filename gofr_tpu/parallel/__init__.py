@@ -1,0 +1,48 @@
+"""Parallelism layer: device mesh, sharding rules, collectives.
+
+This is the "distributed communication backend" of the framework — the
+TPU-native equivalent of the reference's transport stack (SURVEY.md §2.9,
+§5.8). Where GoFr selects a pub/sub backend by config
+(`pkg/gofr/container/container.go:95-122`), we select a mesh topology by
+config (``TPU_MESH=dp:2,tp:4``) and let XLA insert ICI/DCN collectives from
+sharding annotations (GSPMD), instead of hand-written NCCL/MPI calls.
+
+Axis vocabulary (fixed across the framework):
+
+- ``dp``   data parallel (replica groups; DCN-friendly outermost axis)
+- ``fsdp`` fully-sharded data parallel (weights sharded over the data axis)
+- ``pp``   pipeline stages
+- ``tp``   tensor parallel (ICI; heads / mlp sharding)
+- ``sp``   sequence / context parallel (ring attention)
+- ``ep``   expert parallel (MoE)
+"""
+
+from gofr_tpu.parallel.mesh import (
+    AXES,
+    MeshSpec,
+    build_mesh,
+    local_mesh,
+    mesh_from_config,
+)
+from gofr_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_sharding,
+    logical_spec,
+    shard_pytree,
+    with_sharding_constraint,
+)
+from gofr_tpu.parallel import collectives
+
+__all__ = [
+    "AXES",
+    "MeshSpec",
+    "build_mesh",
+    "local_mesh",
+    "mesh_from_config",
+    "ShardingRules",
+    "logical_sharding",
+    "logical_spec",
+    "shard_pytree",
+    "with_sharding_constraint",
+    "collectives",
+]

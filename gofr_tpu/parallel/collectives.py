@@ -1,0 +1,69 @@
+"""Collective communication wrappers (the east-west layer the reference
+lacks — SURVEY.md §5.8).
+
+All collectives are XLA primitives (`jax.lax.psum` etc.) that GSPMD lowers
+onto ICI within a slice and DCN across slices; use them inside
+``jax.shard_map`` / pjit over a mesh. The helpers here add the framework's
+axis vocabulary and the ring-permutation used by ring attention.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def psum(x: Any, axis: str):
+    return lax.psum(x, axis_name=axis)
+
+
+def pmean(x: Any, axis: str):
+    return lax.pmean(x, axis_name=axis)
+
+def pmax(x: Any, axis: str):
+    return lax.pmax(x, axis_name=axis)
+
+
+def all_gather(x: Any, axis: str, *, tiled: bool = True, gather_dim: int = 0):
+    return lax.all_gather(x, axis_name=axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x: Any, axis: str, *, scatter_dim: int = 0):
+    return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def all_to_all(x: Any, axis: str, *, split_dim: int, concat_dim: int):
+    return lax.all_to_all(x, axis_name=axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis_name=axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis_name=axis)
+
+
+def ring_permute(x: Any, axis: str, *, shift: int = 1):
+    """Send this shard to the next device on ``axis`` (wrap-around ring) and
+    receive from the previous one. The building block of ring attention:
+    on TPU the ring maps directly onto ICI neighbor links."""
+    n = lax.axis_size(axis_name=axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def shard_map_over(mesh: Mesh, in_specs: Any, out_specs: Any, *, check_vma: bool = False):
+    """Decorator: run a per-shard function under ``jax.shard_map`` on
+    ``mesh``. Thin sugar so call sites read like the reference's
+    "register handler on transport" style."""
+
+    def wrap(fn):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma)
+
+    return wrap

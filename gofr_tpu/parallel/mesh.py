@@ -1,0 +1,117 @@
+"""Device mesh construction.
+
+The mesh is the framework's unit of distribution: every sharded computation
+(serving engine, train step, collectives) runs inside one
+``jax.sharding.Mesh``. Topology comes from config, keeping GoFr's
+"backend selected by config" ergonomics (`container/container.go:95-122`):
+
+    TPU_MESH=dp:2,tp:4        # explicit
+    TPU_MESH=tp:-1            # -1 = fill with remaining devices
+    (unset)                   # all devices on the ``dp`` axis
+
+Axis order in the spec is physical-layout order: later axes are placed on
+adjacent devices (innermost), so put the bandwidth-hungry axes (``tp``,
+``sp``) last to keep their collectives on ICI and ``dp``/``pp`` first so
+replica traffic can cross DCN.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# canonical axis names, in recommended outer→inner physical order
+AXES = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """An ordered mapping of mesh axis name → size. Size ``-1`` means "fill
+    with whatever devices remain" (at most one axis may be -1)."""
+
+    axes: tuple[tuple[str, int], ...] = (("dp", -1),)
+
+    @classmethod
+    def parse(cls, text: str) -> "MeshSpec":
+        """Parse ``"dp:2,tp:4"`` / ``"tp=4"`` / ``"tp:-1"``."""
+        pairs: list[tuple[str, int]] = []
+        for part in text.replace("=", ":").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, size_s = part.partition(":")
+            name = name.strip()
+            if name not in AXES:
+                raise ValueError(f"unknown mesh axis {name!r}; valid: {AXES}")
+            try:
+                size = int(size_s)
+            except ValueError:
+                raise ValueError(f"bad mesh axis size in {part!r}") from None
+            pairs.append((name, size))
+        if not pairs:
+            raise ValueError(f"empty mesh spec {text!r}")
+        names = [n for n, _ in pairs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate axis in mesh spec {text!r}")
+        if sum(1 for _, s in pairs if s == -1) > 1:
+            raise ValueError(f"at most one -1 axis allowed: {text!r}")
+        return cls(axes=tuple(pairs))
+
+    def resolve(self, n_devices: int) -> tuple[tuple[str, int], ...]:
+        """Fill the -1 axis (if any) and validate the product divides into
+        ``n_devices`` exactly."""
+        fixed = math.prod(s for _, s in self.axes if s != -1)
+        if fixed <= 0:
+            raise ValueError(f"mesh axis sizes must be positive: {self.axes}")
+        resolved = []
+        for name, size in self.axes:
+            if size == -1:
+                if n_devices % fixed != 0:
+                    raise ValueError(
+                        f"cannot fill axis {name!r}: {n_devices} devices not divisible by {fixed}"
+                    )
+                size = n_devices // fixed
+            resolved.append((name, size))
+        total = math.prod(s for _, s in resolved)
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {dict(resolved)} needs {total} devices, have {n_devices}"
+            )
+        return tuple(resolved)
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+
+def build_mesh(spec: MeshSpec | str | None = None, devices=None) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` from a spec over ``devices`` (default:
+    all visible devices)."""
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec()
+    elif isinstance(spec, str):
+        spec = MeshSpec.parse(spec)
+    resolved = spec.resolve(len(devices))
+    shape = tuple(s for _, s in resolved)
+    names = tuple(n for n, _ in resolved)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=names)
+
+
+def mesh_from_config(config, devices=None) -> Mesh:
+    """Mesh from the ``TPU_MESH`` config key (default: all devices on dp)."""
+    text = config.get("TPU_MESH") if hasattr(config, "get") else None
+    return build_mesh(MeshSpec.parse(text) if text else None, devices=devices)
+
+
+def local_mesh(n: int | None = None, axis: str = "dp") -> Mesh:
+    """A trivial mesh over the first ``n`` local devices on one axis —
+    convenience for single-axis tests and single-chip serving."""
+    devices = jax.devices()[: n or len(jax.devices())]
+    return Mesh(np.asarray(devices), axis_names=(axis,))

@@ -1,0 +1,112 @@
+"""Logical-axis sharding rules.
+
+Models annotate every parameter/activation with *logical* axis names
+(``("embed", "mlp")``); ShardingRules map logical names to mesh axes. This
+decouples model code from topology: the same Llama forward runs 1-chip
+(all rules → None), TP-8 (heads/mlp → "tp"), or FSDP+TP, purely by swapping
+rules — the framework's analog of GoFr wiring datasources by config rather
+than code (`container/container.go:66-124`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# A logical axis annotation is a tuple of logical names (or None for
+# unsharded), one entry per array dimension.
+LogicalAxes = tuple[str | None, ...]
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """Map logical axis name → mesh axis name(s) (or None = replicate).
+
+    The default rules implement the standard serving/training layout:
+    batch over (dp, fsdp); attention heads and mlp hidden over tp; sequence
+    over sp (ring attention); experts over ep; layers over pp.
+    """
+
+    rules: tuple[tuple[str, Any], ...] = (
+        ("batch", ("dp", "fsdp")),
+        ("seq", "sp"),
+        ("heads", "tp"),
+        ("kv_heads", "tp"),
+        ("embed", None),
+        ("mlp", "tp"),
+        ("vocab", "tp"),
+        ("expert", "ep"),
+        ("layers", None),
+        ("stage", "pp"),
+    )
+
+    def lookup(self, logical: str | None, mesh_axes: tuple[str, ...]):
+        if logical is None:
+            return None
+        mapping = dict(self.rules)
+        if logical not in mapping:
+            raise KeyError(f"no sharding rule for logical axis {logical!r}")
+        target = mapping[logical]
+        if target is None:
+            return None
+        if isinstance(target, str):
+            return target if target in mesh_axes else None
+        # tuple of mesh axes: keep only those present in the mesh
+        present = tuple(t for t in target if t in mesh_axes)
+        if not present:
+            return None
+        return present if len(present) > 1 else present[0]
+
+    def spec(self, logical_axes: LogicalAxes, mesh: Mesh) -> P:
+        return P(*(self.lookup(name, mesh.axis_names) for name in logical_axes))
+
+    def with_overrides(self, **overrides: Any) -> "ShardingRules":
+        mapping = dict(self.rules)
+        mapping.update(overrides)
+        return ShardingRules(rules=tuple(mapping.items()))
+
+
+def fsdp_rules() -> ShardingRules:
+    """Rules for FSDP training: shard the embed dimension of weights over
+    the fsdp axis so parameters are fully sharded across data replicas."""
+    return ShardingRules().with_overrides(embed="fsdp")
+
+
+def logical_spec(rules: ShardingRules, logical_axes: LogicalAxes, mesh: Mesh) -> P:
+    return rules.spec(logical_axes, mesh)
+
+
+def logical_sharding(rules: ShardingRules, logical_axes: LogicalAxes, mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(logical_axes, mesh))
+
+
+def shard_pytree(tree: Any, axes_tree: Any, rules: ShardingRules, mesh: Mesh) -> Any:
+    """Device-put every leaf of ``tree`` with the sharding derived from the
+    matching leaf of ``axes_tree`` (a pytree of LogicalAxes tuples)."""
+
+    def _put(leaf, axes):
+        return jax.device_put(leaf, logical_sharding(rules, axes, mesh))
+
+    return jax.tree.map(_put, tree, axes_tree, is_leaf=lambda x: x is None)
+
+
+def sharding_tree(axes_tree: Any, rules: ShardingRules, mesh: Mesh) -> Any:
+    """Pytree of NamedShardings matching ``axes_tree`` — feed to jit
+    in_shardings/out_shardings."""
+    return jax.tree.map(
+        lambda axes: logical_sharding(rules, axes, mesh),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x),
+    )
+
+
+def with_sharding_constraint(x: Any, logical_axes: LogicalAxes, rules: ShardingRules, mesh: Mesh) -> Any:
+    """Constrain an intermediate activation inside jit (GSPMD hint). Outside
+    a mesh/jit context this is the identity."""
+    try:
+        return jax.lax.with_sharding_constraint(x, logical_sharding(rules, logical_axes, mesh))
+    except (ValueError, RuntimeError):
+        return x

@@ -7,10 +7,19 @@ path is exercised without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU. Env vars alone are too late here: the image's sitecustomize
+# imports jax at interpreter startup (registering a real-TPU backend), so
+# JAX_PLATFORMS is already captured. jax.config.update still works because
+# no backend has been *initialized* yet — but XLA_FLAGS must be in the env
+# before the CPU client is created, so set both.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -40,6 +40,28 @@ def test_schedule_matches():
     assert not s.matches(t2)
 
 
+def test_schedule_dom_dow_vixie_or_rule():
+    """When BOTH day-of-month and day-of-week are restricted, a day
+    matching either fires (standard cron; reference cron.go:273-277)."""
+    s = Schedule.parse("0 0 1 * 1")  # 1st of month OR Mondays
+    # 2026-06-01 is a Monday AND the 1st
+    assert s.matches(time.struct_time((2026, 6, 1, 0, 0, 0, 0, 152, -1)))
+    # 2026-06-08 is a Monday but not the 1st → still fires
+    assert s.matches(time.struct_time((2026, 6, 8, 0, 0, 0, 0, 159, -1)))
+    # 2026-07-01 is a Wednesday, the 1st → still fires
+    assert s.matches(time.struct_time((2026, 7, 1, 0, 0, 0, 2, 182, -1)))
+    # 2026-06-09 Tuesday, not the 1st → no fire
+    assert not s.matches(time.struct_time((2026, 6, 9, 0, 0, 0, 1, 160, -1)))
+    # only dow restricted → AND semantics as usual
+    s2 = Schedule.parse("0 0 * * 1")
+    assert not s2.matches(time.struct_time((2026, 7, 1, 0, 0, 0, 2, 182, -1)))
+    assert s2.matches(time.struct_time((2026, 6, 8, 0, 0, 0, 0, 159, -1)))
+    # only dom restricted
+    s3 = Schedule.parse("0 0 1 * *")
+    assert s3.matches(time.struct_time((2026, 7, 1, 0, 0, 0, 2, 182, -1)))
+    assert not s3.matches(time.struct_time((2026, 6, 8, 0, 0, 0, 0, 159, -1)))
+
+
 def test_crontab_fires_matching_jobs():
     c = new_mock_container()
     cron = Crontab(c)

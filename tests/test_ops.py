@@ -1,0 +1,231 @@
+"""Numerics tests for gofr_tpu.ops against naive reference implementations."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops import (
+    SlotKVCache,
+    apply_rope,
+    decode_attention,
+    layer_norm,
+    mha_attention,
+    rms_norm,
+    rope_table,
+    sample_token,
+)
+from gofr_tpu.ops.kvcache import append_tokens, write_prompt
+
+
+def naive_attention(q, k, v, causal=True, kv_len=None, q_offset=0):
+    """Slow per-head reference: q [S,H,D], k/v [T,Hkv,D]."""
+    s, h, d = q.shape
+    t, hkv, _ = k.shape
+    group = h // hkv
+    out = np.zeros((s, h, d), np.float32)
+    for i in range(h):
+        kk, vv = k[:, i // group].astype(np.float32), v[:, i // group].astype(np.float32)
+        scores = q[:, i].astype(np.float32) @ kk.T / np.sqrt(d)
+        for a in range(s):
+            for b in range(t):
+                if causal and a + q_offset < b:
+                    scores[a, b] = -np.inf
+                if kv_len is not None and b >= kv_len:
+                    scores[a, b] = -np.inf
+        probs = np.exp(scores - scores.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        out[:, i] = probs @ vv
+    return out
+
+
+class TestNorms:
+    def test_rms_norm(self):
+        x = jax.random.normal(jax.random.key(0), (2, 5, 16))
+        w = jax.random.normal(jax.random.key(1), (16,)) + 1.0
+        got = rms_norm(x, w)
+        xf = np.asarray(x, np.float64)
+        want = xf / np.sqrt((xf**2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+    def test_rms_norm_bf16_computes_in_f32(self):
+        x = (jax.random.normal(jax.random.key(0), (4, 64)) * 100).astype(jnp.bfloat16)
+        w = jnp.ones((64,), jnp.bfloat16)
+        got = rms_norm(x, w)
+        assert got.dtype == jnp.bfloat16
+        assert bool(jnp.all(jnp.isfinite(got.astype(jnp.float32))))
+
+    def test_layer_norm(self):
+        x = jax.random.normal(jax.random.key(0), (3, 8))
+        w, b = jnp.ones((8,)) * 2, jnp.ones((8,)) * 0.5
+        got = np.asarray(layer_norm(x, w, b))
+        xf = np.asarray(x, np.float64)
+        normed = (xf - xf.mean(-1, keepdims=True)) / np.sqrt(xf.var(-1, keepdims=True) + 1e-12)
+        np.testing.assert_allclose(got, normed * 2 + 0.5, rtol=1e-4, atol=1e-5)
+
+
+class TestRope:
+    def test_table_shapes(self):
+        cos, sin = rope_table(32, 8)
+        assert cos.shape == (32, 4) and sin.shape == (32, 4)
+        np.testing.assert_allclose(np.asarray(cos[0]), 1.0)
+        np.testing.assert_allclose(np.asarray(sin[0]), 0.0)
+
+    def test_rotation_preserves_norm(self):
+        x = jax.random.normal(jax.random.key(0), (1, 6, 2, 8))
+        cos, sin = rope_table(16, 8)
+        pos = jnp.arange(6)[None]
+        y = apply_rope(x, pos, cos, sin)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_relative_property(self):
+        """<rope(q,m), rope(k,n)> depends only on m-n."""
+        cos, sin = rope_table(64, 8)
+        q = jax.random.normal(jax.random.key(1), (1, 1, 1, 8))
+        k = jax.random.normal(jax.random.key(2), (1, 1, 1, 8))
+
+        def dot_at(m, n):
+            qr = apply_rope(q, jnp.array([[m]]), cos, sin)
+            kr = apply_rope(k, jnp.array([[n]]), cos, sin)
+            return float(jnp.sum(qr * kr))
+
+        assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-5)
+        assert dot_at(5, 3) != pytest.approx(dot_at(5, 4), rel=1e-3)
+
+    def test_matches_hf_rotate_half(self):
+        """Cross-check against the HF/Llama rotate_half formulation."""
+        torch = pytest.importorskip("torch")
+        head_dim, seq = 16, 7
+        cos, sin = rope_table(32, head_dim)
+        x = np.random.RandomState(0).randn(1, seq, 1, head_dim).astype(np.float32)
+        got = apply_rope(jnp.asarray(x), jnp.arange(seq)[None], cos, sin)
+
+        inv_freq = 1.0 / (10000 ** (np.arange(0, head_dim // 2) * 2 / head_dim))
+        t = np.arange(seq)
+        freqs = np.outer(t, inv_freq)
+        emb = np.concatenate([freqs, freqs], -1)
+        hf_cos, hf_sin = np.cos(emb), np.sin(emb)
+        xt = x[0, :, 0]  # [seq, dim]
+        rot = np.concatenate([-xt[:, head_dim // 2:], xt[:, : head_dim // 2]], -1)
+        want = xt * hf_cos + rot * hf_sin
+        np.testing.assert_allclose(np.asarray(got)[0, :, 0], want, rtol=1e-4, atol=1e-5)
+
+
+class TestAttention:
+    def test_causal_matches_naive(self):
+        key = jax.random.key(0)
+        q = jax.random.normal(key, (2, 5, 4, 8))
+        k = jax.random.normal(jax.random.key(1), (2, 5, 4, 8))
+        v = jax.random.normal(jax.random.key(2), (2, 5, 4, 8))
+        got = np.asarray(mha_attention(q, k, v, causal=True))
+        for b in range(2):
+            want = naive_attention(np.asarray(q[b]), np.asarray(k[b]), np.asarray(v[b]))
+            np.testing.assert_allclose(got[b], want, rtol=1e-4, atol=1e-5)
+
+    def test_gqa_matches_naive(self):
+        q = jax.random.normal(jax.random.key(0), (1, 6, 8, 4))
+        k = jax.random.normal(jax.random.key(1), (1, 6, 2, 4))
+        v = jax.random.normal(jax.random.key(2), (1, 6, 2, 4))
+        got = np.asarray(mha_attention(q, k, v, causal=True))
+        want = naive_attention(np.asarray(q[0]), np.asarray(k[0]), np.asarray(v[0]))
+        np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=1e-5)
+
+    def test_kv_length_masking(self):
+        q = jax.random.normal(jax.random.key(0), (2, 3, 2, 4))
+        k = jax.random.normal(jax.random.key(1), (2, 8, 2, 4))
+        v = jax.random.normal(jax.random.key(2), (2, 8, 2, 4))
+        lengths = jnp.array([8, 4])
+        got = np.asarray(mha_attention(q, k, v, causal=False, kv_lengths=lengths))
+        # batch 1 must equal attention over only the first 4 kv positions
+        want = naive_attention(
+            np.asarray(q[1]), np.asarray(k[1]), np.asarray(v[1]), causal=False, kv_len=4
+        )
+        np.testing.assert_allclose(got[1], want, rtol=1e-4, atol=1e-5)
+
+    def test_q_offset_chunked_prefill(self):
+        """Attention over a chunk at offset t equals the tail of full attention."""
+        q = jax.random.normal(jax.random.key(0), (1, 8, 2, 4))
+        k = jax.random.normal(jax.random.key(1), (1, 8, 2, 4))
+        v = jax.random.normal(jax.random.key(2), (1, 8, 2, 4))
+        full = mha_attention(q, k, v, causal=True)
+        chunk = mha_attention(q[:, 4:], k, v, causal=True, q_offset=4)
+        np.testing.assert_allclose(np.asarray(chunk), np.asarray(full[:, 4:]), rtol=1e-4, atol=1e-5)
+        # per-batch array offset too
+        chunk2 = mha_attention(q[:, 4:], k, v, causal=True, q_offset=jnp.array([4]))
+        np.testing.assert_allclose(np.asarray(chunk2), np.asarray(full[:, 4:]), rtol=1e-4, atol=1e-5)
+
+    def test_fully_masked_rows_are_finite(self):
+        q = jax.random.normal(jax.random.key(0), (1, 2, 1, 4))
+        k = jax.random.normal(jax.random.key(1), (1, 4, 1, 4))
+        v = jax.random.normal(jax.random.key(2), (1, 4, 1, 4))
+        out = mha_attention(q, k, v, causal=False, kv_lengths=jnp.array([0]))
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+    def test_decode_matches_prefill_last_row(self):
+        s = 6
+        q = jax.random.normal(jax.random.key(0), (1, s, 4, 8))
+        k = jax.random.normal(jax.random.key(1), (1, s, 2, 8))
+        v = jax.random.normal(jax.random.key(2), (1, s, 2, 8))
+        full = mha_attention(q, k, v, causal=True)
+        # cache padded beyond the real length
+        k_pad = jnp.pad(k, ((0, 0), (0, 10), (0, 0), (0, 0)))
+        v_pad = jnp.pad(v, ((0, 0), (0, 10), (0, 0), (0, 0)))
+        dec = decode_attention(q[:, -1], k_pad, v_pad, jnp.array([s]))
+        np.testing.assert_allclose(np.asarray(dec), np.asarray(full[:, -1]), rtol=1e-4, atol=1e-5)
+
+
+class TestKVCache:
+    def test_create_shapes(self):
+        c = SlotKVCache.create(layers=2, slots=3, max_len=16, kv_heads=2, head_dim=4)
+        assert c.k.shape == (2, 3, 16, 2, 4)
+        assert c.num_layers == 2 and c.num_slots == 3 and c.max_len == 16
+
+    def test_write_prompt_and_append(self):
+        c = SlotKVCache.create(1, 2, 8, 1, 4, dtype=jnp.float32)
+        k_new = jnp.ones((3, 1, 4))
+        v_new = jnp.full((3, 1, 4), 2.0)
+        k_l, v_l = write_prompt(c.k[0], c.v[0], jnp.int32(1), k_new, v_new)
+        np.testing.assert_array_equal(np.asarray(k_l[1, :3]), np.ones((3, 1, 4)))
+        np.testing.assert_array_equal(np.asarray(k_l[0]), np.zeros((8, 1, 4)))
+        # append one token per slot at different positions
+        k_tok = jnp.full((2, 1, 4), 5.0)
+        v_tok = jnp.full((2, 1, 4), 6.0)
+        k_l, v_l = append_tokens(k_l, v_l, jnp.array([0, 3]), k_tok, v_tok)
+        np.testing.assert_array_equal(np.asarray(k_l[0, 0]), np.full((1, 4), 5.0))
+        np.testing.assert_array_equal(np.asarray(k_l[1, 3]), np.full((1, 4), 5.0))
+        np.testing.assert_array_equal(np.asarray(v_l[1, 3]), np.full((1, 4), 6.0))
+
+
+class TestSampling:
+    def test_greedy(self):
+        logits = jnp.array([[0.0, 5.0, 1.0], [9.0, 0.0, 0.0]])
+        toks = sample_token(logits, jax.random.key(0), do_sample=False)
+        np.testing.assert_array_equal(np.asarray(toks), [1, 0])
+
+    def test_top_k_restricts_support(self):
+        logits = jnp.array([[10.0, 9.0, -1.0, -2.0]] * 64)
+        toks = sample_token(logits, jax.random.key(0), top_k=2, temperature=5.0)
+        assert set(np.asarray(toks)) <= {0, 1}
+
+    def test_top_p_restricts_support(self):
+        logits = jnp.log(jnp.array([[0.6, 0.35, 0.04, 0.01]] * 64))
+        toks = sample_token(logits, jax.random.key(1), top_p=0.9, temperature=1.0)
+        assert set(np.asarray(toks)) <= {0, 1}
+
+    def test_top_p_always_keeps_top1(self):
+        logits = jnp.array([[3.0, 1.0, 0.0]] * 8)
+        toks = sample_token(logits, jax.random.key(0), top_p=1e-9)
+        np.testing.assert_array_equal(np.asarray(toks), [0] * 8)
+
+    def test_temperature_is_traced(self):
+        """Same compiled fn serves different temperatures (no recompile)."""
+        f = jax.jit(lambda lg, key, t: sample_token(lg, key, temperature=t))
+        logits = jnp.array([[1.0, 2.0, 3.0]] * 4)
+        _ = f(logits, jax.random.key(0), 1.0)
+        n0 = f._cache_size()
+        _ = f(logits, jax.random.key(0), 0.3)
+        assert f._cache_size() == n0

@@ -1,0 +1,151 @@
+"""Mesh / sharding-rules / collectives tests on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gofr_tpu.parallel import (
+    MeshSpec,
+    ShardingRules,
+    build_mesh,
+    collectives,
+    local_mesh,
+    logical_sharding,
+    mesh_from_config,
+    shard_pytree,
+)
+from gofr_tpu.config import DictConfig
+
+
+def test_virtual_device_count():
+    assert len(jax.devices()) == 8
+
+
+class TestMeshSpec:
+    def test_parse(self):
+        spec = MeshSpec.parse("dp:2,tp:4")
+        assert spec.axes == (("dp", 2), ("tp", 4))
+
+    def test_parse_equals_and_fill(self):
+        spec = MeshSpec.parse("tp=-1")
+        assert spec.resolve(8) == (("tp", 8),)
+
+    def test_fill_with_fixed(self):
+        spec = MeshSpec.parse("dp:2,tp:-1")
+        assert spec.resolve(8) == (("dp", 2), ("tp", 4))
+
+    def test_bad_axis(self):
+        with pytest.raises(ValueError, match="unknown mesh axis"):
+            MeshSpec.parse("zz:2")
+
+    def test_two_fills(self):
+        with pytest.raises(ValueError, match="at most one"):
+            MeshSpec.parse("dp:-1,tp:-1")
+
+    def test_duplicate(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            MeshSpec.parse("tp:2,tp:4")
+
+    def test_indivisible(self):
+        with pytest.raises(ValueError, match="divisible|needs"):
+            MeshSpec.parse("dp:3").resolve(8)
+
+    def test_build_mesh(self):
+        mesh = build_mesh("dp:2,tp:4")
+        assert mesh.axis_names == ("dp", "tp")
+        assert mesh.devices.shape == (2, 4)
+
+    def test_mesh_from_config(self):
+        mesh = mesh_from_config(DictConfig({"TPU_MESH": "dp:2,sp:2,tp:2"}))
+        assert mesh.axis_names == ("dp", "sp", "tp")
+
+    def test_mesh_from_config_default(self):
+        mesh = mesh_from_config(DictConfig({}))
+        assert mesh.axis_names == ("dp",)
+        assert mesh.devices.shape == (8,)
+
+
+class TestShardingRules:
+    def test_spec_maps_logical_to_mesh(self):
+        mesh = build_mesh("dp:2,tp:4")
+        rules = ShardingRules()
+        spec = rules.spec(("batch", "seq", "embed"), mesh)
+        # batch → dp (fsdp absent from mesh), seq → sp absent → None
+        assert spec == P("dp", None, None)
+        spec2 = rules.spec(("embed", "mlp"), mesh)
+        assert spec2 == P(None, "tp")
+
+    def test_absent_axis_replicates(self):
+        mesh = local_mesh(8, axis="dp")
+        spec = ShardingRules().spec(("heads", "embed"), mesh)
+        assert spec == P(None, None)
+
+    def test_unknown_logical_raises(self):
+        mesh = local_mesh(8)
+        with pytest.raises(KeyError):
+            ShardingRules().spec(("nonsense",), mesh)
+
+    def test_overrides(self):
+        mesh = build_mesh("fsdp:8")
+        rules = ShardingRules().with_overrides(embed="fsdp")
+        assert rules.spec(("embed", "mlp"), mesh) == P("fsdp", None)
+
+    def test_shard_pytree(self):
+        mesh = build_mesh("dp:2,tp:4")
+        rules = ShardingRules()
+        params = {"w": jnp.ones((8, 16)), "b": jnp.ones((16,))}
+        axes = {"w": ("embed", "mlp"), "b": ("mlp",)}
+        sharded = shard_pytree(params, axes, rules, mesh)
+        assert sharded["w"].sharding == NamedSharding(mesh, P(None, "tp"))
+        assert sharded["b"].sharding == NamedSharding(mesh, P("tp"))
+        # value preserved
+        np.testing.assert_array_equal(np.asarray(sharded["w"]), np.ones((8, 16)))
+
+
+class TestCollectives:
+    def test_psum_all_gather_under_shard_map(self):
+        mesh = local_mesh(8, axis="tp")
+        x = jnp.arange(8.0)
+
+        @collectives.shard_map_over(mesh, in_specs=P("tp"), out_specs=P())
+        def total(shard):
+            return collectives.psum(jnp.sum(shard), "tp")
+
+        assert float(total(x)) == 28.0
+
+    def test_ring_permute(self):
+        mesh = local_mesh(8, axis="sp")
+        x = jnp.arange(8.0)
+
+        @collectives.shard_map_over(mesh, in_specs=P("sp"), out_specs=P("sp"))
+        def rotate(shard):
+            return collectives.ring_permute(shard, "sp")
+
+        out = rotate(x)
+        # device i's value moves to device i+1 (wrap): result is roll by 1
+        np.testing.assert_array_equal(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+    def test_reduce_scatter(self):
+        mesh = local_mesh(4, axis="tp")
+        x = jnp.ones((4, 8))
+
+        @collectives.shard_map_over(mesh, in_specs=P("tp", None), out_specs=P("tp", None))
+        def rs(shard):
+            # each shard is (1, 8); psum_scatter over tp splits dim 1 → (1, 2) per device
+            return collectives.reduce_scatter(shard, "tp", scatter_dim=1)
+
+        out = rs(x)
+        assert out.shape == (4, 2)
+        np.testing.assert_array_equal(np.asarray(out), np.full((4, 2), 4.0))
+
+    def test_axis_index_size(self):
+        mesh = local_mesh(8, axis="dp")
+
+        @collectives.shard_map_over(mesh, in_specs=(), out_specs=P("dp"))
+        def idx():
+            return (collectives.axis_index("dp") * 10 + collectives.axis_size("dp"))[None]
+
+        out = idx()
+        np.testing.assert_array_equal(np.asarray(out), np.arange(8) * 10 + 8)
