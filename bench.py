@@ -1,0 +1,112 @@
+"""Headline benchmark: flagship Llama generate throughput through the
+continuous-batching engine (BASELINE.md config #2 analog on one chip).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "req/s", "vs_baseline": N}
+
+``vs_baseline`` is value / 125 — the north-star target of ≥1000 req/s on a
+v5e-8 (BASELINE.json) prorated to a single chip. The reference publishes
+no numbers of its own (BASELINE.md), so the north-star target is the bar.
+
+Env knobs:
+    GOFR_BENCH_PRESET    one_b (default) | tiny  (tiny = CPU smoke test)
+    GOFR_BENCH_REQUESTS  total requests (default 64)
+    GOFR_BENCH_SLOTS     decode slots (default 16)
+    GOFR_BENCH_PROMPT    prompt length (default 64)
+    GOFR_BENCH_NEW       generated tokens per request (default 64)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    preset = os.environ.get("GOFR_BENCH_PRESET", "one_b")
+    n_requests = int(os.environ.get("GOFR_BENCH_REQUESTS", "64"))
+    slots = int(os.environ.get("GOFR_BENCH_SLOTS", "16"))
+    prompt_len = int(os.environ.get("GOFR_BENCH_PROMPT", "64"))
+    max_new = int(os.environ.get("GOFR_BENCH_NEW", "64"))
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import LlamaConfig, llama
+    from gofr_tpu.tpu.engine import GenerateEngine
+
+    if preset == "tiny":
+        cfg = LlamaConfig.tiny()
+    else:
+        cfg = LlamaConfig.one_b()
+
+    container = new_mock_container()
+    params = llama.init(cfg, jax.random.key(0))
+    max_len = prompt_len + max_new + 8
+    engine = GenerateEngine(
+        llama, cfg, params, container,
+        slots=slots, max_len=max_len,
+        max_prefill_batch=4,
+        prefill_buckets=[prompt_len],
+    )
+    engine.start()
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, size=prompt_len).tolist() for _ in range(n_requests)]
+
+    # warmup: compile prefill + decode programs
+    engine.generate(prompts[0], max_new_tokens=2, timeout=600)
+
+    results = [None] * n_requests
+    errors: list[Exception] = []
+
+    def worker(i: int) -> None:
+        try:
+            results[i] = engine.generate(prompts[i], max_new_tokens=max_new, timeout=1200)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    engine.stop()
+
+    if errors or any(r is None for r in results):
+        print(json.dumps({"metric": "bench_error", "value": 0, "unit": "req/s",
+                          "vs_baseline": 0, "error": str(errors[:1])}))
+        sys.exit(1)
+
+    total_tokens = sum(len(r["tokens"]) for r in results)
+    req_per_s = n_requests / elapsed
+    tok_per_s = total_tokens / elapsed
+    platform = jax.devices()[0].platform
+
+    print(json.dumps({
+        "metric": f"llama_{preset}_generate_req_per_s_per_chip",
+        "value": round(req_per_s, 3),
+        "unit": "req/s",
+        "vs_baseline": round(req_per_s / 125.0, 4),
+        "extra": {
+            "decode_tokens_per_s": round(tok_per_s, 1),
+            "requests": n_requests,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new,
+            "slots": slots,
+            "platform": platform,
+            "elapsed_s": round(elapsed, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""gofr_tpu: a TPU-native application framework with GoFr's capabilities.
+
+GoFr's shape — one App, one Container, one transport-neutral Context,
+handlers as plain functions, everything config-gated — with a JAX/XLA
+serving runtime underneath: models served behind continuous-batching
+engines on a sharded device mesh, reachable from any handler via
+``ctx.infer`` / ``ctx.generate``.
+
+    import gofr_tpu
+    from gofr_tpu.models import ModelSpec, LlamaConfig
+
+    app = gofr_tpu.new()
+    app.serve_model("lm", ModelSpec("llama", LlamaConfig.llama3_8b(),
+                                    weights="/ckpt/llama3-8b", task="generate"))
+
+    def generate(ctx):
+        return ctx.generate("lm", ctx.bind()["prompt"], max_new_tokens=128)
+
+    app.post("/generate", generate)
+    app.run()
+"""
+
+from gofr_tpu.app import App, new, new_cmd, new_testing
+from gofr_tpu.context import Context
+from gofr_tpu.models.base import ModelSpec
+from gofr_tpu import version
+
+__version__ = version.FRAMEWORK
+__all__ = ["App", "Context", "ModelSpec", "new", "new_cmd", "new_testing"]

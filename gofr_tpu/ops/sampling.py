@@ -23,11 +23,20 @@ def sample_token(
     top_p: float = 1.0,
     do_sample: bool = True,
 ) -> jnp.ndarray:
-    """Sample next tokens from ``logits`` [B, V] → [B] int32."""
-    if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    """Sample next tokens from ``logits`` [B, V] → [B] int32.
 
-    logits = logits.astype(jnp.float32) / jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    ``temperature`` may be a scalar or per-row [B] array; rows with
+    temperature <= 0 decode greedily (the continuous-batching engine mixes
+    greedy and sampled requests in one step this way).
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if not do_sample:
+        return greedy
+
+    temp = jnp.asarray(temperature, jnp.float32)  # scalar or [B]; shape is static under jit
+    logits = logits.astype(jnp.float32) / jnp.maximum(
+        temp[:, None] if temp.ndim == 1 else temp, 1e-6
+    )
 
     if top_k > 0 and top_k < logits.shape[-1]:
         vals, _ = lax.top_k(logits, top_k)
@@ -38,9 +47,13 @@ def sample_token(
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        # keep tokens until cumulative prob exceeds top_p (always keep the top-1)
-        keep_sorted = jnp.roll(cum, 1, axis=-1).at[..., 0].set(0.0) < top_p
+        # keep tokens while the cumulative prob BEFORE them is < top_p; the
+        # top-1 is kept unconditionally (so top_p=0.0 degrades to greedy,
+        # not uniform garbage)
+        keep_sorted = (jnp.roll(cum, 1, axis=-1) < top_p).at[..., 0].set(True)
         cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
         logits = jnp.where(logits < cutoff, NEG_INF, logits)
 
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    sampled = jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    # temperature <= 0 → greedy, for scalar and per-row alike
+    return jnp.where(temp > 0, sampled, greedy)

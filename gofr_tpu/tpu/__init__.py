@@ -1,0 +1,12 @@
+"""TPU runtime: device datasource + continuous-batching serving engines.
+
+The device mesh is a *datasource* (``container.tpu``) exactly parallel to
+how the reference wraps a Redis pool (`container/container.go:91`):
+config-gated, lazily created, health-checked, metered. The engines replace
+the reference's goroutine-per-request hot path (SURVEY.md §3.2) with
+enqueue → batch → device-step.
+"""
+
+from gofr_tpu.tpu.device import TPUDevices
+
+__all__ = ["TPUDevices"]

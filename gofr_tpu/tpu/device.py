@@ -1,0 +1,98 @@
+"""TPU device datasource.
+
+Wraps the visible accelerator devices plus the configured mesh the way the
+reference wraps a connection pool (SQL: `datasource/sql/sql.go:37-89` —
+lazy connect, pushed pool gauges, health check). Config keys:
+
+    TPU_MESH       mesh topology, e.g. "dp:2,tp:4" (default: all on dp)
+    TPU_DEVICES    cap the number of devices used (default: all)
+
+Everything degrades gracefully on CPU (the virtual test mesh) — memory
+stats are best-effort because the CPU PJRT client doesn't report them.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+
+from gofr_tpu.parallel import ShardingRules, mesh_from_config
+
+
+class TPUDevices:
+    def __init__(self, config, logger, metrics):
+        self.config = config
+        self.logger = logger
+        self.metrics = metrics
+        self._lock = threading.Lock()
+
+        limit = config.get_int("TPU_DEVICES", 0)
+        devices = jax.devices()
+        self.devices = devices[:limit] if limit > 0 else devices
+        self.platform = self.devices[0].platform if self.devices else "none"
+        self.mesh = mesh_from_config(config, devices=self.devices)
+        self.rules = ShardingRules()
+        self._compiles = 0
+
+        metrics.set_gauge("app_tpu_device_count", len(self.devices))
+        self._push_memory_gauges()
+        logger.infof(
+            "TPU datasource: %d %s device(s), mesh %s",
+            len(self.devices), self.platform,
+            dict(zip(self.mesh.axis_names, self.mesh.devices.shape)),
+        )
+
+    # -- stats -----------------------------------------------------------------
+
+    def memory_stats(self) -> dict[str, dict[str, int]]:
+        """Per-device HBM stats (empty entries where the backend doesn't
+        report them, e.g. CPU)."""
+        stats: dict[str, dict[str, int]] = {}
+        for d in self.devices:
+            try:
+                s = d.memory_stats() or {}
+            except Exception:  # noqa: BLE001
+                s = {}
+            stats[str(d.id)] = {
+                "bytes_in_use": int(s.get("bytes_in_use", 0)),
+                "bytes_limit": int(s.get("bytes_limit", 0)),
+            }
+        return stats
+
+    def _push_memory_gauges(self) -> None:
+        for dev_id, s in self.memory_stats().items():
+            self.metrics.set_gauge("app_tpu_hbm_used_bytes", s["bytes_in_use"], device=dev_id)
+            self.metrics.set_gauge("app_tpu_hbm_limit_bytes", s["bytes_limit"], device=dev_id)
+
+    def record_compile(self) -> None:
+        """Engines call this when a (shape-bucket) program compiles for the
+        first time — the compile-cache-miss signal of the north star."""
+        with self._lock:
+            self._compiles += 1
+        self.metrics.increment_counter("app_tpu_compile_total", 1)
+
+    @property
+    def compile_count(self) -> int:
+        return self._compiles
+
+    # -- health (container/health.go parity) -----------------------------------
+
+    def health_check(self) -> dict[str, Any]:
+        try:
+            n = len(self.devices)
+            if n == 0:
+                return {"status": "DOWN", "details": {"error": "no devices visible"}}
+            self._push_memory_gauges()
+            return {
+                "status": "UP",
+                "details": {
+                    "platform": self.platform,
+                    "devices": n,
+                    "mesh": {k: int(v) for k, v in zip(self.mesh.axis_names, self.mesh.devices.shape)},
+                    "memory": self.memory_stats(),
+                },
+            }
+        except Exception as e:  # noqa: BLE001
+            return {"status": "DOWN", "details": {"error": str(e)}}

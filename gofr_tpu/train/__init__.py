@@ -1,0 +1,122 @@
+"""Distributed training: sharded train step over the device mesh.
+
+The reference is a serving framework with no training surface (SURVEY.md
+§2.9) — this subsystem exists so gofr_tpu models can be fine-tuned /
+trained on the same mesh they serve from. One ``make_train_step`` builds a
+pjit-style compiled step with explicit in/out shardings derived from the
+model's logical param axes: dp/fsdp shard the batch (and weights, for
+fsdp), tp shards heads/mlp/vocab — XLA inserts the ICI collectives
+(psum for grads over dp, all-gathers for fsdp params) per GSPMD.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gofr_tpu.parallel import ShardingRules, logical_sharding
+from gofr_tpu.parallel.sharding import sharding_tree
+
+
+def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray,
+                       mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Mean next-token cross entropy. logits [B,S,V] (f32), targets [B,S],
+    mask [B,S] (1 = count this position)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return jnp.mean(nll)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def make_train_step(
+    cfg: Any,
+    family: Any,
+    mesh: Mesh,
+    rules: ShardingRules | None = None,
+    optimizer: optax.GradientTransformation | None = None,
+    remat: bool = False,
+):
+    """Build ``(init_fn, step_fn)`` compiled over ``mesh``.
+
+    - ``init_fn(key) -> TrainState`` with every leaf placed per the
+      model's logical axes (params AND optimizer moments shard alike).
+    - ``step_fn(state, tokens, lengths) -> (state, metrics)`` — next-token
+      LM loss on ``tokens`` [B,S]; batch dim sharded over (dp, fsdp).
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint`` to trade FLOPs
+    for HBM (rematerialize activations in the backward pass).
+    """
+    rules = rules or ShardingRules()
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+    axes = family.param_axes(cfg)
+    param_sh = sharding_tree(axes, rules, mesh)
+    batch_spec = rules.spec(("batch", None), mesh)
+    batch_sh = NamedSharding(mesh, batch_spec)
+    len_sh = NamedSharding(mesh, P(batch_spec[0]))
+    scalar_sh = NamedSharding(mesh, P())
+
+    def fwd(params, tokens, lengths):
+        return family.forward(cfg, params, tokens, lengths)
+
+    if remat:
+        fwd = jax.checkpoint(fwd)
+
+    def loss_fn(params, tokens, lengths):
+        logits = fwd(params, tokens, lengths)
+        mask = (jnp.arange(tokens.shape[1])[None] < lengths[:, None] - 1).astype(jnp.float32)
+        # predict token t+1 from position t
+        return cross_entropy_loss(logits[:, :-1], tokens[:, 1:], mask[:, : tokens.shape[1] - 1])
+
+    def _init(key):
+        params = family.init(cfg, key)
+        return TrainState(params=params, opt_state=optimizer.init(params), step=jnp.zeros((), jnp.int32))
+
+    # opt_state mirrors params leaf-for-leaf (adam moments) plus scalar
+    # counters — derive its shardings by shape-matching against params.
+    state_shape = jax.eval_shape(_init, jax.random.key(0))
+
+    flat_param_sh = jax.tree.leaves(param_sh)
+    flat_param_shapes = [tuple(x.shape) for x in jax.tree.leaves(state_shape.params)]
+    shape_to_sh = {}
+    for shp, sh in zip(flat_param_shapes, flat_param_sh):
+        shape_to_sh.setdefault(shp, sh)
+
+    def leaf_sharding(leaf):
+        return shape_to_sh.get(tuple(leaf.shape), scalar_sh)
+
+    opt_sh = jax.tree.map(leaf_sharding, state_shape.opt_state)
+    state_sh = TrainState(params=param_sh, opt_state=opt_sh, step=scalar_sh)
+
+    init_fn = jax.jit(_init, out_shardings=state_sh)
+
+    def _step(state: TrainState, tokens, lengths):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, lengths)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    step_fn = jax.jit(
+        _step,
+        in_shardings=(state_sh, batch_sh, len_sh),
+        out_shardings=(state_sh, {"loss": scalar_sh, "grad_norm": scalar_sh}),
+        donate_argnums=0,
+    )
+    return init_fn, step_fn

@@ -1,0 +1,342 @@
+"""Continuous-batching engine tests on the CPU mesh.
+
+The load-bearing property: N requests served concurrently through the
+slot-based engine must produce *identical* tokens to sequential
+single-request generation with the same params (greedy), regardless of
+arrival order, slot assignment, or padding.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.config import DictConfig
+from gofr_tpu.container import Container, new_mock_container
+from gofr_tpu.http.errors import RequestTimeout
+from gofr_tpu.models import LlamaConfig, BertConfig, ViTConfig, ModelSpec, llama
+from gofr_tpu.tpu.engine import (
+    BatchEngine,
+    GenerateEngine,
+    Request,
+    build_engine,
+    next_bucket,
+)
+
+
+@pytest.fixture(scope="module")
+def gen_setup():
+    """Shared tiny llama + reference greedy generations."""
+    cfg = LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(7))
+
+    def reference_generate(prompt, n_new):
+        seq = list(prompt)
+        for _ in range(n_new):
+            logits = llama.forward(cfg, params, jnp.asarray([seq], jnp.int32))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        return seq[len(prompt):]
+
+    return cfg, params, reference_generate
+
+
+def make_container():
+    return new_mock_container()
+
+
+def make_gen_engine(cfg, params, container, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("max_prefill_batch", 2)
+    return GenerateEngine(llama, cfg, params, container, **kw)
+
+
+def test_next_bucket():
+    assert next_bucket(3, [4, 8, 16]) == 4
+    assert next_bucket(4, [4, 8, 16]) == 4
+    assert next_bucket(9, [4, 8, 16]) == 16
+    with pytest.raises(ValueError):
+        next_bucket(17, [4, 8, 16])
+
+
+class TestGenerateEngine:
+    def test_single_request_matches_reference(self, gen_setup):
+        cfg, params, ref = gen_setup
+        eng = make_gen_engine(cfg, params, make_container())
+        try:
+            out = eng.generate([5, 3, 9], max_new_tokens=6, timeout=60)
+            assert out["finish_reason"] == "length"
+            assert out["tokens"] == ref([5, 3, 9], 6)
+        finally:
+            eng.stop()
+
+    def test_concurrent_requests_match_reference(self, gen_setup):
+        """8 concurrent requests through 4 slots == sequential reference."""
+        cfg, params, ref = gen_setup
+        eng = make_gen_engine(cfg, params, make_container())
+        prompts = [[i + 1, (2 * i) % 200 + 1, (7 * i) % 150] for i in range(8)]
+        want = [ref(p, 5) for p in prompts]
+        results = [None] * len(prompts)
+
+        def worker(i):
+            results[i] = eng.generate(prompts[i], max_new_tokens=5, timeout=120)
+
+        try:
+            threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            for i, r in enumerate(results):
+                assert r is not None, f"request {i} did not complete"
+                assert r["tokens"] == want[i], f"request {i} diverged"
+        finally:
+            eng.stop()
+
+    def test_variable_prompt_lengths(self, gen_setup):
+        cfg, params, ref = gen_setup
+        eng = make_gen_engine(cfg, params, make_container())
+        prompts = [[7], [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13], [42, 17]]
+        try:
+            outs = [eng.generate(p, max_new_tokens=4, timeout=120) for p in prompts]
+            for p, o in zip(prompts, outs):
+                assert o["tokens"] == ref(p, 4)
+        finally:
+            eng.stop()
+
+    def test_eos_stops_generation(self, gen_setup):
+        cfg, params, ref = gen_setup
+        # pick the greedy 3rd token as "eos" so generation stops there
+        full = ref([11, 22, 33], 6)
+        eos = full[2]
+        eng = make_gen_engine(cfg, params, make_container(), eos_token_id=eos)
+        try:
+            out = eng.generate([11, 22, 33], max_new_tokens=6, timeout=60)
+            assert out["finish_reason"] == "stop"
+            assert out["tokens"] == full[:2]
+        finally:
+            eng.stop()
+
+    def test_sampling_temperature(self, gen_setup):
+        """temperature>0 samples (deterministic per engine seed), mixed
+        greedy+sampled requests coexist in one batch."""
+        cfg, params, ref = gen_setup
+        eng = make_gen_engine(cfg, params, make_container(), seed=3)
+        try:
+            greedy = eng.generate([4, 4, 4], max_new_tokens=5, temperature=0.0, timeout=60)
+            assert greedy["tokens"] == ref([4, 4, 4], 5)
+            hot = eng.generate([4, 4, 4], max_new_tokens=5, temperature=5.0, timeout=60)
+            assert len(hot["tokens"]) == 5
+            assert all(0 <= t < cfg.vocab_size for t in hot["tokens"])
+        finally:
+            eng.stop()
+
+    def test_streaming(self, gen_setup):
+        cfg, params, ref = gen_setup
+        eng = make_gen_engine(cfg, params, make_container())
+        try:
+            toks = list(eng.generate([9, 8, 7], max_new_tokens=4, stream=True, timeout=60))
+            assert toks == ref([9, 8, 7], 4)
+        finally:
+            eng.stop()
+
+    def test_prompt_too_long_rejected(self, gen_setup):
+        cfg, params, _ = gen_setup
+        eng = make_gen_engine(cfg, params, make_container())
+        try:
+            with pytest.raises(ValueError, match="max_len"):
+                eng.generate(list(range(100)), max_new_tokens=2, timeout=60)
+        finally:
+            eng.stop()
+
+    def test_timeout_frees_slot(self, gen_setup):
+        """A timed-out request raises AND its slot is reclaimed."""
+        cfg, params, ref = gen_setup
+        eng = make_gen_engine(cfg, params, make_container(), slots=2)
+        try:
+            with pytest.raises(RequestTimeout):
+                eng.generate([1, 2], max_new_tokens=10_000_000 % 50, timeout=1e-9)
+            # wait for the loop to notice and free the lane
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and any(s is not None for s in eng.slots):
+                time.sleep(0.05)
+            assert all(s is None for s in eng.slots)
+            # engine still serves
+            out = eng.generate([5, 3, 9], max_new_tokens=3, timeout=60)
+            assert out["tokens"] == ref([5, 3, 9], 3)
+        finally:
+            eng.stop()
+
+    def test_more_requests_than_slots_all_complete(self, gen_setup):
+        cfg, params, ref = gen_setup
+        eng = make_gen_engine(cfg, params, make_container(), slots=2, max_prefill_batch=1)
+        results = {}
+
+        def worker(i):
+            results[i] = eng.generate([i + 1], max_new_tokens=3, timeout=120)
+
+        try:
+            threads = [threading.Thread(target=worker, args=(i,)) for i in range(5)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert len(results) == 5
+            for i in range(5):
+                assert results[i]["tokens"] == ref([i + 1], 3)
+        finally:
+            eng.stop()
+
+    def test_metrics_recorded(self, gen_setup):
+        cfg, params, _ = gen_setup
+        c = make_container()
+        eng = make_gen_engine(cfg, params, c)
+        try:
+            eng.generate([1, 2, 3], max_new_tokens=4, timeout=60)
+            text = c.metrics.expose_text()
+            assert "app_tpu_step_seconds" in text
+            assert "app_tpu_batch_occupancy" in text
+            # prompt (3) + generated (4) tokens counted
+            assert c.metrics.get("app_tpu_tokens_total").value() >= 7
+            # compile happened at least twice (prefill + decode programs)
+            assert c.metrics.get("app_tpu_compile_total").value() >= 2
+        finally:
+            eng.stop()
+
+    def test_health_check(self, gen_setup):
+        cfg, params, _ = gen_setup
+        eng = make_gen_engine(cfg, params, make_container())
+        try:
+            eng.start()
+            h = eng.health_check()
+            assert h["status"] == "UP"
+        finally:
+            eng.stop()
+
+
+class TestBatchEngine:
+    def test_embed_batching_matches_single(self):
+        from gofr_tpu.models import bert
+
+        cfg = BertConfig.tiny()
+        params = bert.init(cfg, jax.random.key(0))
+
+        def apply(tokens, lengths):
+            return bert.embed_pooled(cfg, params, tokens, lengths)
+
+        eng = BatchEngine(apply, make_container(), max_batch=8, len_buckets=[8, 16])
+        try:
+            seqs = [list(range(1, 4)), list(range(5, 12)), [9]]
+            outs = [eng.infer(s, timeout=60) for s in seqs]
+            for s, o in zip(seqs, outs):
+                want = bert.embed_pooled(
+                    cfg, params,
+                    jnp.asarray([s + [0] * (8 - len(s))], jnp.int32),
+                    jnp.asarray([len(s)]),
+                )
+                np.testing.assert_allclose(np.asarray(o), np.asarray(want[0]), rtol=1e-4, atol=1e-5)
+        finally:
+            eng.stop()
+
+    def test_concurrent_embeds_batched_together(self):
+        from gofr_tpu.models import bert
+
+        cfg = BertConfig.tiny()
+        params = bert.init(cfg, jax.random.key(0))
+        calls = []
+
+        def apply(tokens, lengths):
+            calls.append(int(tokens.shape[0]))
+            return bert.embed_pooled(cfg, params, tokens, lengths)
+
+        c = make_container()
+        eng = BatchEngine(apply, c, max_batch=16, len_buckets=[8], max_wait_ms=200.0)
+        results = [None] * 6
+
+        def worker(i):
+            results[i] = eng.infer([i + 1, i + 2], timeout=60)
+
+        try:
+            # warm up compile first so the batching window isn't dominated by it
+            eng.infer([1, 2], timeout=60)
+            threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert all(r is not None for r in results)
+        finally:
+            eng.stop()
+
+    def test_classify_images(self):
+        from gofr_tpu.models import vit
+
+        cfg = ViTConfig.tiny()
+        params = vit.init(cfg, jax.random.key(0))
+
+        def apply(images):
+            return vit.forward(cfg, params, images)
+
+        eng = BatchEngine(apply, make_container(), max_batch=4)
+        try:
+            img = np.random.RandomState(0).randn(32, 32, 3).astype(np.float32)
+            out = eng.infer(img, timeout=60)
+            want = vit.forward(cfg, params, jnp.asarray(img)[None])[0]
+            np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-4, atol=1e-4)
+        finally:
+            eng.stop()
+
+    def test_error_propagates_to_caller(self):
+        def apply(tokens, lengths):
+            raise RuntimeError("boom")
+
+        eng = BatchEngine(apply, make_container(), max_batch=2)
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                eng.infer([1, 2, 3], timeout=60)
+        finally:
+            eng.stop()
+
+
+class TestBuildEngine:
+    def test_build_generate_engine_random_init(self):
+        c = make_container()
+        spec = ModelSpec("llama", LlamaConfig.tiny(), task="generate", dtype=jnp.float32)
+        eng = build_engine(spec, c, slots=2, max_len=32)
+        try:
+            out = eng.generate([1, 2, 3], max_new_tokens=2, timeout=120)
+            assert len(out["tokens"]) == 2
+        finally:
+            eng.stop()
+
+    def test_build_embed_engine(self):
+        c = make_container()
+        spec = ModelSpec("bert", BertConfig.tiny(), task="embed", dtype=jnp.float32)
+        eng = build_engine(spec, c)
+        try:
+            emb = eng.infer([4, 5, 6], timeout=120)
+            assert emb.shape == (32,)
+        finally:
+            eng.stop()
+
+    def test_build_rejects_unknown_task(self):
+        spec = ModelSpec("llama", LlamaConfig.tiny(), task="nonsense")
+        with pytest.raises(ValueError, match="unknown task"):
+            build_engine(spec, make_container())
+
+    def test_container_integration(self):
+        """serve_model → ctx-style container.generate round trip."""
+        c = make_container()
+        spec = ModelSpec("llama", LlamaConfig.tiny(), task="generate", dtype=jnp.float32)
+        eng = build_engine(spec, c, slots=2, max_len=32)
+        c.register_engine("lm", eng)
+        try:
+            out = c.generate("lm", [3, 1, 4], max_new_tokens=2, timeout=120)
+            assert len(out["tokens"]) == 2
+            health = c.health()
+            assert "model:lm" in health["services"]
+        finally:
+            eng.stop()

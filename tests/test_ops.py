@@ -221,6 +221,25 @@ class TestSampling:
         toks = sample_token(logits, jax.random.key(0), top_p=1e-9)
         np.testing.assert_array_equal(np.asarray(toks), [0] * 8)
 
+    def test_scalar_zero_temperature_is_greedy(self):
+        logits = jnp.array([[0.0, 5.0, 1.0], [9.0, 0.0, 0.0]])
+        toks = sample_token(logits, jax.random.key(0), temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(toks), [1, 0])
+        toks = sample_token(logits, jax.random.key(0), temperature=-1.0)
+        np.testing.assert_array_equal(np.asarray(toks), [1, 0])
+
+    def test_per_row_temperature_mixes_greedy_and_sampled(self):
+        logits = jnp.array([[0.0, 5.0, 1.0]] * 4)
+        temps = jnp.array([0.0, 0.0, 8.0, 8.0])
+        toks = np.asarray(sample_token(logits, jax.random.key(2), temperature=temps))
+        assert toks[0] == 1 and toks[1] == 1
+        assert all(0 <= t < 3 for t in toks)
+
+    def test_top_p_zero_degrades_to_greedy(self):
+        logits = jnp.array([[3.0, 1.0, 0.0]] * 8)
+        toks = sample_token(logits, jax.random.key(0), top_p=0.0)
+        np.testing.assert_array_equal(np.asarray(toks), [0] * 8)
+
     def test_temperature_is_traced(self):
         """Same compiled fn serves different temperatures (no recompile)."""
         f = jax.jit(lambda lg, key, t: sample_token(lg, key, temperature=t))

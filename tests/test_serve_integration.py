@@ -1,0 +1,94 @@
+"""Full-stack serving integration: HTTP entrypoint → Context →
+continuous-batching engine → model on the device mesh — the framework's
+"minimum end-to-end slice" (SURVEY.md §7 stage 3), hermetic on CPU.
+"""
+
+import threading
+
+import httpx
+import jax.numpy as jnp
+import pytest
+
+from tests.test_http_server import AppHarness, make_app
+from gofr_tpu.models import LlamaConfig, BertConfig, ModelSpec
+
+
+@pytest.fixture
+def lm_app():
+    app = make_app()
+    spec = ModelSpec("llama", LlamaConfig.tiny(), task="generate", dtype=jnp.float32)
+    app.serve_model("lm", spec, slots=2, max_len=32)
+
+    def generate(ctx):
+        body = ctx.bind(dict)
+        out = ctx.generate("lm", body["prompt"], max_new_tokens=int(body.get("max_new_tokens", 4)),
+                           timeout=120)
+        return out
+
+    app.post("/generate", generate)
+    return app
+
+
+def test_generate_over_http(lm_app):
+    with AppHarness(lm_app) as h, httpx.Client(base_url=h.base, timeout=180) as client:
+        r = client.post("/generate", json={"prompt": [1, 2, 3], "max_new_tokens": 3})
+        assert r.status_code == 201, r.text
+        data = r.json()["data"]
+        assert len(data["tokens"]) == 3
+        assert data["finish_reason"] == "length"
+
+        # concurrent requests batch through the slots
+        results = []
+
+        def call(i):
+            rr = client.post("/generate", json={"prompt": [i + 1, 5], "max_new_tokens": 2})
+            results.append(rr.status_code)
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert results == [201, 201, 201, 201]
+
+        # engine surfaced in health
+        r = client.get("/.well-known/health")
+        services = r.json()["data"]["services"]
+        assert services["model:lm"]["status"] == "UP"
+        assert services["tpu"]["status"] == "UP"
+
+
+def test_embed_over_http():
+    app = make_app()
+    spec = ModelSpec("bert", BertConfig.tiny(), task="embed", dtype=jnp.float32)
+    app.serve_model("embedder", spec)
+
+    def embed(ctx):
+        body = ctx.bind(dict)
+        vec = ctx.infer("embedder", body["tokens"], timeout=120)
+        return {"embedding": [float(x) for x in vec], "dim": len(vec)}
+
+    app.post("/embed", embed)
+
+    with AppHarness(app) as h, httpx.Client(base_url=h.base, timeout=180) as client:
+        r = client.post("/embed", json={"tokens": [4, 9, 2]})
+        assert r.status_code == 201, r.text
+        data = r.json()["data"]
+        assert data["dim"] == 32
+        norm = sum(x * x for x in data["embedding"]) ** 0.5
+        assert abs(norm - 1.0) < 1e-4
+
+        # serving metrics visible on the metrics port
+        m = httpx.get(f"http://127.0.0.1:{app.metrics_port}/metrics")
+        assert "app_tpu_step_seconds" in m.text
+        assert "app_tpu_device_count" in m.text
+
+
+def test_unknown_model_is_client_error(lm_app):
+    def bad(ctx):
+        return ctx.generate("nope", [1], timeout=5)
+
+    lm_app.post("/bad", bad)
+    with AppHarness(lm_app) as h, httpx.Client(base_url=h.base, timeout=60) as client:
+        r = client.post("/bad", json={})
+        assert r.status_code == 500

@@ -1,0 +1,69 @@
+"""TPU device datasource tests (container.tpu) on the virtual CPU mesh."""
+
+import jax
+
+from gofr_tpu.config import DictConfig
+from gofr_tpu.container import Container, new_mock_container
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.metrics import Registry
+from gofr_tpu.tpu.device import TPUDevices
+
+
+def _registry() -> Registry:
+    """Registry with the framework's app_tpu_* metrics registered (names
+    unknown to the registry are silently ignored, gofr-style)."""
+    return new_mock_container().metrics
+
+
+def make(conf=None):
+    return TPUDevices(DictConfig(conf or {}), MockLogger(), _registry())
+
+
+def test_defaults_all_devices_on_dp():
+    t = make()
+    assert len(t.devices) == 8
+    assert t.mesh.axis_names == ("dp",)
+
+
+def test_mesh_from_config():
+    t = make({"TPU_MESH": "dp:2,tp:4"})
+    assert t.mesh.devices.shape == (2, 4)
+    assert t.mesh.axis_names == ("dp", "tp")
+
+
+def test_device_cap():
+    t = make({"TPU_DEVICES": "4", "TPU_MESH": "tp:4"})
+    assert len(t.devices) == 4
+
+
+def test_health_check_up():
+    t = make({"TPU_MESH": "tp:-1"})
+    h = t.health_check()
+    assert h["status"] == "UP"
+    assert h["details"]["devices"] == 8
+    assert h["details"]["mesh"] == {"tp": 8}
+    assert set(h["details"]["memory"]) == {str(d.id) for d in t.devices}
+
+
+def test_compile_counter():
+    reg = _registry()
+    t = TPUDevices(DictConfig({}), MockLogger(), reg)
+    t.record_compile()
+    t.record_compile()
+    assert t.compile_count == 2
+    assert reg.get("app_tpu_compile_total").value() == 2
+
+
+def test_container_lazily_wires_tpu():
+    c = new_mock_container()
+    assert not c.tpu_wired
+    tpu = c.tpu
+    assert c.tpu_wired
+    assert tpu is c.tpu  # cached
+    assert c.health()["services"]["tpu"]["status"] == "UP"
+
+
+def test_device_count_gauge():
+    reg = _registry()
+    TPUDevices(DictConfig({}), MockLogger(), reg)
+    assert reg.get("app_tpu_device_count").value() == 8
