@@ -30,7 +30,7 @@ from jax import lax
 from gofr_tpu.models.base import fan_in_init, truncated_normal
 from gofr_tpu.ops import apply_rope, mha_attention, rms_norm, rope_table
 from gofr_tpu.ops.attention import decode_attention
-from gofr_tpu.ops.kvcache import SlotKVCache
+from gofr_tpu.ops.kvcache import SlotKVCache, append_tokens, write_prompts
 
 
 @dataclass(frozen=True)
@@ -209,9 +209,7 @@ def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.nd
         q, k, v = _qkv(cfg, lp, x)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
-        # write the prompt K/V into each row's slot: [B,S] scatter
-        k_layer = k_layer.at[slots[:, None], jnp.arange(s)[None, :]].set(k.astype(k_layer.dtype))
-        v_layer = v_layer.at[slots[:, None], jnp.arange(s)[None, :]].set(v.astype(v_layer.dtype))
+        k_layer, v_layer = write_prompts(k_layer, v_layer, slots, k, v)
         attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
         x = x + attn.reshape(b, s, -1) @ lp["wo"]
         x = x + _mlp(cfg, lp, x)
@@ -239,7 +237,6 @@ def decode_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, positions: 
     cos, sin = _rope(cfg)
     x = params["embed"][tokens].astype(cfg.dtype)  # [N,E]
     n = tokens.shape[0]
-    row = jnp.arange(n)
     pos1 = positions[:, None]  # [N,1]
 
     def body(x, xs):
@@ -248,8 +245,7 @@ def decode_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, positions: 
         q = apply_rope(q, pos1, cos, sin)[:, 0]  # [N,Hq,D]
         k = apply_rope(k, pos1, cos, sin)[:, 0]
         v = v[:, 0]
-        k_layer = k_layer.at[row, positions].set(k.astype(k_layer.dtype))
-        v_layer = v_layer.at[row, positions].set(v.astype(v_layer.dtype))
+        k_layer, v_layer = append_tokens(k_layer, v_layer, positions, k, v)
         attn = decode_attention(q, k_layer, v_layer, positions + 1)
         x = x + attn.reshape(n, -1) @ lp["wo"]
         x = x + _mlp(cfg, lp, x)

@@ -17,6 +17,20 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def resolve_backend(backend: str) -> str:
+    """'auto' picks the Pallas kernels when the platform supports them
+    (TPU, or CPU under the interpreter for tests) and XLA otherwise; an
+    explicit 'pallas' likewise degrades to 'xla' off-TPU so one model code
+    path serves the test mesh and real chips."""
+    if backend in ("auto", "pallas"):
+        from gofr_tpu.ops.pallas import flash_attention_available
+
+        return "pallas" if flash_attention_available() else "xla"
+    if backend != "xla":
+        raise ValueError(f"unknown attention backend {backend!r}; use 'auto', 'xla' or 'pallas'")
+    return backend
+
+
 def _group_query_heads(q: jnp.ndarray, num_kv_heads: int) -> jnp.ndarray:
     """[B, S, Hq, D] → [B, S, Hkv, G, D]."""
     b, s, hq, d = q.shape
@@ -35,7 +49,7 @@ def mha_attention(
     kv_lengths: jnp.ndarray | None = None,
     bias: jnp.ndarray | None = None,
     scale: float | None = None,
-    backend: str = "xla",
+    backend: str = "auto",
 ) -> jnp.ndarray:
     """Full (prefill) attention.
 
@@ -46,18 +60,15 @@ def mha_attention(
     ``kv_lengths`` [B] masks padded key positions. ``bias`` is an additive
     [B, 1|Hq, Sq, Skv] mask/ALiBi-style term.
     """
-    if backend == "pallas":
-        from gofr_tpu.ops.pallas import flash_attention_available
+    backend = resolve_backend(backend)
+    if backend == "pallas" and bias is None:  # kernel has no bias path
+        from gofr_tpu.ops.pallas import interpret_mode
+        from gofr_tpu.ops.pallas.flash_attention import flash_attention
 
-        if flash_attention_available():
-            from gofr_tpu.ops.pallas.flash_attention import flash_attention
-
-            return flash_attention(
-                q, k, v, causal=causal, q_offset=q_offset, kv_lengths=kv_lengths, scale=scale
-            )
-        backend = "xla"  # CPU/unsupported platform: fall back (kernels are TPU-only)
-    elif backend != "xla":
-        raise ValueError(f"unknown attention backend {backend!r}; use 'xla' or 'pallas'")
+        return flash_attention(
+            q, k, v, causal=causal, q_offset=q_offset, kv_lengths=kv_lengths,
+            scale=scale, interpret=interpret_mode(),
+        )
 
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -109,17 +120,30 @@ def decode_attention(
     lengths: jnp.ndarray,
     *,
     scale: float | None = None,
-    backend: str = "xla",
+    backend: str = "auto",
 ) -> jnp.ndarray:
-    """Single-step decode: q [B, Hq, D] against cache [B, Smax, Hkv, D],
-    attending to positions < lengths[b]. Returns [B, Hq, D]."""
-    out = mha_attention(
-        q[:, None],
-        k_cache,
-        v_cache,
-        causal=False,
-        kv_lengths=lengths,
-        scale=scale,
-        backend=backend,
-    )
-    return out[:, 0]
+    """Single-step decode: q [B, Hq, D] against a head-major cache
+    [B, Hkv, Smax, D], attending to positions < lengths[b]. Returns
+    [B, Hq, D]."""
+    if resolve_backend(backend) == "pallas":
+        from gofr_tpu.ops.pallas import interpret_mode
+        from gofr_tpu.ops.pallas.decode_attention import _pick_block
+        from gofr_tpu.ops.pallas.decode_attention import decode_attention as pallas_decode
+
+        smax = k_cache.shape[2]
+        # An awkward Smax (e.g. prime) would degrade the kernel's kv block to
+        # a sliver and serialize the grid; the XLA path is faster then.
+        if _pick_block(smax, 512) >= min(smax, 128):
+            return pallas_decode(
+                q, k_cache, v_cache, lengths, scale=scale, interpret=interpret_mode()
+            )
+    b, hq, d = q.shape
+    _, hkv, smax, _ = k_cache.shape
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    qg = q.reshape(b, hkv, hq // hkv, d)  # head h groups under kv head h // G
+    scores = jnp.einsum("bkgd,bktd->bkgt", qg, k_cache).astype(jnp.float32) * scale
+    mask = jnp.arange(smax)[None, :] < lengths[:, None]  # [B, Smax]
+    scores = jnp.where(mask[:, None, None], scores, NEG_INF)
+    probs = _softmax(scores)
+    out = jnp.einsum("bkgt,bktd->bkgd", probs.astype(v_cache.dtype), v_cache)
+    return out.reshape(b, hq, d)

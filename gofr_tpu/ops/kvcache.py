@@ -1,6 +1,6 @@
 """Slot-based KV cache for continuous batching.
 
-One static buffer of shape [layers, slots, max_len, kv_heads, head_dim]
+One static buffer of shape [layers, slots, kv_heads, max_len, head_dim]
 per K and V. The serving engine owns slot assignment: an arriving request
 claims a free slot, prefill writes its prompt at offset 0, each decode
 step appends one token at ``positions[slot]``, and the slot is recycled on
@@ -9,7 +9,12 @@ the whole serving lifetime — the continuous-batching analog of the
 reference's goroutine-per-request hot path (SURVEY.md §3.2).
 
 Layout note: layers lead so a ``lax.scan`` over layers can carry the cache
-as its xs/ys; [slots, max_len] next so per-slot scatters are contiguous.
+as its xs/ys. Heads sit AHEAD of sequence (head-major) so the decode/flash
+Pallas kernels can block one [block_kv, head_dim] tile per (slot, kv_head)
+straight out of HBM — TPU tiling requires the last two dims of a block to
+be (8k, 128k)-aligned, which a seq-major layout cannot satisfy per-head.
+Activations stay [B, S, H, D]; the helpers below transpose at the write,
+which XLA fuses into the scatter.
 """
 
 from __future__ import annotations
@@ -23,8 +28,8 @@ import jax.numpy as jnp
 @jax.tree_util.register_dataclass
 @dataclass
 class SlotKVCache:
-    k: jnp.ndarray  # [L, B, Smax, Hkv, D]
-    v: jnp.ndarray  # [L, B, Smax, Hkv, D]
+    k: jnp.ndarray  # [L, B, Hkv, Smax, D]
+    v: jnp.ndarray  # [L, B, Hkv, Smax, D]
 
     @classmethod
     def create(
@@ -36,7 +41,7 @@ class SlotKVCache:
         head_dim: int,
         dtype=jnp.bfloat16,
     ) -> "SlotKVCache":
-        shape = (layers, slots, max_len, kv_heads, head_dim)
+        shape = (layers, slots, kv_heads, max_len, head_dim)
         return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
     @property
@@ -49,7 +54,26 @@ class SlotKVCache:
 
     @property
     def max_len(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
+
+
+def write_prompts(
+    k_layer: jnp.ndarray,
+    v_layer: jnp.ndarray,
+    slots: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write prefilled prompts [B, S, Hkv, D] (activation layout) into rows
+    ``slots`` [B] at offsets 0..S. ``k_layer``/``v_layer`` are per-layer
+    views [Slots, Hkv, Smax, D]."""
+    b, s, hkv, _ = k_new.shape
+    rows = slots[:, None, None]
+    heads = jnp.arange(hkv)[None, :, None]
+    pos = jnp.arange(s)[None, None, :]
+    k_layer = k_layer.at[rows, heads, pos].set(k_new.swapaxes(1, 2).astype(k_layer.dtype))
+    v_layer = v_layer.at[rows, heads, pos].set(v_new.swapaxes(1, 2).astype(v_layer.dtype))
+    return k_layer, v_layer
 
 
 def write_prompt(
@@ -59,11 +83,9 @@ def write_prompt(
     k_new: jnp.ndarray,
     v_new: jnp.ndarray,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Write a prefilled prompt [S, Hkv, D] into one slot at offset 0.
-    ``k_layer``/``v_layer`` are per-layer views [B, Smax, Hkv, D]."""
-    k_layer = jax.lax.dynamic_update_slice(k_layer, k_new[None], (slot, 0, 0, 0))
-    v_layer = jax.lax.dynamic_update_slice(v_layer, v_new[None], (slot, 0, 0, 0))
-    return k_layer, v_layer
+    """Single-slot write of one prompt [S, Hkv, D] at offset 0."""
+    slot = jnp.asarray(slot)[None]
+    return write_prompts(k_layer, v_layer, slot, k_new[None], v_new[None])
 
 
 def append_tokens(
@@ -75,8 +97,10 @@ def append_tokens(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Append one token's K/V per slot: k_new [B, Hkv, D] written at
     ``positions`` [B] in each slot's sequence dimension."""
-    b = k_layer.shape[0]
-    idx = jnp.arange(b)
-    k_layer = k_layer.at[idx, positions].set(k_new)
-    v_layer = v_layer.at[idx, positions].set(v_new)
+    b, hkv, _ = k_new.shape
+    rows = jnp.arange(b)[:, None]
+    heads = jnp.arange(hkv)[None, :]
+    pos = positions[:, None]
+    k_layer = k_layer.at[rows, heads, pos].set(k_new.astype(k_layer.dtype))
+    v_layer = v_layer.at[rows, heads, pos].set(v_new.astype(v_layer.dtype))
     return k_layer, v_layer

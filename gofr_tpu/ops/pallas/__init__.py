@@ -1,18 +1,37 @@
-"""Hand-written Pallas TPU kernels (flash attention, paged decode).
+"""Hand-written Pallas TPU kernels (flash attention, slot-cache decode).
 
 Kernels target the TPU memory hierarchy (HBM→VMEM blocks, MXU-sized
-tiles) and are unavailable on CPU — callers go through
-``flash_attention_available()`` and fall back to the XLA path, so the
-same model code runs on the test mesh and real chips.
+tiles). On CPU they run only under the Pallas interpreter — set
+``GOFR_PALLAS_INTERPRET=1``, as tests/test_pallas.py does for its parity
+cases (the rest of the suite runs the XLA path) — otherwise callers go
+through ``flash_attention_available()`` and fall back to the XLA path, so
+the same model code runs on the test mesh and real chips.
+
+``GOFR_PALLAS=0`` force-disables the kernels even on TPU (escape hatch /
+A-B benchmarking).
 """
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 
+def interpret_mode() -> bool:
+    """True when kernels should run under the Pallas interpreter (CPU tests)."""
+    return os.environ.get("GOFR_PALLAS_INTERPRET", "") == "1"
+
+
 def flash_attention_available() -> bool:
+    if os.environ.get("GOFR_PALLAS", "") == "0":
+        return False
+    if interpret_mode():
+        return True
     try:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:  # noqa: BLE001
         return False
+
+
+__all__ = ["flash_attention_available", "interpret_mode"]

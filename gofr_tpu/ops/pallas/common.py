@@ -1,0 +1,57 @@
+"""Shared pieces of the blocked online-softmax recurrence.
+
+Both flash (prefill) and decode kernels carry (m, l, acc) scratch across
+sequential kv-block grid steps; the numerics — the NEG_INF fully-masked-row
+guard and the normalizer clamp — must stay identical between them, so they
+live here once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def init_softmax_scratch(ki, acc_ref, m_ref, l_ref) -> None:
+    """Zero the accumulators at the first kv block of each output tile."""
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+
+def softmax_block_update(s, v, acc_ref, m_ref, l_ref) -> None:
+    """One online-softmax step: fold masked scores ``s`` [rows, block_kv]
+    (f32, masked entries == NEG_INF) and values ``v`` [block_kv, d] into the
+    running (acc, m, l) scratch. Fully-masked-so-far rows keep l == 0 so the
+    final divide yields zeros, not NaN."""
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    # exp against a safe 0 for all-masked rows keeps exp(NEG_INF) == 0
+    # instead of exp(0) == 1.
+    m_safe = jnp.where(m_next > NEG_INF / 2, m_next, 0.0)
+
+    p = jnp.exp(s - m_safe)          # masked entries underflow to 0
+    alpha = jnp.exp(m_prev - m_safe)  # rescale of previous blocks
+    l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_next, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_next, l_ref.shape)
+
+
+def softmax_finish(ki, n_kvb, acc_ref, l_ref, write) -> None:
+    """After the last kv block, normalize and hand the tile to ``write``."""
+
+    @pl.when(ki == n_kvb - 1)
+    def _():
+        write(acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-20))

@@ -171,9 +171,9 @@ class TestAttention:
         k = jax.random.normal(jax.random.key(1), (1, s, 2, 8))
         v = jax.random.normal(jax.random.key(2), (1, s, 2, 8))
         full = mha_attention(q, k, v, causal=True)
-        # cache padded beyond the real length
-        k_pad = jnp.pad(k, ((0, 0), (0, 10), (0, 0), (0, 0)))
-        v_pad = jnp.pad(v, ((0, 0), (0, 10), (0, 0), (0, 0)))
+        # head-major cache padded beyond the real length
+        k_pad = jnp.pad(k.swapaxes(1, 2), ((0, 0), (0, 0), (0, 10), (0, 0)))
+        v_pad = jnp.pad(v.swapaxes(1, 2), ((0, 0), (0, 0), (0, 10), (0, 0)))
         dec = decode_attention(q[:, -1], k_pad, v_pad, jnp.array([s]))
         np.testing.assert_allclose(np.asarray(dec), np.asarray(full[:, -1]), rtol=1e-4, atol=1e-5)
 
@@ -181,23 +181,23 @@ class TestAttention:
 class TestKVCache:
     def test_create_shapes(self):
         c = SlotKVCache.create(layers=2, slots=3, max_len=16, kv_heads=2, head_dim=4)
-        assert c.k.shape == (2, 3, 16, 2, 4)
+        assert c.k.shape == (2, 3, 2, 16, 4)  # head-major: [L, B, Hkv, Smax, D]
         assert c.num_layers == 2 and c.num_slots == 3 and c.max_len == 16
 
     def test_write_prompt_and_append(self):
         c = SlotKVCache.create(1, 2, 8, 1, 4, dtype=jnp.float32)
-        k_new = jnp.ones((3, 1, 4))
+        k_new = jnp.ones((3, 1, 4))  # [S, Hkv, D] activation layout
         v_new = jnp.full((3, 1, 4), 2.0)
         k_l, v_l = write_prompt(c.k[0], c.v[0], jnp.int32(1), k_new, v_new)
-        np.testing.assert_array_equal(np.asarray(k_l[1, :3]), np.ones((3, 1, 4)))
-        np.testing.assert_array_equal(np.asarray(k_l[0]), np.zeros((8, 1, 4)))
+        np.testing.assert_array_equal(np.asarray(k_l[1, :, :3]), np.ones((1, 3, 4)))
+        np.testing.assert_array_equal(np.asarray(k_l[0]), np.zeros((1, 8, 4)))
         # append one token per slot at different positions
         k_tok = jnp.full((2, 1, 4), 5.0)
         v_tok = jnp.full((2, 1, 4), 6.0)
         k_l, v_l = append_tokens(k_l, v_l, jnp.array([0, 3]), k_tok, v_tok)
-        np.testing.assert_array_equal(np.asarray(k_l[0, 0]), np.full((1, 4), 5.0))
-        np.testing.assert_array_equal(np.asarray(k_l[1, 3]), np.full((1, 4), 5.0))
-        np.testing.assert_array_equal(np.asarray(v_l[1, 3]), np.full((1, 4), 6.0))
+        np.testing.assert_array_equal(np.asarray(k_l[0, :, 0]), np.full((1, 4), 5.0))
+        np.testing.assert_array_equal(np.asarray(k_l[1, :, 3]), np.full((1, 4), 5.0))
+        np.testing.assert_array_equal(np.asarray(v_l[1, :, 3]), np.full((1, 4), 6.0))
 
 
 class TestSampling:

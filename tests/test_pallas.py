@@ -1,0 +1,100 @@
+"""Pallas kernels vs the XLA reference path, run under the Pallas
+interpreter on the CPU test mesh (SURVEY.md §4 analog: hermetic device
+tests without TPU hardware)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops.attention import decode_attention, mha_attention
+from gofr_tpu.ops.pallas.decode_attention import decode_attention as pallas_decode
+from gofr_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _qkv(key, b, sq, skv, hq, hkv, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, sq, hq, d), dtype)
+    k = jax.random.normal(kk, (b, skv, hkv, d), dtype)
+    v = jax.random.normal(kv, (b, skv, hkv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2)])
+def test_flash_matches_xla_causal(hq, hkv):
+    q, k, v = _qkv(jax.random.key(0), 2, 64, 64, hq, hkv, 32)
+    want = mha_attention(q, k, v, causal=True, backend="xla")
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_kv_lengths_and_offset():
+    b, sq, skv = 3, 24, 48
+    q, k, v = _qkv(jax.random.key(1), b, sq, skv, 4, 2, 16)
+    lengths = jnp.array([48, 17, 1], jnp.int32)
+    offset = jnp.array([24, 5, 0], jnp.int32)
+    want = mha_attention(
+        q, k, v, causal=True, q_offset=offset, kv_lengths=lengths, backend="xla"
+    )
+    got = flash_attention(
+        q, k, v, causal=True, q_offset=offset, kv_lengths=lengths, interpret=True
+    )
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_non_causal_padded_blocks():
+    # seq lengths that don't divide the block size exercise the pad path
+    q, k, v = _qkv(jax.random.key(2), 2, 9, 21, 2, 2, 8)
+    lengths = jnp.array([21, 13], jnp.int32)
+    want = mha_attention(q, k, v, causal=False, kv_lengths=lengths, backend="xla")
+    got = flash_attention(q, k, v, causal=False, kv_lengths=lengths, interpret=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_fully_masked_rows_zero():
+    q, k, v = _qkv(jax.random.key(3), 1, 8, 8, 2, 2, 8)
+    lengths = jnp.array([0], jnp.int32)  # nothing visible
+    got = flash_attention(q, k, v, causal=False, kv_lengths=lengths, interpret=True)
+    assert not np.isnan(np.asarray(got)).any()
+    np.testing.assert_allclose(got, jnp.zeros_like(got), atol=1e-7)
+
+
+@pytest.mark.parametrize("hq,hkv,smax", [(4, 2, 64), (8, 8, 96)])
+def test_decode_matches_xla(hq, hkv, smax):
+    b, d = 4, 16
+    key = jax.random.key(4)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, hq, d))
+    k_cache = jax.random.normal(kk, (b, hkv, smax, d))
+    v_cache = jax.random.normal(kv, (b, hkv, smax, d))
+    lengths = jnp.array([1, 7, smax, smax // 2], jnp.int32)
+    want = decode_attention(q, k_cache, v_cache, lengths, backend="xla")
+    got = pallas_decode(q, k_cache, v_cache, lengths, interpret=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_auto_backend_dispatches_interpret(monkeypatch):
+    monkeypatch.setenv("GOFR_PALLAS_INTERPRET", "1")
+    q, k, v = _qkv(jax.random.key(5), 1, 16, 16, 2, 2, 8)
+    want = mha_attention(q, k, v, causal=True, backend="xla")
+    got = mha_attention(q, k, v, causal=True, backend="auto")
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_llama_forward_with_pallas_backend(monkeypatch):
+    """Whole-model parity: tiny Llama forward, XLA vs Pallas-interpret."""
+    monkeypatch.setenv("GOFR_PALLAS", "0")
+    from gofr_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    lengths = jnp.array([32, 20], jnp.int32)
+    want = llama.forward(cfg, params, tokens, lengths)
+
+    monkeypatch.setenv("GOFR_PALLAS", "1")
+    monkeypatch.setenv("GOFR_PALLAS_INTERPRET", "1")
+    jax.clear_caches()  # backend resolution happens at trace time
+    got = llama.forward(cfg, params, tokens, lengths)
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+    jax.clear_caches()
