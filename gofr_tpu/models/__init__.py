@@ -5,7 +5,7 @@ capability the TPU build adds: decoder LMs for /generate, encoders for
 embedding and classification endpoints, all shardable via logical axes.
 """
 
-from gofr_tpu.models import bert, llama, vit
+from gofr_tpu.models import bert, llama, mixtral, vit
 from gofr_tpu.models.base import (
     ModelSpec,
     cast_floats,
@@ -15,19 +15,23 @@ from gofr_tpu.models.base import (
     register_family,
 )
 from gofr_tpu.models.llama import LlamaConfig
+from gofr_tpu.models.mixtral import MixtralConfig
 from gofr_tpu.models.bert import BertConfig
 from gofr_tpu.models.vit import ViTConfig
 
 register_family("llama", llama)
+register_family("mixtral", mixtral)
 register_family("bert", bert)
 register_family("vit", vit)
 
 __all__ = [
     "ModelSpec",
     "LlamaConfig",
+    "MixtralConfig",
     "BertConfig",
     "ViTConfig",
     "llama",
+    "mixtral",
     "bert",
     "vit",
     "cast_floats",

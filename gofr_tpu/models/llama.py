@@ -164,11 +164,17 @@ def _mlp(cfg: LlamaConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
 # -- entry points --------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnums=0)
+@partial(jax.jit, static_argnums=(0, 4))
 def forward(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
-            lengths: jnp.ndarray | None = None) -> jnp.ndarray:
+            lengths: jnp.ndarray | None = None,
+            attn_fn: Any = None) -> jnp.ndarray:
     """Full causal forward, no cache: tokens [B,S] → logits [B,S,V] (f32).
-    ``lengths`` masks padded positions out of attention."""
+    ``lengths`` masks padded positions out of attention.
+
+    ``attn_fn`` swaps the attention implementation (static; same contract
+    as ops.mha_attention) — e.g. a mesh-bound ring/Ulysses sequence-parallel
+    attention from gofr_tpu.parallel.ring.make_seq_parallel_attn."""
+    attn = attn_fn or mha_attention
     cos, sin = _rope(cfg)
     x = params["embed"][tokens].astype(cfg.dtype)
     b, s = tokens.shape
@@ -178,12 +184,74 @@ def forward(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
         q, k, v = _qkv(cfg, lp, x)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
-        attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
-        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        a = attn(q, k, v, causal=True, kv_lengths=lengths)
+        x = x + a.reshape(b, s, -1) @ lp["wo"]
         x = x + _mlp(cfg, lp, x)
         return x, None
 
     x, _ = lax.scan(body, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (x @ head).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnums=(0, 4, 5))
+def forward_pipelined(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
+                      lengths: jnp.ndarray, mesh: Any,
+                      microbatches: int = 4) -> jnp.ndarray:
+    """Pipeline-parallel full forward: blocks shard over the mesh's ``pp``
+    axis (leading layers dim) and microbatches stream through the stage
+    ring (gofr_tpu.parallel.pipeline). Embed/norm/head stay replicated.
+    Requires num_layers % pp == 0 and batch % microbatches == 0.
+
+    Composes with tp: heads/mlp dims of the stage weights stay tp-sharded
+    inside the pipeline region (manual Megatron-style psums after wo and
+    w_down), so pp×tp meshes neither replicate weights nor duplicate
+    compute."""
+    from gofr_tpu.parallel.pipeline import make_pipeline_forward
+    from gofr_tpu.parallel.sharding import ShardingRules
+
+    cos, sin = _rope(cfg)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    s = tokens.shape[1]
+    positions = jnp.arange(s)[None]
+    d = cfg.head_size
+    tp = "tp" if "tp" in mesh.axis_names and mesh.shape["tp"] > 1 else None
+
+    def stage(blocks_local, x, lens):
+        b = x.shape[0]
+
+        def body(x, lp):
+            # local-head qkv: head counts come from the tp-sharded weights
+            h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+            q = (h @ lp["wq"]).reshape(b, s, -1, d)
+            k = (h @ lp["wk"]).reshape(b, s, -1, d)
+            v = (h @ lp["wv"]).reshape(b, s, -1, d)
+            q = apply_rope(q, positions, cos, sin)
+            k = apply_rope(k, positions, cos, sin)
+            a = mha_attention(q, k, v, causal=True, kv_lengths=lens)
+            o = a.reshape(b, s, -1) @ lp["wo"]
+            if tp:
+                o = lax.psum(o, tp)
+            x = x + o
+            h2 = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+            mo = (jax.nn.silu(h2 @ lp["w_gate"]) * (h2 @ lp["w_up"])) @ lp["w_down"]
+            if tp:
+                mo = lax.psum(mo, tp)
+            return x + mo, None
+
+        x, _ = lax.scan(body, x, blocks_local)
+        return x
+
+    rules = ShardingRules().with_overrides(layers="pp")
+    block_specs = {
+        name: rules.spec(axes, mesh)
+        for name, axes in param_axes(cfg)["blocks"].items()
+    }
+    pp_forward = make_pipeline_forward(
+        mesh, microbatches=microbatches, param_specs=block_specs
+    )
+    x = pp_forward(stage, params["blocks"], x, lengths)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     return (x @ head).astype(jnp.float32)

@@ -12,6 +12,9 @@ serving sizes); ``backend="pallas"`` dispatches to the flash kernels in
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
@@ -62,13 +65,11 @@ def mha_attention(
     """
     backend = resolve_backend(backend)
     if backend == "pallas" and bias is None:  # kernel has no bias path
-        from gofr_tpu.ops.pallas import interpret_mode
-        from gofr_tpu.ops.pallas.flash_attention import flash_attention
-
-        return flash_attention(
-            q, k, v, causal=causal, q_offset=q_offset, kv_lengths=kv_lengths,
-            scale=scale, interpret=interpret_mode(),
-        )
+        if not isinstance(q_offset, jnp.ndarray):
+            q_offset = jnp.asarray(q_offset, jnp.int32)
+        if kv_lengths is None:
+            kv_lengths = jnp.full((q.shape[0],), k.shape[1], jnp.int32)
+        return _flash_mha(q, k, v, q_offset, kv_lengths, causal, scale)
 
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
@@ -102,6 +103,41 @@ def mha_attention(
     probs = _softmax(scores)
     out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(v.dtype), v)
     return out.reshape(b, sq, hq, d)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash_mha(q, k, v, q_offset, kv_lengths, causal, scale):
+    """Pallas flash forward with an XLA-recompute backward: pallas_call has
+    no JVP rule, so gradients re-derive the attention via the einsum path
+    (flash-style recompute — no S×S tensor is saved between fwd and bwd)."""
+    from gofr_tpu.ops.pallas import interpret_mode
+    from gofr_tpu.ops.pallas.flash_attention import flash_attention
+
+    return flash_attention(
+        q, k, v, causal=causal, q_offset=q_offset, kv_lengths=kv_lengths,
+        scale=scale, interpret=interpret_mode(),
+    )
+
+
+def _flash_mha_fwd(q, k, v, q_offset, kv_lengths, causal, scale):
+    return _flash_mha(q, k, v, q_offset, kv_lengths, causal, scale), (q, k, v, q_offset, kv_lengths)
+
+
+def _flash_mha_bwd(causal, scale, res, g):
+    q, k, v, q_offset, kv_lengths = res
+
+    def ref(q, k, v):
+        return mha_attention(
+            q, k, v, causal=causal, q_offset=q_offset, kv_lengths=kv_lengths,
+            scale=scale, backend="xla",
+        )
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None, None
+
+
+_flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
 
 
 def _softmax(scores: jnp.ndarray) -> jnp.ndarray:

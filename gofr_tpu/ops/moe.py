@@ -1,0 +1,123 @@
+"""Mixture-of-experts routing: top-k gating with static capacity.
+
+GShard/Switch-style dense dispatch, shaped for the TPU compiler: every
+tensor is static — tokens route into a fixed [experts, capacity, dim]
+buffer via one-hot dispatch/combine einsums, so the whole MoE layer is
+three big MXU contractions regardless of routing decisions, and the same
+compiled program serves every batch (no recompiles, no ragged shapes).
+Tokens beyond an expert's capacity are *dropped* (their combine weight is
+zero and the residual stream carries them through) — the standard
+capacity-factor trade.
+
+Expert parallelism falls out of sharding: the expert dimension of the
+dispatch buffer and the expert weights carry the "expert" logical axis
+(→ mesh ``ep``), and GSPMD turns the dispatch/combine einsums into
+all-to-alls over ICI (SURVEY.md §2.9 — new subsystem, no reference analog).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Routing(NamedTuple):
+    dispatch: jnp.ndarray  # [T, E, C] one-hot-ish {0,1}
+    combine: jnp.ndarray   # [T, E, C] gate probabilities at kept slots
+    aux_loss: jnp.ndarray  # [] load-balance loss (Switch §2.2 style)
+    router_probs: jnp.ndarray  # [T, E] full softmax (for metrics/tests)
+
+
+def route_topk(
+    router_logits: jnp.ndarray,  # [T, E]
+    *,
+    k: int,
+    capacity: int,
+    renormalize: bool = True,
+    token_mask: jnp.ndarray | None = None,  # [T] 1 = real token
+) -> Routing:
+    """Top-k token→expert assignment with per-expert capacity ``C``.
+
+    Priority is choice-major then token-major: every token's 1st choice
+    beats any token's 2nd choice; ties break by token order — deterministic
+    and batch-order stable.
+
+    ``token_mask`` excludes padding tokens entirely: they take no capacity,
+    get zero combine mass, and don't bias the aux loss — so a padded batch
+    routes identically to its unpadded equivalent.
+    """
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # [T, E]
+    top_probs, top_idx = lax.top_k(probs, k)  # [T, K]
+    if renormalize:
+        top_probs = top_probs / jnp.maximum(jnp.sum(top_probs, -1, keepdims=True), 1e-9)
+
+    mask = jax.nn.one_hot(top_idx, e, dtype=jnp.int32)  # [T, K, E]
+    if token_mask is not None:
+        mask = mask * token_mask.astype(jnp.int32)[:, None, None]
+    # choice-major flatten → positions within each expert's buffer
+    mask_f = mask.transpose(1, 0, 2).reshape(k * t, e)
+    pos_f = (jnp.cumsum(mask_f, axis=0) - 1) * mask_f  # [K*T, E]
+    pos = pos_f.reshape(k, t, e).transpose(1, 0, 2)  # [T, K, E]
+    kept = (pos < capacity) & (mask > 0)  # [T, K, E]
+
+    slot = jax.nn.one_hot(jnp.where(kept, pos, -1), capacity, dtype=jnp.float32)  # [T,K,E,C]
+    dispatch = jnp.sum(slot, axis=1)  # [T, E, C]
+    combine = jnp.sum(slot * top_probs[:, :, None, None], axis=1)  # [T, E, C]
+
+    # load balance: E * Σ_e (fraction of first-choice tokens to e) * (mean prob of e)
+    first = jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32)
+    if token_mask is not None:
+        w = token_mask.astype(jnp.float32)[:, None]
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        frac = jnp.sum(first * w, axis=0) / denom
+        mean_prob = jnp.sum(probs * w, axis=0) / denom
+    else:
+        frac = jnp.mean(first, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return Routing(dispatch=dispatch, combine=combine, aux_loss=aux, router_probs=probs)
+
+
+def default_capacity(tokens: int, experts: int, k: int, factor: float = 1.25) -> int:
+    """ceil(T*k/E * factor), at least 1 — static per (shape, config)."""
+    return max(1, int((tokens * k + experts - 1) // experts * factor))
+
+
+def moe_ffn(
+    x: jnp.ndarray,          # [T, D] tokens (post-norm)
+    router_w: jnp.ndarray,   # [D, E]
+    w_gate: jnp.ndarray,     # [E, D, M]
+    w_up: jnp.ndarray,       # [E, D, M]
+    w_down: jnp.ndarray,     # [E, M, D]
+    *,
+    k: int,
+    capacity_factor: float = 1.25,
+    capacity: int | None = None,
+    token_mask: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """SwiGLU expert FFN over routed tokens → ([T, D], aux_loss).
+
+    The three einsums (dispatch, expert matmuls, combine) are where EP
+    sharding bites: w_* carry the "expert" logical axis. ``capacity``
+    overrides the factor-derived default (e.g. decode uses capacity == T so
+    a skewed slot batch can never drop a live token).
+    """
+    t, d = x.shape
+    e = router_w.shape[1]
+    cap = capacity if capacity is not None else default_capacity(t, e, k, capacity_factor)
+    routing = route_topk(
+        (x @ router_w.astype(x.dtype)).astype(jnp.float32),
+        k=k, capacity=cap, token_mask=token_mask,
+    )
+
+    xin = jnp.einsum("tec,td->ecd", routing.dispatch.astype(x.dtype), x)  # [E, C, D]
+    gated = jax.nn.silu(jnp.einsum("ecd,edm->ecm", xin, w_gate)) * jnp.einsum(
+        "ecd,edm->ecm", xin, w_up
+    )
+    out = jnp.einsum("ecm,emd->ecd", gated, w_down)  # [E, C, D]
+    y = jnp.einsum("tec,ecd->td", routing.combine.astype(x.dtype), out)
+    return y, routing.aux_loss

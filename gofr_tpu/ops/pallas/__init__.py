@@ -13,9 +13,29 @@ A-B benchmarking).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 
 import jax
+
+# Where the computation being *traced* will actually run. jax.default_backend()
+# lies when a TPU is attached but the target mesh is CPU (the multichip dryrun,
+# CPU test meshes), so mesh-aware callers (make_train_step, engines) pin it.
+_PLATFORM: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "gofr_pallas_platform", default=None
+)
+
+
+@contextlib.contextmanager
+def platform_hint(platform: str | None):
+    """Pin the target platform for backend resolution while tracing, e.g.
+    ``with platform_hint(mesh.devices.flat[0].platform): step_fn(...)``."""
+    tok = _PLATFORM.set(platform)
+    try:
+        yield
+    finally:
+        _PLATFORM.reset(tok)
 
 
 def interpret_mode() -> bool:
@@ -28,10 +48,13 @@ def flash_attention_available() -> bool:
         return False
     if interpret_mode():
         return True
+    platform = _PLATFORM.get()
     try:
-        return jax.default_backend() in ("tpu", "axon")
+        if platform is None:
+            platform = jax.default_backend()
     except Exception:  # noqa: BLE001
         return False
+    return platform in ("tpu", "axon")
 
 
-__all__ = ["flash_attention_available", "interpret_mode"]
+__all__ = ["flash_attention_available", "interpret_mode", "platform_hint"]

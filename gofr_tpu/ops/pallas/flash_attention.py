@@ -63,26 +63,36 @@ def _flash_kernel(
     ki = pl.program_id(3)
     init_softmax_scratch(ki, acc_ref, m_ref, l_ref)
 
-    q = q_ref[0, 0]
-    k = k_ref[0, 0]
-    v = v_ref[0, 0]
-
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [block_q, block_kv] f32
-
-    kv_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-    mask = kv_pos < kl_ref[bi]
+    # Causal block skip: a kv block starting past this q block's last global
+    # position is fully masked — skip its matmuls entirely (~2x less MXU
+    # work for square causal prefill; the DMA still streams, bounded by the
+    # grid, but compute is the prefill bottleneck at these tile sizes).
+    needed = True
     if causal:
-        q_pos = (
-            qi * block_q
-            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-            + qo_ref[bi]
-        )
-        mask = mask & (q_pos >= kv_pos)
-    s = jnp.where(mask, s, NEG_INF)
+        needed = ki * block_kv <= qi * block_q + block_q - 1 + qo_ref[bi]
 
-    softmax_block_update(s, v, acc_ref, m_ref, l_ref)
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_kv] f32
+
+        kv_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+        mask = kv_pos < kl_ref[bi]
+        if causal:
+            q_pos = (
+                qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+                + qo_ref[bi]
+            )
+            mask = mask & (q_pos >= kv_pos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        softmax_block_update(s, v, acc_ref, m_ref, l_ref)
 
     def write(out):
         o_ref[0, 0] = out.astype(o_ref.dtype)

@@ -1,0 +1,130 @@
+"""SPMD pipeline parallelism over a ``pp`` mesh axis.
+
+GPipe-style schedule expressed as ONE program on every device (no
+per-stage programs, no host orchestration — the TPU way): stage s owns a
+contiguous slab of layers (the stacked [L, ...] block params shard over
+``pp`` on their leading dim), microbatches march through the ring via
+``ppermute``, and a ``lax.scan`` over M + P - 1 ticks runs the whole
+schedule inside one jit. Bubble ticks compute on garbage and are discarded
+— uniform work keeps the program static (same discipline as the serving
+engine's inactive slots, gofr_tpu/tpu/engine.py).
+
+The reference has no model execution at all (SURVEY.md §2.9); this is the
+pp entry in the dp/fsdp/tp/sp/ep/pp axis set (parallel.mesh.AXES).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, Any], Any],
+    stage_params: Any,
+    inputs: Any,
+    *,
+    axis: str = "pp",
+    microbatches: int,
+):
+    """Run inside ``shard_map``: pipeline ``inputs`` (pytree, leading dim =
+    ``microbatches``) through P ring stages.
+
+    ``stage_fn(stage_params, act) -> act`` must preserve the activation
+    pytree's structure and shapes (pass-through leaves like per-microbatch
+    lengths just return unchanged). Returns outputs shaped like ``inputs``,
+    replicated over the axis (psum-broadcast from the last stage).
+    """
+    p = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    m = microbatches
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    act0 = jax.tree.map(lambda x: jnp.zeros_like(x[0]), inputs)
+    outs0 = jax.tree.map(jnp.zeros_like, inputs)
+
+    def tick(carry, t):
+        outs, act = carry
+        feed_idx = jnp.minimum(t, m - 1)
+        feed = jax.tree.map(lambda x: lax.dynamic_index_in_dim(x, feed_idx, 0, keepdims=False), inputs)
+        cur = jax.tree.map(lambda f, a: jnp.where(stage == 0, f, a), feed, act)
+        out = stage_fn(stage_params, cur)
+        out_idx = jnp.clip(t - (p - 1), 0, m - 1)
+        write = jnp.logical_and(stage == p - 1, t >= p - 1)
+        outs = jax.tree.map(
+            lambda o_all, o: jnp.where(
+                write, lax.dynamic_update_index_in_dim(o_all, o, out_idx, 0), o_all
+            ),
+            outs, out,
+        )
+        act = jax.tree.map(lambda o: lax.ppermute(o, axis, perm), out)
+        return (outs, act), None
+
+    (outs, _), _ = lax.scan(tick, (outs0, act0), jnp.arange(m + p - 1))
+    # broadcast finished microbatches from the last stage to everyone
+    return jax.tree.map(
+        lambda o: lax.psum(jnp.where(stage == p - 1, o, jnp.zeros_like(o)), axis), outs
+    )
+
+
+def make_pipeline_forward(
+    mesh: Mesh,
+    *,
+    microbatches: int = 4,
+    axis: str = "pp",
+    batch_axes=("dp", "fsdp"),
+    param_specs: Any | None = None,
+):
+    """Bind a mesh to a pipelined model forward.
+
+    Returns ``pp_forward(stage_fn, block_params, x, lengths)`` where
+    ``block_params`` leaves have a leading layers dim (sharded over ``axis``)
+    and ``stage_fn(local_blocks, x, lengths) -> x`` runs one stage's layers.
+    The global batch B is cut into ``microbatches``; B % (microbatches *
+    dp-shards) must be 0.
+
+    ``param_specs`` (pytree of PartitionSpec matching ``block_params``)
+    keeps other axes of the stage weights sharded inside the region — e.g.
+    P('pp', None, 'tp') for a [L, E, H*D] projection — so pp composes with
+    tp instead of all-gathering the stage weights; the stage_fn is then
+    responsible for the matching manual psums.
+    """
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names)
+    bspec = batch if batch else None
+
+    def pp_forward(stage_fn, block_params, x, lengths):
+        b, s, e = x.shape
+        if b % microbatches:
+            raise ValueError(f"batch {b} not divisible by {microbatches} microbatches")
+        mb = b // microbatches
+        xm = x.reshape(microbatches, mb, s, e)
+        lm = lengths.reshape(microbatches, mb)
+        specs = param_specs if param_specs is not None else jax.tree.map(
+            lambda _: P(axis), block_params
+        )
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(specs, P(None, bspec), P(None, bspec)),
+            out_specs=(P(None, bspec), P(None, bspec)),
+            check_vma=False,
+        )
+        def run(blocks_local, xm, lm):
+            def fn(params, act):
+                xa, la = act
+                return stage_fn(params, xa, la), la
+
+            return spmd_pipeline(fn, blocks_local, (xm, lm), axis=axis, microbatches=microbatches)
+
+        ym, _ = run(block_params, xm, lm)
+        return ym.reshape(b, s, e)
+
+    return pp_forward

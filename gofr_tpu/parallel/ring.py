@@ -1,0 +1,194 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Long-context first-class support (SURVEY.md §5.7 — absent in the reference,
+sourced from PAPERS.md): shard the SEQUENCE dimension of activations over a
+mesh axis (``sp``) so context length scales with chips.
+
+Two strategies, both running inside ``shard_map`` so the collectives are
+explicit and ride ICI:
+
+- **Ring attention**: queries stay put; K/V chunks rotate around the ``sp``
+  ring via ``ppermute`` while each device folds every visiting chunk into a
+  blockwise online-softmax accumulator (same recurrence as the Pallas flash
+  kernel, one ring hop = one kv block). Memory per device stays O(S/n);
+  comm overlaps with the next block's compute in XLA's scheduler.
+- **Ulysses**: ``all_to_all`` swaps the shard axis from sequence to heads
+  ([B, S/n, H, D] → [B, S, H/n, D]), runs ordinary dense attention locally
+  (which on TPU dispatches to the Pallas flash kernel), and swaps back.
+  Cheaper comm at moderate S; requires heads % sp == 0.
+
+``make_seq_parallel_attn`` binds either strategy to a mesh as a drop-in
+``attn_fn`` for the model forwards (gofr_tpu.models.llama.forward).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale, q_off, kv_off, causal, kv_lengths, chunk_kv):
+    """Masked f32 scores for one (local q, visiting kv) block.
+
+    q [B, Cq, Hkv, G, D] grouped; k [B, Ckv, Hkv, D] → s [B, Hkv, G, Cq, Ckv].
+    Positions are global: q_off/kv_off are the chunks' global start offsets.
+    """
+    s = jnp.einsum("bskgd,btkd->bkgst", q, k).astype(jnp.float32) * scale
+    kv_pos = kv_off + jnp.arange(chunk_kv)  # [Ckv]
+    mask = None
+    if causal:
+        q_pos = q_off + jnp.arange(q.shape[1])  # [Cq]
+        mask = q_pos[:, None] >= kv_pos[None, :]  # [Cq, Ckv]
+        mask = mask[None, None, None]
+    if kv_lengths is not None:
+        lmask = kv_pos[None, :] < kv_lengths[:, None]  # [B, Ckv]
+        lmask = lmask[:, None, None, None]
+        mask = lmask if mask is None else (mask & lmask)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    return s
+
+
+def _online_update(carry, s, v):
+    """Fold one block's scores/values into the (m, l, acc) accumulator.
+    s [B, K, G, Cq, Ckv] f32; v [B, Ckv, K, D]; acc [B, K, G, Cq, D] f32."""
+    m, l, acc = carry
+    m_next = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    m_safe = jnp.where(m_next > NEG_INF / 2, m_next, 0.0)
+    p = jnp.exp(s - m_safe)
+    alpha = jnp.exp(m - m_safe)
+    l_next = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bkgst,btkd->bkgsd", p.astype(v.dtype), v).astype(jnp.float32)
+    acc_next = acc * alpha + pv
+    return m_next, l_next, acc_next
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis: str = "sp",
+    causal: bool = True,
+    kv_lengths: jnp.ndarray | None = None,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Ring attention over sequence chunks. MUST run inside ``shard_map``
+    with the sequence dim of q/k/v sharded over ``axis``.
+
+    q [B, C, Hq, D], k/v [B, C, Hkv, D] local chunks of a global sequence
+    S = C * axis_size; ``kv_lengths`` [B] are GLOBAL lengths. Chunk i holds
+    global positions [i*C, (i+1)*C).
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    b, c, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    qg = q.reshape(b, c, hkv, g, d)  # grouped [B, Cq, K, G, D]
+
+    q_off = idx * c
+    m = jnp.full((b, hkv, g, c, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hkv, g, c, 1), jnp.float32)
+    acc = jnp.zeros((b, hkv, g, c, d), jnp.float32)
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        # after i forward rotations we hold the chunk of device (idx - i) % n
+        kv_off = ((idx - i) % n) * c
+        s = _block_scores(qg, k_cur, scale, q_off, kv_off, causal, kv_lengths, c)
+        m, l, acc = _online_update((m, l, acc), s, v_cur)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return (k_nxt, v_nxt, m, l, acc), None
+
+    (k, v, m, l, acc), _ = lax.scan(step, (k, v, m, l, acc), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-20)  # [B, K, G, Cq, D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, c, hq, d).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis: str = "sp",
+    causal: bool = True,
+    kv_lengths: jnp.ndarray | None = None,
+    scale: float | None = None,
+    inner: Callable | None = None,
+) -> jnp.ndarray:
+    """Ulysses sequence parallelism. MUST run inside ``shard_map`` with the
+    sequence dim sharded over ``axis``; requires Hq and Hkv divisible by the
+    axis size. ``inner`` is the dense attention to run after the swap
+    (default: gofr_tpu.ops.mha_attention, i.e. Pallas flash on TPU)."""
+    from gofr_tpu.ops.attention import mha_attention
+
+    inner = inner or mha_attention
+    n = lax.axis_size(axis)
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % n != 0:
+        raise ValueError(f"ulysses needs query heads ({hq}) divisible by sp axis size ({n})")
+    if hkv % n != 0:
+        # GQA with fewer kv heads than the axis: expand kv to the query-head
+        # count so both scatter identically (head blocks stay aligned).
+        k = jnp.repeat(k, hq // hkv, axis=2)
+        v = jnp.repeat(v, hq // hkv, axis=2)
+    # [B, C, H, D] → gather seq, scatter heads → [B, S, H/n, D]
+    qh = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    out = inner(qh, kh, vh, causal=causal, kv_lengths=kv_lengths, scale=scale)
+    # back: gather heads, scatter seq → [B, C, H, D]
+    return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def make_seq_parallel_attn(
+    mesh: Mesh,
+    *,
+    strategy: str = "ring",
+    axis: str = "sp",
+    batch_axes=("dp", "fsdp"),
+    head_axis: str = "tp",
+):
+    """Bind ring/ulysses attention to ``mesh`` as a drop-in ``attn_fn`` for
+    model forwards: takes GLOBAL [B, S, H, D] activations (GSPMD-sharded),
+    runs the strategy under ``shard_map`` with seq sharded over ``axis`` and
+    heads over ``head_axis``, returns global output.
+    """
+    if strategy not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names)
+    bspec = batch if batch else None
+    head = head_axis if head_axis in mesh.axis_names else None
+    qkv_spec = P(bspec, axis, head, None)
+    len_spec = P(bspec)
+
+    fn = ring_attention if strategy == "ring" else ulysses_attention
+
+    def attn_fn(q, k, v, *, causal=True, kv_lengths=None, scale=None, **_):
+        if kv_lengths is None:
+            kv_lengths = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
+
+        @partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, len_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        def run(ql, kl, vl, lens):
+            return fn(ql, kl, vl, axis=axis, causal=causal, kv_lengths=lens, scale=scale)
+
+        return run(q, k, v, kv_lengths)
+
+    return attn_fn

@@ -139,7 +139,13 @@ class _EngineBase:
 
     def _run(self) -> None:
         try:
-            self._loop()
+            from gofr_tpu.ops.pallas import platform_hint
+
+            # Pin kernel-backend resolution to where this engine's device
+            # actually is (a CPU test mesh under an attached TPU would
+            # otherwise trace Pallas kernels it can't lower).
+            with platform_hint(getattr(self.tpu, "platform", None)):
+                self._loop()
         except Exception as e:  # noqa: BLE001
             self._startup_error = e
             self.logger.log_exception(e, "model engine thread died")

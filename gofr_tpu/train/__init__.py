@@ -51,6 +51,8 @@ def make_train_step(
     rules: ShardingRules | None = None,
     optimizer: optax.GradientTransformation | None = None,
     remat: bool = False,
+    seq_parallel: str | None = None,
+    pipeline_microbatches: int | None = None,
 ):
     """Build ``(init_fn, step_fn)`` compiled over ``mesh``.
 
@@ -61,8 +63,27 @@ def make_train_step(
 
     ``remat=True`` wraps the forward in ``jax.checkpoint`` to trade FLOPs
     for HBM (rematerialize activations in the backward pass).
+
+    ``seq_parallel='ring'|'ulysses'`` shards the sequence dimension of
+    attention over the mesh's ``sp`` axis (gofr_tpu.parallel.ring) — the
+    long-context path; the model family must accept an ``attn_fn``.
+
+    ``pipeline_microbatches=M`` runs the blocks pipeline-parallel over the
+    mesh's ``pp`` axis (family must expose ``forward_pipelined``); the
+    layers dim of block params shards over pp.
     """
     rules = rules or ShardingRules()
+    if pipeline_microbatches and seq_parallel:
+        raise ValueError(
+            "seq_parallel and pipeline_microbatches cannot be combined yet: "
+            "the pipelined stages run dense attention"
+        )
+    if pipeline_microbatches:
+        if "pp" not in mesh.axis_names or mesh.shape["pp"] <= 1:
+            raise ValueError("pipeline_microbatches needs a 'pp' mesh axis > 1")
+        if not hasattr(family, "forward_pipelined"):
+            raise ValueError(f"{family.__name__} does not support pipeline parallelism")
+        rules = rules.with_overrides(layers="pp")
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
     axes = family.param_axes(cfg)
     param_sh = sharding_tree(axes, rules, mesh)
@@ -71,17 +92,41 @@ def make_train_step(
     len_sh = NamedSharding(mesh, P(batch_spec[0]))
     scalar_sh = NamedSharding(mesh, P())
 
+    attn_fn = None
+    if seq_parallel:
+        if "sp" not in mesh.axis_names or mesh.shape["sp"] <= 1:
+            raise ValueError(f"seq_parallel={seq_parallel!r} needs an 'sp' mesh axis > 1")
+        from gofr_tpu.parallel.ring import make_seq_parallel_attn
+
+        attn_fn = make_seq_parallel_attn(mesh, strategy=seq_parallel)
+
+    # MoE families expose forward_with_aux; the router load-balance term
+    # joins the loss scaled by cfg.router_aux_coef.
+    with_aux = getattr(family, "forward_with_aux", None)
+    aux_coef = float(getattr(cfg, "router_aux_coef", 0.0)) if with_aux else 0.0
+
     def fwd(params, tokens, lengths):
-        return family.forward(cfg, params, tokens, lengths)
+        if pipeline_microbatches:
+            return family.forward_pipelined(
+                cfg, params, tokens, lengths, mesh, pipeline_microbatches
+            ), {}
+        if with_aux is not None:
+            return with_aux(cfg, params, tokens, lengths, attn_fn)
+        if attn_fn is not None:
+            return family.forward(cfg, params, tokens, lengths, attn_fn), {}
+        return family.forward(cfg, params, tokens, lengths), {}
 
     if remat:
         fwd = jax.checkpoint(fwd)
 
     def loss_fn(params, tokens, lengths):
-        logits = fwd(params, tokens, lengths)
+        logits, aux = fwd(params, tokens, lengths)
         mask = (jnp.arange(tokens.shape[1])[None] < lengths[:, None] - 1).astype(jnp.float32)
         # predict token t+1 from position t
-        return cross_entropy_loss(logits[:, :-1], tokens[:, 1:], mask[:, : tokens.shape[1] - 1])
+        loss = cross_entropy_loss(logits[:, :-1], tokens[:, 1:], mask[:, : tokens.shape[1] - 1])
+        if aux_coef and "load_balance" in aux:
+            loss = loss + aux_coef * aux["load_balance"]
+        return loss
 
     def _init(key):
         params = family.init(cfg, key)
@@ -103,7 +148,21 @@ def make_train_step(
     opt_sh = jax.tree.map(leaf_sharding, state_shape.opt_state)
     state_sh = TrainState(params=param_sh, opt_state=opt_sh, step=scalar_sh)
 
-    init_fn = jax.jit(_init, out_shardings=state_sh)
+    platform = mesh.devices.flat[0].platform
+
+    def _hinted(f):
+        """Trace under the mesh's platform so kernel-backend resolution sees
+        where the step actually runs (not jax.default_backend())."""
+
+        def g(*a):
+            from gofr_tpu.ops.pallas import platform_hint
+
+            with platform_hint(platform):
+                return f(*a)
+
+        return g
+
+    init_fn = _hinted(jax.jit(_init, out_shardings=state_sh))
 
     def _step(state: TrainState, tokens, lengths):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, lengths)
@@ -113,10 +172,10 @@ def make_train_step(
         new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
         return new_state, {"loss": loss, "grad_norm": gnorm}
 
-    step_fn = jax.jit(
+    step_fn = _hinted(jax.jit(
         _step,
         in_shardings=(state_sh, batch_sh, len_sh),
         out_shardings=(state_sh, {"loss": scalar_sh, "grad_norm": scalar_sh}),
         donate_argnums=0,
-    )
+    ))
     return init_fn, step_fn

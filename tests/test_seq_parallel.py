@@ -1,0 +1,104 @@
+"""Sequence parallelism (ring / Ulysses) vs dense attention on the 8-device
+CPU mesh — long-context support (SURVEY.md §5.7, new subsystem)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models import LlamaConfig, llama
+from gofr_tpu.ops.attention import mha_attention
+from gofr_tpu.parallel import build_mesh
+from gofr_tpu.parallel.ring import make_seq_parallel_attn
+from gofr_tpu.train import make_train_step
+
+
+def _qkv(key, b, s, hq, hkv, d):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, s, hq, d)),
+        jax.random.normal(kk, (b, s, hkv, d)),
+        jax.random.normal(kv, (b, s, hkv, d)),
+    )
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+@pytest.mark.parametrize("mesh_spec", ["sp:8", "dp:2,sp:4"])
+def test_matches_dense_causal(strategy, mesh_spec):
+    mesh = build_mesh(mesh_spec)
+    q, k, v = _qkv(jax.random.key(0), 2, 32, 8, 4, 16)
+    lengths = jnp.array([32, 19], jnp.int32)
+    want = mha_attention(q, k, v, causal=True, kv_lengths=lengths, backend="xla")
+    attn = make_seq_parallel_attn(mesh, strategy=strategy)
+    got = attn(q, k, v, causal=True, kv_lengths=lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_matches_dense_non_causal(strategy):
+    mesh = build_mesh("dp:2,sp:4")
+    q, k, v = _qkv(jax.random.key(1), 2, 16, 4, 4, 8)
+    want = mha_attention(q, k, v, causal=False, backend="xla")
+    attn = make_seq_parallel_attn(mesh, strategy=strategy)
+    got = attn(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5)
+
+
+def test_ring_with_tp_sharded_heads():
+    mesh = build_mesh("sp:2,tp:4")
+    q, k, v = _qkv(jax.random.key(2), 2, 16, 8, 4, 8)
+    want = mha_attention(q, k, v, causal=True, backend="xla")
+    attn = make_seq_parallel_attn(mesh, strategy="ring")
+    got = attn(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5)
+
+
+def test_ring_gradients_match_dense():
+    mesh = build_mesh("dp:2,sp:4")
+    q, k, v = _qkv(jax.random.key(3), 2, 16, 2, 2, 8)
+    attn = make_seq_parallel_attn(mesh, strategy="ring")
+
+    def loss_ring(q, k, v):
+        return jnp.sum(attn(q, k, v, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(mha_attention(q, k, v, causal=True, backend="xla") ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd), atol=1e-4, rtol=1e-4)
+
+
+def test_llama_forward_with_ring_attn():
+    mesh = build_mesh("dp:2,sp:4")
+    cfg = LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    lengths = jnp.array([32, 30], jnp.int32)
+    want = llama.forward(cfg, params, tokens, lengths)
+    attn = make_seq_parallel_attn(mesh, strategy="ring")
+    got = llama.forward(cfg, params, tokens, lengths, attn)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_train_step_seq_parallel(strategy):
+    mesh = build_mesh("dp:2,sp:2,tp:2")
+    cfg = LlamaConfig.tiny()
+    init_fn, step_fn = make_train_step(cfg, llama, mesh, seq_parallel=strategy)
+    state = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, cfg.vocab_size)
+    lengths = jnp.full((4,), 32, jnp.int32)
+    state, metrics = step_fn(state, tokens, lengths)
+    l0 = float(metrics["loss"])
+    assert np.isfinite(l0)
+    for _ in range(3):
+        state, metrics = step_fn(state, tokens, lengths)
+    assert float(metrics["loss"]) < l0  # it learns
+
+
+def test_seq_parallel_requires_sp_axis():
+    mesh = build_mesh("dp:8")
+    with pytest.raises(ValueError, match="sp"):
+        make_train_step(LlamaConfig.tiny(), llama, mesh, seq_parallel="ring")
