@@ -1,0 +1,218 @@
+// gofr_tpu native runtime core.
+//
+// Two host-side hot paths live here, off the Python GIL (SURVEY.md §7 —
+// the reference keeps its runtime in Go; the TPU build's host runtime is
+// C++ around the XLA device loop):
+//
+//  1. Prefill planner: EDF + bucket-affinity batch packing for the
+//     continuous-batching engine. Given pending request metadata it picks
+//     which requests to prefill together and at which (len, batch) bucket,
+//     minimizing padding FLOPs while honoring deadlines.
+//  2. Token data loader: mmap'd token corpus with a background prefetch
+//     thread producing fixed-shape [batch, seqlen+1] crops into a ring
+//     buffer for the training input pipeline.
+//
+// C ABI (ctypes-friendly): plain ints/pointers only.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// 1) Prefill planner
+// ---------------------------------------------------------------------------
+
+// Pick the batch to prefill next.
+//   lens[n], deadlines_us[n] (0 = no deadline), arrival order = index order.
+//   len_buckets[n_buckets] ascending; free_slots / max_batch cap the batch.
+// Writes chosen request indices into out_chosen (cap max_batch), expired
+// indices into out_expired (cap n), bucket results into out_len_bucket /
+// out_batch_bucket. Returns the number chosen; *out_n_expired set.
+//
+// Policy: requests past deadline are expired. The earliest-deadline (ties:
+// FIFO) request leads; the batch is filled, in EDF order, only with
+// requests that fit the leader's length bucket — a longer request never
+// inflates everyone's padding, it simply leads its own batch next round.
+int gofr_plan_prefill(
+    const int32_t* lens, const int64_t* deadlines_us, int32_t n,
+    int64_t now_us, int32_t free_slots, int32_t max_batch,
+    const int32_t* len_buckets, int32_t n_buckets,
+    int32_t* out_chosen, int32_t* out_expired, int32_t* out_n_expired,
+    int32_t* out_len_bucket, int32_t* out_batch_bucket) {
+  *out_n_expired = 0;
+  *out_len_bucket = 0;
+  *out_batch_bucket = 0;
+  if (n <= 0) return 0;
+
+  // expiry is reported even when no slot is free — the engine must fail
+  // timed-out requests promptly, not strand them in the pending list
+  std::vector<int32_t> valid;
+  valid.reserve(n);
+  for (int32_t i = 0; i < n; ++i) {
+    if (deadlines_us[i] > 0 && deadlines_us[i] < now_us) {
+      out_expired[(*out_n_expired)++] = i;
+    } else {
+      valid.push_back(i);
+    }
+  }
+  if (valid.empty() || free_slots <= 0 || max_batch <= 0) return 0;
+
+  std::stable_sort(valid.begin(), valid.end(), [&](int32_t a, int32_t b) {
+    int64_t da = deadlines_us[a] > 0 ? deadlines_us[a] : INT64_MAX;
+    int64_t db = deadlines_us[b] > 0 ? deadlines_us[b] : INT64_MAX;
+    if (da != db) return da < db;
+    return a < b;  // FIFO tie-break
+  });
+
+  // leader sets the length bucket
+  int32_t lead_len = lens[valid[0]];
+  int32_t bucket = len_buckets[n_buckets - 1];
+  for (int32_t bi = 0; bi < n_buckets; ++bi) {
+    if (len_buckets[bi] >= lead_len) { bucket = len_buckets[bi]; break; }
+  }
+
+  int32_t cap = std::min(free_slots, max_batch);
+  int32_t count = 0;
+  for (int32_t idx : valid) {
+    if (count >= cap) break;
+    if (lens[idx] <= bucket) out_chosen[count++] = idx;
+  }
+
+  // batch bucket: next power of two >= count (bounded by max_batch)
+  int32_t bb = 1;
+  while (bb < count) bb <<= 1;
+  if (bb > max_batch) bb = max_batch;
+
+  *out_len_bucket = bucket;
+  *out_batch_bucket = bb;
+  return count;
+}
+
+// ---------------------------------------------------------------------------
+// 2) Token data loader
+// ---------------------------------------------------------------------------
+
+struct Loader {
+  const int32_t* tokens = nullptr;   // mmap'd
+  int64_t n_tokens = 0;
+  int fd = -1;
+  size_t map_len = 0;
+  bool owns_copy = false;            // fallback: buffer copied from caller
+
+  int32_t batch = 0;
+  int32_t seqlen = 0;                // yields [batch, seqlen + 1] (inputs+target)
+  uint64_t seed = 0;
+
+  std::vector<std::vector<int32_t>> ring;  // prefetched batches
+  size_t ring_cap = 0;
+  size_t head = 0, tail = 0, filled = 0;
+  std::mutex mu;
+  std::condition_variable cv_full, cv_empty;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+  uint64_t counter = 0;
+
+  void fill_batch(std::vector<int32_t>& out) {
+    // splitmix64 per (seed, counter) → deterministic, seekable stream
+    const int64_t span = seqlen + 1;
+    for (int32_t b = 0; b < batch; ++b) {
+      uint64_t z = seed + 0x9E3779B97F4A7C15ULL * (++counter);
+      z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+      z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+      z ^= z >> 31;
+      int64_t max_start = n_tokens - span;
+      int64_t start = max_start > 0 ? static_cast<int64_t>(z % static_cast<uint64_t>(max_start + 1)) : 0;
+      std::memcpy(out.data() + static_cast<size_t>(b) * span,
+                  tokens + start, static_cast<size_t>(span) * sizeof(int32_t));
+    }
+  }
+
+  void run() {
+    while (!stop.load()) {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_full.wait(lk, [&] { return stop.load() || filled < ring_cap; });
+      if (stop.load()) return;
+      auto& slot = ring[tail];
+      lk.unlock();
+      fill_batch(slot);           // copy outside the lock
+      lk.lock();
+      tail = (tail + 1) % ring_cap;
+      ++filled;
+      cv_empty.notify_one();
+    }
+  }
+};
+
+void* gofr_loader_create(const char* path, int32_t batch, int32_t seqlen,
+                         uint64_t seed, int32_t prefetch) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < static_cast<off_t>((seqlen + 1) * sizeof(int32_t))) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (map == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* l = new Loader();
+  l->tokens = static_cast<const int32_t*>(map);
+  l->n_tokens = st.st_size / sizeof(int32_t);
+  l->fd = fd;
+  l->map_len = st.st_size;
+  l->batch = batch;
+  l->seqlen = seqlen;
+  l->seed = seed;
+  l->ring_cap = prefetch > 0 ? prefetch : 2;
+  l->ring.assign(l->ring_cap, std::vector<int32_t>(
+      static_cast<size_t>(batch) * (seqlen + 1)));
+  l->worker = std::thread([l] { l->run(); });
+  return l;
+}
+
+// Blocks until a prefetched batch is ready; copies it into out
+// [batch * (seqlen+1)] int32. Returns 0 on success.
+int gofr_loader_next(void* handle, int32_t* out) {
+  auto* l = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(l->mu);
+  l->cv_empty.wait(lk, [&] { return l->stop.load() || l->filled > 0; });
+  if (l->stop.load()) return 1;
+  auto& slot = l->ring[l->head];
+  std::memcpy(out, slot.data(), slot.size() * sizeof(int32_t));
+  l->head = (l->head + 1) % l->ring_cap;
+  --l->filled;
+  l->cv_full.notify_one();
+  return 0;
+}
+
+int64_t gofr_loader_num_tokens(void* handle) {
+  return static_cast<Loader*>(handle)->n_tokens;
+}
+
+void gofr_loader_destroy(void* handle) {
+  auto* l = static_cast<Loader*>(handle);
+  l->stop.store(true);
+  l->cv_full.notify_all();
+  l->cv_empty.notify_all();
+  if (l->worker.joinable()) l->worker.join();
+  if (l->tokens && !l->owns_copy) munmap(const_cast<int32_t*>(l->tokens), l->map_len);
+  if (l->fd >= 0) ::close(l->fd);
+  delete l;
+}
+
+}  // extern "C"

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gofr_tpu.http.errors import RequestTimeout
+from gofr_tpu.native import plan_prefill
 from gofr_tpu.models.base import ModelSpec, get_family
 from gofr_tpu.ops.sampling import sample_token
 from gofr_tpu.parallel import shard_pytree
@@ -129,13 +130,23 @@ class _EngineBase:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
-        # fail whatever is still queued
+        self._fail_all(EngineClosed("engine stopped"))
+
+    def _fail_all(self, error: Exception) -> None:
+        """Fail everything waiting — the queue AND the drained-but-unadmitted
+        pending list (subclasses with richer state extend this)."""
         while True:
             try:
-                req = self._queue.get_nowait()
+                self._queue.get_nowait().complete(error=error)
             except queue.Empty:
                 break
-            req.complete(error=EngineClosed("engine stopped"))
+        for req, _ in getattr(self, "_pending", []):
+            req.complete(error=error)
+        if hasattr(self, "_pending"):
+            self._pending = []
+
+    def _backlog(self) -> int:
+        return self._queue.qsize() + len(getattr(self, "_pending", []))
 
     def _run(self) -> None:
         try:
@@ -149,11 +160,7 @@ class _EngineBase:
         except Exception as e:  # noqa: BLE001
             self._startup_error = e
             self.logger.log_exception(e, "model engine thread died")
-            while True:
-                try:
-                    self._queue.get_nowait().complete(error=e)
-                except queue.Empty:
-                    break
+            self._fail_all(e)
 
     def _loop(self) -> None:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -167,7 +174,7 @@ class _EngineBase:
             raise self._startup_error
         req = Request(inputs, kw, timeout if timeout is not None else self.default_timeout, stream)
         self._queue.put(req)
-        self.metrics.set_gauge("app_tpu_queue_depth", self._queue.qsize())
+        self.metrics.set_gauge("app_tpu_queue_depth", self._backlog())
         return req
 
     def _record_step(self, kind: str, seconds: float, occupancy: float, signature: tuple) -> None:
@@ -356,6 +363,7 @@ class GenerateEngine(_EngineBase):
 
         self.cache = family.make_cache(cfg, slots, self.max_len)
         self.slots: list[_Slot | None] = [None] * slots
+        self._pending: list[tuple[Request, np.ndarray]] = []
         self._base_key = jax.random.key(seed)
         self._step_count = 0
 
@@ -441,37 +449,58 @@ class GenerateEngine(_EngineBase):
 
     # -- admission / prefill ---------------------------------------------------
 
-    def _admit(self) -> bool:
-        free = self._free_slots()
-        if not free:
-            return False
-        pending: list[Request] = []
-        now = time.monotonic()
-        while len(pending) < min(len(free), self.max_prefill_batch):
+    def _drain_pending(self) -> None:
+        """Move queued requests into the encoded pending list (invalid ones
+        complete with their error immediately)."""
+        while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            if req.cancelled or req.expired(now):
-                req.complete(error=RequestTimeout())
-                continue
-            pending.append(req)
-        self.metrics.set_gauge("app_tpu_queue_depth", self._queue.qsize())
-        if not pending:
-            return False
-
-        # encode + validate
-        ready: list[tuple[Request, np.ndarray]] = []
-        for req in pending:
             try:
                 toks = self._encode_prompt(req.inputs)
                 if toks.ndim != 1 or toks.shape[0] == 0:
                     raise ValueError(f"prompt must be a non-empty 1-D token sequence, got shape {toks.shape}")
                 if toks.shape[0] >= self.max_len:
                     raise ValueError(f"prompt length {toks.shape[0]} ≥ engine max_len {self.max_len}")
-                ready.append((req, toks))
+                if toks.shape[0] > self.prefill_buckets[-1]:
+                    raise ValueError(
+                        f"prompt length {toks.shape[0]} exceeds the largest prefill "
+                        f"bucket {self.prefill_buckets[-1]}"
+                    )
+                self._pending.append((req, toks))
             except Exception as e:  # noqa: BLE001
                 req.complete(error=e)
+
+    def _admit(self) -> bool:
+        self._drain_pending()
+        self.metrics.set_gauge("app_tpu_queue_depth", self._backlog())
+        free = self._free_slots()
+        if not self._pending:
+            return False
+        still = []
+        for r, t in self._pending:
+            if r.cancelled:
+                r.complete(error=RequestTimeout())
+            else:
+                still.append((r, t))
+        self._pending = still
+
+        # EDF + bucket-affinity packing (native planner when available):
+        # the most urgent request leads and sets the length bucket; only
+        # prompts fitting that bucket join, so one long prompt never
+        # inflates the whole batch's padding.
+        now_us = int(time.monotonic() * 1e6)
+        plan = plan_prefill(
+            [t.shape[0] for _, t in self._pending],
+            [int(r.deadline * 1e6) if r.deadline else 0 for r, _ in self._pending],
+            now_us, len(free), self.max_prefill_batch, self.prefill_buckets,
+        )
+        for i in plan.expired:
+            self._pending[i][0].complete(error=RequestTimeout())
+        ready = [self._pending[i] for i in plan.chosen]
+        taken = set(plan.chosen) | set(plan.expired)
+        self._pending = [p for i, p in enumerate(self._pending) if i not in taken]
         if not ready:
             return False
 
@@ -480,8 +509,8 @@ class GenerateEngine(_EngineBase):
         # the cache's slot dimension — XLA scatter DROPS out-of-bounds
         # updates, so they write nowhere (verified in tests).
         n = len(ready)
-        nb = next_bucket(n, _pow2_buckets(1, self.max_prefill_batch))
-        lb = next_bucket(max(t.shape[0] for _, t in ready), self.prefill_buckets)
+        nb = plan.batch_bucket
+        lb = plan.len_bucket
         tokens = np.zeros((nb, lb), np.int32)
         lengths = np.ones((nb,), np.int32)
         slot_ids = np.full((nb,), self.num_slots, np.int32)
