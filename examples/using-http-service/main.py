@@ -1,0 +1,36 @@
+"""Inter-service HTTP client example (reference `examples/using-http-service`):
+a registered downstream service with circuit breaker + retry options."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.service import CircuitBreaker, Retry
+
+
+def build_app(downstream_url: str, config=None) -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+    app.register_service(
+        "catalog", downstream_url,
+        CircuitBreaker(threshold=3, interval=0.2),
+        Retry(max_retries=2),
+    )
+
+    def fetch(ctx):
+        resp = ctx.http_service("catalog").get("item")
+        return {"downstream": resp.json(), "status": resp.status_code}
+
+    app.get("/fetch", fetch)
+    return app
+
+
+if __name__ == "__main__":
+    import sys
+
+    build_app(sys.argv[1] if len(sys.argv) > 1 else "http://localhost:9000").run()

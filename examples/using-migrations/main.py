@@ -1,0 +1,49 @@
+"""Migrations example (reference `examples/using-migrations`): versioned,
+transactional schema evolution recorded in gofr_migrations."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.migration import Migration
+
+
+def all_migrations():
+    def create_users(ds):
+        ds.sql.execute("CREATE TABLE users (id INTEGER PRIMARY KEY, name TEXT)")
+
+    def add_email(ds):
+        ds.sql.execute("ALTER TABLE users ADD COLUMN email TEXT")
+
+    return {
+        20240101_00_00: Migration(up=create_users),
+        20240201_00_00: Migration(up=add_email),
+    }
+
+
+def build_app(config=None) -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+    app.migrate(all_migrations())
+
+    def add_user(ctx):
+        body = ctx.bind(dict)
+        ctx.sql.execute("INSERT INTO users (name, email) VALUES (?, ?)",
+                        (body["name"], body.get("email")))
+        return {"ok": True}
+
+    def list_users(ctx):
+        return ctx.sql.query("SELECT name, email FROM users")
+
+    app.post("/user", add_user)
+    app.get("/user", list_users)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,39 @@
+"""Publisher + subscriber example (reference `examples/using-publisher` +
+`using-subscriber`): HTTP handler publishes orders; a subscription handler
+consumes them with at-least-once commit semantics."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+
+PROCESSED: list[dict] = []
+
+
+def build_app(config=None) -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+
+    def publish_order(ctx):
+        order = ctx.bind(dict)
+        ctx.publish("orders", order)
+        return {"published": True}
+
+    def consume_order(ctx):
+        order = ctx.bind(dict)
+        PROCESSED.append(order)
+        ctx.logger.info(f"processed order {order}")
+        return None  # success → offset committed (at-least-once)
+
+    app.post("/order", publish_order)
+    app.subscribe("orders", consume_order)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,28 @@
+"""WebSocket example (reference `examples/using-web-socket`): per-message
+handler loop; bind() reads one message, return value is written back."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+
+
+def build_app(config=None) -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+
+    def echo(ctx):
+        msg = ctx.bind(dict)
+        return {"echo": msg, "via": "gofr-tpu"}
+
+    app.websocket("/ws", echo)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -169,6 +169,20 @@ class App:
 
         run_migrations(migrations, self.container)
 
+    # -- external datasource plugins (gofr `external_db.go:8-52` pattern) ------
+
+    def add_mongo(self, client: Any) -> None:
+        self.container.add_mongo(client)
+
+    def add_cassandra(self, client: Any) -> None:
+        self.container.add_cassandra(client)
+
+    def add_clickhouse(self, client: Any) -> None:
+        self.container.add_clickhouse(client)
+
+    def add_kv_store(self, client: Any) -> None:
+        self.container.add_kv_store(client)
+
     # -- TPU model serving (the new capability) --------------------------------
 
     def serve_model(self, name: str, spec: Any = None, *, engine: Any = None, **engine_kw: Any):

@@ -33,15 +33,25 @@ _lib_failed = False
 def _build() -> str | None:
     if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
         return _LIB_PATH
+    # compile to a private temp path and publish atomically so a concurrent
+    # process can never dlopen a half-written .so
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     try:
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-             "-o", _LIB_PATH, _SRC],
+             "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120,
         )
+        os.replace(tmp, _LIB_PATH)
         return _LIB_PATH
     except (OSError, subprocess.SubprocessError):
         return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def load_native() -> ctypes.CDLL | None:
@@ -97,7 +107,7 @@ def native_available() -> bool:
 @dataclass
 class PrefillPlan:
     chosen: list[int]       # indices into the pending list, EDF order
-    expired: list[int]      # indices past their deadline
+    expired: list[int]      # past deadline OR longer than every bucket
     len_bucket: int
     batch_bucket: int
 
@@ -106,8 +116,13 @@ def _plan_prefill_py(
     lens, deadlines_us, now_us: int, free_slots: int, max_batch: int, len_buckets
 ) -> PrefillPlan:
     """Reference implementation — semantics identical to gofr_plan_prefill."""
-    expired = [i for i, d in enumerate(deadlines_us) if 0 < d < now_us]
-    valid = [i for i in range(len(lens)) if not (0 < deadlines_us[i] < now_us)]
+    max_bucket = len_buckets[-1]
+    expired = [
+        i for i in range(len(lens))
+        if 0 < deadlines_us[i] < now_us or lens[i] > max_bucket
+    ]
+    dead = set(expired)
+    valid = [i for i in range(len(lens)) if i not in dead]
     if not valid or free_slots <= 0 or max_batch <= 0:
         return PrefillPlan([], expired, 0, 0)
     valid.sort(key=lambda i: (deadlines_us[i] if deadlines_us[i] > 0 else 2**62, i))
@@ -127,7 +142,9 @@ def plan_prefill(
     """EDF + bucket-affinity prefill packing: the earliest-deadline request
     leads and sets the length bucket; only requests fitting that bucket
     join the batch, so one long prompt never inflates everyone's padding.
-    ``deadlines_us[i] <= 0`` means no deadline."""
+    ``deadlines_us[i] <= 0`` means no deadline. Requests longer than the
+    largest bucket are unschedulable and come back in ``expired`` (the
+    caller fails them) rather than starving silently."""
     lib = load_native()
     n = len(lens)
     if lib is None or n == 0:

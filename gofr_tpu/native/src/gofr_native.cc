@@ -42,10 +42,12 @@ extern "C" {
 // indices into out_expired (cap n), bucket results into out_len_bucket /
 // out_batch_bucket. Returns the number chosen; *out_n_expired set.
 //
-// Policy: requests past deadline are expired. The earliest-deadline (ties:
-// FIFO) request leads; the batch is filled, in EDF order, only with
-// requests that fit the leader's length bucket — a longer request never
-// inflates everyone's padding, it simply leads its own batch next round.
+// Policy: requests past deadline — or longer than the largest length
+// bucket (unschedulable, ever) — are reported in out_expired. The
+// earliest-deadline (ties: FIFO) request leads; the batch is filled, in
+// EDF order, only with requests that fit the leader's length bucket — a
+// longer request never inflates everyone's padding, it simply leads its
+// own batch next round.
 int gofr_plan_prefill(
     const int32_t* lens, const int64_t* deadlines_us, int32_t n,
     int64_t now_us, int32_t free_slots, int32_t max_batch,
@@ -59,10 +61,11 @@ int gofr_plan_prefill(
 
   // expiry is reported even when no slot is free — the engine must fail
   // timed-out requests promptly, not strand them in the pending list
+  const int32_t max_bucket = len_buckets[n_buckets - 1];
   std::vector<int32_t> valid;
   valid.reserve(n);
   for (int32_t i = 0; i < n; ++i) {
-    if (deadlines_us[i] > 0 && deadlines_us[i] < now_us) {
+    if ((deadlines_us[i] > 0 && deadlines_us[i] < now_us) || lens[i] > max_bucket) {
       out_expired[(*out_n_expired)++] = i;
     } else {
       valid.push_back(i);

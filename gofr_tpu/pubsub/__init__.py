@@ -107,10 +107,22 @@ def connect_pubsub(backend: str, config, logger, metrics):
 
         return KafkaBroker(config, logger, metrics)
     if backend in ("google", "gcp"):
-        logger.warn("PUBSUB_BACKEND=google requires google-cloud-pubsub (not installed); pubsub not wired")
-        return None
+        try:
+            from google.cloud import pubsub_v1  # type: ignore[import-not-found]  # noqa: F401
+        except ImportError:
+            logger.warn("PUBSUB_BACKEND=google but google-cloud-pubsub not installed; pubsub not wired")
+            return None
+        from gofr_tpu.pubsub.google import GooglePubSubBroker
+
+        return GooglePubSubBroker(config, logger, metrics)
     if backend == "mqtt":
-        logger.warn("PUBSUB_BACKEND=mqtt requires paho-mqtt (not installed); pubsub not wired")
-        return None
+        try:
+            import paho.mqtt.client  # type: ignore[import-not-found]  # noqa: F401
+        except ImportError:
+            logger.warn("PUBSUB_BACKEND=mqtt but paho-mqtt not installed; pubsub not wired")
+            return None
+        from gofr_tpu.pubsub.mqtt import MqttBroker
+
+        return MqttBroker(config, logger, metrics)
     logger.warnf("unknown PUBSUB_BACKEND %r; pubsub not wired", backend)
     return None

@@ -1,0 +1,149 @@
+"""MQTT backend (gofr `pkg/gofr/datasource/pubsub/mqtt/` parity).
+
+Per-topic subscription queues under a lock (`mqtt.go:38,156-170`),
+QoS/ordering/keepalive from config (`container.go:126-161`), and the
+callback-style ``subscribe_with_function`` (`mqtt.go:298`). The paho client
+is injectable (``client_factory``) so the driver tests hermetically;
+``FakeMqttClient`` is an in-tree loopback implementing the client surface
+the driver touches.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from typing import Any, Callable
+
+from gofr_tpu.pubsub import Message, encode_payload
+
+
+class MqttBroker:
+    def __init__(self, config, logger, metrics, client_factory: Callable[..., Any] | None = None):
+        self._logger = logger
+        self._metrics = metrics
+        self._host = config.get_or_default("MQTT_HOST", "localhost")
+        self._port = config.get_int("MQTT_PORT", 1883)
+        self._qos = config.get_int("MQTT_QOS", 1)
+        self._keepalive = config.get_int("MQTT_KEEP_ALIVE", 30)
+        client_id = config.get("MQTT_CLIENT_ID") or f"gofr-tpu-{uuid.uuid4().hex[:8]}"
+
+        if client_factory is None:
+            import paho.mqtt.client as paho  # type: ignore[import-not-found]
+
+            def client_factory(cid):  # noqa: F811
+                return paho.Client(client_id=cid, clean_session=False)
+
+        self._client = client_factory(client_id)
+        self._queues: dict[str, queue.Queue] = {}
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._client.on_message = self._on_message
+        self._client.connect(self._host, self._port, self._keepalive)
+        if hasattr(self._client, "loop_start"):
+            self._client.loop_start()
+
+    # -- internals -------------------------------------------------------------
+
+    def _queue_for(self, topic: str) -> queue.Queue:
+        with self._lock:
+            if topic not in self._queues:
+                self._queues[topic] = queue.Queue()
+                self._client.subscribe(topic, qos=self._qos)
+            return self._queues[topic]
+
+    def _on_message(self, _client, _userdata, msg) -> None:
+        with self._lock:
+            q = self._queues.get(msg.topic)
+        if q is not None:
+            q.put(msg.payload)
+
+    # -- broker interface ------------------------------------------------------
+
+    def publish(self, topic: str, payload: Any) -> None:
+        info = self._client.publish(topic, encode_payload(payload), qos=self._qos)
+        if hasattr(info, "wait_for_publish"):
+            info.wait_for_publish(timeout=30)
+
+    def subscribe(self, topic: str, group: str = "default", timeout: float | None = None) -> Message | None:
+        q = self._queue_for(topic)
+        try:
+            value = q.get(timeout=timeout if timeout is not None else 1.0)
+        except queue.Empty:
+            return None
+        # MQTT QoS handles redelivery at the protocol layer; commit is a no-op
+        return Message(topic, value, metadata={"group": group}, committer=lambda: None)
+
+    def subscribe_with_function(self, topic: str, fn: Callable[[Message], Any]) -> None:
+        """Callback-style subscription (`mqtt.go:298` parity): ``fn`` runs on
+        a daemon thread per delivered message. Handler exceptions are logged
+        and consumption continues; the thread exits when the broker closes."""
+
+        def loop():
+            while not self._closed.is_set():
+                msg = self.subscribe(topic, timeout=1.0)
+                if msg is None:
+                    continue
+                try:
+                    fn(msg)
+                except Exception as e:  # noqa: BLE001
+                    if self._logger:
+                        self._logger.error(f"mqtt handler for {topic!r} failed: {e!r}")
+
+        threading.Thread(target=loop, daemon=True, name=f"mqtt-sub-{topic}").start()
+
+    def create_topic(self, topic: str) -> None:
+        self._queue_for(topic)
+
+    def delete_topic(self, topic: str) -> None:
+        with self._lock:
+            if topic in self._queues:
+                self._client.unsubscribe(topic)
+                del self._queues[topic]
+
+    def health_check(self) -> dict[str, Any]:
+        connected = True
+        if hasattr(self._client, "is_connected"):
+            try:
+                connected = bool(self._client.is_connected())
+            except Exception:  # noqa: BLE001
+                connected = False
+        return {
+            "status": "UP" if connected else "DOWN",
+            "details": {"host": self._host, "port": self._port, "qos": self._qos},
+        }
+
+    def close(self) -> None:
+        self._closed.set()
+        if hasattr(self._client, "loop_stop"):
+            self._client.loop_stop()
+        self._client.disconnect()
+
+
+class FakeMqttClient:
+    """In-tree loopback client: publish delivers straight to on_message."""
+
+    def __init__(self, *_a, **_kw):
+        self.on_message = None
+        self._subscribed: set[str] = set()
+        self._connected = False
+
+    def connect(self, *_a, **_kw):
+        self._connected = True
+
+    def disconnect(self):
+        self._connected = False
+
+    def is_connected(self):
+        return self._connected
+
+    def subscribe(self, topic, qos=0):
+        self._subscribed.add(topic)
+
+    def unsubscribe(self, topic):
+        self._subscribed.discard(topic)
+
+    def publish(self, topic, payload, qos=0):
+        if topic in self._subscribed and self.on_message is not None:
+            msg = type("_Msg", (), {"topic": topic, "payload": payload})()
+            self.on_message(self, None, msg)

@@ -191,7 +191,7 @@ class _EngineBase:
             return {"status": "DOWN", "details": {"error": str(self._startup_error)}}
         return {
             "status": "UP" if self._thread is not None and self._thread.is_alive() else "DEGRADED",
-            "details": {"queue_depth": self._queue.qsize()},
+            "details": {"queue_depth": self._backlog()},
         }
 
 
@@ -647,12 +647,20 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
     conf = container.config
 
     if spec.weights:
-        from gofr_tpu.models import convert
+        from gofr_tpu.train.checkpoint import is_checkpoint_dir, load_params
 
-        converter = getattr(convert, f"{spec.family}_from_hf", None)
-        if converter is None:
-            raise ValueError(f"no weight converter for family {spec.family!r}")
-        cfg, params = converter(spec.weights, dtype=spec.dtype)
+        if is_checkpoint_dir(spec.weights):
+            # orbax checkpoint dir (train/checkpoint.py): config must be given
+            cfg = _resolve_config(spec.family, spec.config)
+            like = jax.eval_shape(lambda: family.init(cfg, jax.random.key(0)))
+            params = load_params(spec.weights, like)
+        else:
+            from gofr_tpu.models import convert
+
+            converter = getattr(convert, f"{spec.family}_from_hf", None)
+            if converter is None:
+                raise ValueError(f"no weight converter for family {spec.family!r}")
+            cfg, params = converter(spec.weights, dtype=spec.dtype)
     else:
         cfg = _resolve_config(spec.family, spec.config)
         params = family.init(cfg, jax.random.key(int(kw.pop("seed", 0))))

@@ -1,0 +1,81 @@
+"""Checkpoint / resume (orbax-backed).
+
+The reference's only durable-progress machinery is the versioned migration
+table (SURVEY.md §5.4); the TPU build needs real state checkpointing:
+
+- training: save/restore the full TrainState (params + optimizer moments +
+  step) with shardings preserved — restore places every leaf back on the
+  same mesh layout, so resume works across process restarts on the same
+  topology (and across topologies by passing different shardings).
+- serving: ``save_params`` / ``load_params`` let ModelSpec.weights point at
+  a checkpoint directory instead of an HF id (engine.build_engine).
+
+Layout: ``<dir>/<step>/state`` via orbax CheckpointManager — idempotent
+re-run semantics like the migration runner (skip ≤ last applied;
+`migration.go:55-62` analog: ``latest_step`` + ``restore``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _manager(directory: str, max_to_keep: int | None = 3) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+    )
+
+
+def save_checkpoint(directory: str, state: Any, step: int | None = None,
+                    max_to_keep: int | None = 3) -> int:
+    """Save a pytree (e.g. TrainState) at ``step`` (default: state.step).
+    Returns the step saved. Blocks until the write is durable."""
+    if step is None:
+        step = int(jax.device_get(getattr(state, "step", 0)))
+    with _manager(directory, max_to_keep) as mgr:
+        mgr.save(step, args=ocp.args.StandardSave(state))
+        mgr.wait_until_finished()
+    return step
+
+
+def latest_step(directory: str) -> int | None:
+    """Newest saved step, or None when the directory holds no checkpoints."""
+    if not os.path.isdir(directory):
+        return None
+    with _manager(directory, None) as mgr:
+        return mgr.latest_step()
+
+
+def restore_checkpoint(directory: str, target: Any, step: int | None = None) -> Any:
+    """Restore into the structure/shardings of ``target`` (a concrete pytree
+    or jax.eval_shape result with shardings). ``step`` defaults to latest."""
+    with _manager(directory, None) as mgr:
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory!r}")
+        return mgr.restore(step, args=ocp.args.StandardRestore(target))
+
+
+def save_params(directory: str, params: Any) -> None:
+    """Serving-weights save: bare param pytree at step 0."""
+    save_checkpoint(directory, params, step=0, max_to_keep=1)
+
+
+def load_params(directory: str, like: Any) -> Any:
+    """Serving-weights load shaped/sharded like ``like`` (an abstract or
+    concrete param pytree)."""
+    return restore_checkpoint(directory, like)
+
+
+def is_checkpoint_dir(path: str) -> bool:
+    """Heuristic used by build_engine to tell a checkpoint directory from an
+    HF model id: a local dir containing at least one numeric step dir."""
+    if not os.path.isdir(path):
+        return False
+    return any(name.isdigit() for name in os.listdir(path))

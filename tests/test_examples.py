@@ -1,0 +1,144 @@
+"""Integration tests over the runnable examples (examples/*) — the
+reference's example-tier test strategy (SURVEY.md §4 tier 2): start the
+real app, hit it over real HTTP, assert the JSON envelope."""
+
+import asyncio
+import importlib.util
+import io
+import os
+import sys
+import time
+
+import httpx
+import pytest
+
+from tests.test_http_server import AppHarness
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+
+def load_example(name: str):
+    path = os.path.join(EXAMPLES, name, "main.py")
+    spec = importlib.util.spec_from_file_location(f"example_{name.replace('-', '_')}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_http_server_example():
+    app = load_example("http-server").build_app()
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:
+        r = c.get("/greet", params={"name": "Ada"})
+        assert r.status_code == 200 and r.json()["data"] == "Hello Ada!"
+        r = c.post("/person", json={"name": "ada", "age": 36})
+        assert r.status_code == 201
+        r = c.get("/person/ada")
+        assert r.json()["data"] == {"name": "ada", "age": 36}
+        r = c.get("/person/nobody")
+        assert r.status_code == 404 and "error" in r.json()
+        assert c.get("/.well-known/health").json()["data"]["status"] == "UP"
+
+
+def test_serving_llm_example():
+    app = load_example("serving-llm").build_app()
+    with AppHarness(app) as h, httpx.Client(base_url=h.base, timeout=300) as c:
+        r = c.post("/generate", json={"prompt": [1, 2, 3], "max_new_tokens": 4})
+        assert r.status_code == 201, r.text
+        data = r.json()["data"]
+        assert len(data["tokens"]) == 4 and data["finish_reason"] == "length"
+
+
+def test_rest_handlers_example():
+    app = load_example("using-add-rest-handlers").build_app()
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:
+        r = c.post("/book", json={"id": 1, "title": "SICP", "year": 1985})
+        assert r.status_code == 201, r.text
+        assert c.get("/book/1").json()["data"]["title"] == "SICP"
+        c.put("/book/1", json={"id": 1, "title": "SICP", "year": 1996})
+        assert c.get("/book/1").json()["data"]["year"] == 1996
+        assert len(c.get("/book").json()["data"]) == 1
+        assert c.delete("/book/1").status_code == 204
+        assert c.get("/book/1").status_code == 404
+
+
+def test_pubsub_example():
+    mod = load_example("using-pubsub")
+    mod.PROCESSED.clear()
+    app = mod.build_app()
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:
+        r = c.post("/order", json={"id": 42, "qty": 2})
+        assert r.status_code == 201
+        deadline = time.time() + 10
+        while time.time() < deadline and not mod.PROCESSED:
+            time.sleep(0.05)
+        assert mod.PROCESSED == [{"id": 42, "qty": 2}]
+
+
+def test_cron_example():
+    mod = load_example("using-cron-jobs")
+    mod.RUNS.clear()
+    app = mod.build_app()
+    assert [j.name for j in app.cron.jobs] == ["heartbeat"]
+    app.cron.tick(time.time())  # fire synchronously instead of waiting a minute
+    deadline = time.time() + 5
+    while time.time() < deadline and not mod.RUNS:
+        time.sleep(0.05)
+    assert len(mod.RUNS) >= 1
+
+
+def test_sample_cmd_example():
+    mod = load_example("sample-cmd")
+    app = mod.build_app()
+    out, err = io.StringIO(), io.StringIO()
+    code = app.run(["hello", "-name=Ada"], out=out, err=err)
+    assert code == 0 and "Hello Ada!" in out.getvalue()
+    out2 = io.StringIO()
+    assert app.run(["hello", "-name=Ada", "-shout"], out=out2, err=err) == 0
+    assert "HELLO ADA!" in out2.getvalue()
+    outh = io.StringIO()
+    app.run(["--help"], out=outh, err=err)
+    assert "hello" in outh.getvalue() and "version" in outh.getvalue()
+
+
+def test_migrations_example():
+    app = load_example("using-migrations").build_app()
+    rows = app.container.sql.query("SELECT version FROM gofr_migrations ORDER BY version")
+    assert len(rows) == 2
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:
+        r = c.post("/user", json={"name": "ada", "email": "ada@x.io"})
+        assert r.status_code == 201
+        users = c.get("/user").json()["data"]
+        assert users == [{"name": "ada", "email": "ada@x.io"}]
+
+
+def test_web_socket_example():
+    import aiohttp
+
+    app = load_example("using-web-socket").build_app()
+    with AppHarness(app) as h:
+        async def roundtrip():
+            async with aiohttp.ClientSession() as session:
+                async with session.ws_connect(f"{h.base}/ws") as ws:
+                    await ws.send_json({"n": 1})
+                    return await ws.receive_json(timeout=10)
+
+        got = asyncio.run(roundtrip())
+        assert got == {"echo": {"n": 1}, "via": "gofr-tpu"}
+
+
+def test_http_service_example():
+    # downstream app the example's service client calls
+    from gofr_tpu.config import DictConfig
+    from gofr_tpu import App
+
+    down = App(config=DictConfig({"HTTP_PORT": "8819", "METRICS_PORT": "9819",
+                                  "LOG_LEVEL": "ERROR"}))
+    down.get("/item", lambda ctx: {"sku": "tpu-v5e", "stock": 8})
+    with AppHarness(down) as hd:
+        app = load_example("using-http-service").build_app(hd.base)
+        with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:
+            r = c.get("/fetch")
+            assert r.status_code == 200, r.text
+            body = r.json()["data"]
+            assert body["status"] == 200
+            assert body["downstream"]["data"]["sku"] == "tpu-v5e"

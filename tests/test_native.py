@@ -123,3 +123,11 @@ def test_loader_feeds_train_step(corpus):
         lengths = jnp.full((8,), 16, jnp.int32)
         state, metrics = step_fn(state, tokens, lengths)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_planner_oversize_prompt_reported_not_starved():
+    # longer than every bucket → unschedulable: reported in expired, and the
+    # rest of the queue still schedules
+    plan = plan_prefill([500, 10], [100, 0], now_us=0, free_slots=4, max_batch=4,
+                        len_buckets=BUCKETS)
+    assert plan.expired == [0] and plan.chosen == [1]
