@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import itertools
 import queue
+from functools import partial
 import threading
 import time
 from typing import Any, Callable, Iterator
@@ -339,6 +340,7 @@ class GenerateEngine(_EngineBase):
         max_len: int = 2048,
         prefill_buckets: list[int] | None = None,
         max_prefill_batch: int = 4,
+        decode_chunk: int = 8,
         eos_token_id: int | None = None,
         top_k: int = 0,
         top_p: float = 1.0,
@@ -361,7 +363,17 @@ class GenerateEngine(_EngineBase):
         self.top_k = top_k
         self.top_p = top_p
 
-        self.cache = family.make_cache(cfg, slots, self.max_len)
+        # K decode steps run on-device per host round trip, with sampling
+        # fused into the step — the host sees [slots, K] int32 tokens, never
+        # logits. This is the difference between per-token host syncs (the
+        # reference's per-request goroutine equivalent) and a device-resident
+        # loop; it also keeps serving fast over high-latency device links.
+        self.decode_chunk = max(1, decode_chunk)
+        self.max_len = min(self.max_len, cfg.max_seq_len - self.decode_chunk)
+        # cache headroom so a chunk never writes past Smax; round to a
+        # kernel-friendly multiple of 128 when the model allows it
+        cache_len = min(-(-(self.max_len + self.decode_chunk) // 128) * 128, cfg.max_seq_len)
+        self.cache = family.make_cache(cfg, slots, cache_len)
         self.slots: list[_Slot | None] = [None] * slots
         self._pending: list[tuple[Request, np.ndarray]] = []
         self._base_key = jax.random.key(seed)
@@ -369,11 +381,28 @@ class GenerateEngine(_EngineBase):
 
         ts = (top_k, top_p)
 
-        @jax.jit
-        def _sample(logits, key, temps):
-            return sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
+        @partial(jax.jit, donate_argnums=(3,))
+        def _prefill_sample(params, tokens, lengths, cache, slot_ids, key, temps):
+            logits, cache = family.prefill(cfg, params, tokens, lengths, cache, slot_ids)
+            toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
+            return toks, cache
 
-        self._sample = _sample
+        @partial(jax.jit, static_argnums=(6,), donate_argnums=(3,))
+        def _decode_chunk(params, tokens, positions, cache, key, temps, steps):
+            def body(carry, _):
+                toks, pos, cache, key = carry
+                logits, cache = family.decode_step(cfg, params, toks, pos, cache)
+                key, sub = jax.random.split(key)
+                nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
+                return (nxt, pos + 1, cache, key), nxt
+
+            (toks, pos, cache, key), out = jax.lax.scan(
+                body, (tokens, positions, cache, key), None, length=steps
+            )
+            return out.T, cache  # [slots, K]
+
+        self._prefill_sample = _prefill_sample
+        self._decode_chunk = _decode_chunk
 
     # -- public API ------------------------------------------------------------
 
@@ -522,13 +551,13 @@ class GenerateEngine(_EngineBase):
             temps[i] = float(req.kw.get("temperature", 0.0))
 
         t0 = time.monotonic()
-        logits, self.cache = self.family.prefill(
-            self.cfg, self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-            self.cache, jnp.asarray(slot_ids),
-        )
         self._step_count += 1
         key = jax.random.fold_in(self._base_key, self._step_count)
-        first = np.asarray(self._sample(logits, key, jnp.asarray(temps)))
+        first_dev, self.cache = self._prefill_sample(
+            self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+            self.cache, jnp.asarray(slot_ids), key, jnp.asarray(temps),
+        )
+        first = np.asarray(first_dev)  # [nb] int32 — tokens, never logits
         self._record_step("prefill", time.monotonic() - t0, n / nb, ("prefill", lb, nb))
         self.metrics.increment_counter("app_tpu_tokens_total", int(lengths[:n].sum()) + n)
 
@@ -553,9 +582,14 @@ class GenerateEngine(_EngineBase):
         if not active:
             return False
         n = self.num_slots
+        k = self.decode_chunk
         tokens = np.zeros((n,), np.int32)
         positions = np.zeros((n,), np.int32)
         temps = np.zeros((n,), np.float32)
+        # always the FULL chunk — one compiled decode program for the whole
+        # serving lifetime. A slot that hits its budget/EOS mid-chunk simply
+        # has its surplus tokens discarded (the cache carries decode_chunk
+        # slack past max_len, so overshoot writes stay in bounds).
         for i in active:
             s = self.slots[i]
             tokens[i] = s.last_token
@@ -563,16 +597,17 @@ class GenerateEngine(_EngineBase):
             temps[i] = float(s.request.kw.get("temperature", 0.0))
 
         t0 = time.monotonic()
-        logits, self.cache = self.family.decode_step(
-            self.cfg, self.params, jnp.asarray(tokens), jnp.asarray(positions), self.cache
-        )
         self._step_count += 1
         key = jax.random.fold_in(self._base_key, self._step_count)
-        sampled = np.asarray(self._sample(logits, key, jnp.asarray(temps)))
-        self._record_step("decode", time.monotonic() - t0, len(active) / n, ("decode", n))
-        self.metrics.increment_counter("app_tpu_tokens_total", len(active))
+        chunk_dev, self.cache = self._decode_chunk(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            self.cache, key, jnp.asarray(temps), k,
+        )
+        chunk = np.asarray(chunk_dev)  # [slots, k] int32 — tokens, never logits
+        self._record_step("decode", time.monotonic() - t0, len(active) / n, ("decode", n, k))
 
         now = time.monotonic()
+        accepted = 0
         for i in active:
             s = self.slots[i]
             if s.request.cancelled or s.request.expired(now):
@@ -580,12 +615,17 @@ class GenerateEngine(_EngineBase):
                 self.slots[i] = None
                 s.request.complete(error=RequestTimeout())
                 continue
-            tok = int(sampled[i])
-            s.pos += 1
-            s.last_token = tok
-            s.generated.append(tok)
-            self._emit(s, tok)
-            self._maybe_finish(i)
+            for j in range(k):
+                tok = int(chunk[i, j])
+                s.pos += 1
+                s.last_token = tok
+                s.generated.append(tok)
+                accepted += 1
+                self._emit(s, tok)
+                self._maybe_finish(i)
+                if self.slots[i] is None:  # EOS/length mid-chunk: rest discarded
+                    break
+        self.metrics.increment_counter("app_tpu_tokens_total", accepted)
         return True
 
     # -- completion ------------------------------------------------------------
@@ -680,6 +720,7 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             family, cfg, params, container,
             slots=int(kw.pop("slots", conf.get_int("ENGINE_SLOTS", 8))),
             max_len=int(kw.pop("max_len", conf.get_int("ENGINE_MAX_LEN", 2048))),
+            decode_chunk=int(kw.pop("decode_chunk", conf.get_int("ENGINE_DECODE_CHUNK", 8))),
             max_prefill_batch=int(kw.pop("max_prefill_batch", conf.get_int("ENGINE_PREFILL_BATCH", 4))),
             eos_token_id=eos,
             tokenizer=tokenizer,
