@@ -8,103 +8,256 @@ Prints ONE JSON line:
 v5e-8 (BASELINE.json) prorated to a single chip. The reference publishes
 no numbers of its own (BASELINE.md), so the north-star target is the bar.
 
+Backend acquisition is failure-tolerant (round-1 lesson: the 'axon' TPU
+plugin can hang at init when the chip tunnel is down, and a hang/traceback
+was the round's only artifact). We probe TPU init in a SUBPROCESS with a
+timeout, retry once, and on failure pin the CPU backend and run a scaled
+preset — the JSON line always appears, with the platform reported honestly.
+
 Env knobs:
-    GOFR_BENCH_PRESET    one_b (default) | tiny  (tiny = CPU smoke test)
-    GOFR_BENCH_REQUESTS  total requests (default 64)
-    GOFR_BENCH_SLOTS     decode slots (default 16)
-    GOFR_BENCH_PROMPT    prompt length (default 64)
-    GOFR_BENCH_NEW       generated tokens per request (default 64)
+    GOFR_BENCH_PRESET        one_b (default on TPU) | tiny (default on CPU fallback)
+    GOFR_BENCH_REQUESTS      total requests (default 64 TPU / 8 CPU)
+    GOFR_BENCH_SLOTS         decode slots (default 16)
+    GOFR_BENCH_CHUNK         decode chunk (default 8)
+    GOFR_BENCH_PROMPT        prompt length (default 64)
+    GOFR_BENCH_NEW           generated tokens per request (default 64)
+    GOFR_BENCH_PLATFORM      force 'cpu' or 'tpu' (skips the probe)
+    GOFR_BENCH_PROBE_S       TPU init probe timeout seconds (default 240)
+    GOFR_BENCH_SWEEP         1 = sweep slots x decode_chunk, keep best
+    GOFR_TPU_PEAK_TFLOPS     override bf16 peak for MFU (default by device kind)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax  # noqa: E402
-import numpy as np  # noqa: E402
+_PROBE_SRC = "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform + ',KIND=' + d[0].device_kind)"
+
+
+def _pin_cpu() -> None:
+    from jaxpin import pin_cpu
+
+    pin_cpu(1)
+
+
+def _probe_tpu(timeout_s: float) -> tuple[bool, str]:
+    """Initialize the default (TPU) backend in a subprocess so a hung or
+    failing init can't take this process down. Returns (ok, detail)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s"
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout).strip().splitlines()[-1:] or ["no output"]
+        return False, f"probe rc={out.returncode}: {tail[0][:200]}"
+    marker = [ln for ln in out.stdout.splitlines() if ln.startswith("PLATFORM=")]
+    if not marker:
+        return False, "probe produced no platform marker"
+    detail = marker[0]
+    if "PLATFORM=cpu" in detail:
+        return False, f"default backend is cpu ({detail})"
+    return True, detail
+
+
+def acquire_backend() -> tuple[str, str]:
+    """→ (platform, diagnostic). Never hangs, never raises."""
+    forced = os.environ.get("GOFR_BENCH_PLATFORM")
+    if forced == "cpu":
+        _pin_cpu()
+        return "cpu", "forced by GOFR_BENCH_PLATFORM"
+    probe_s = float(os.environ.get("GOFR_BENCH_PROBE_S", "240"))
+    if forced == "tpu":
+        return "tpu", "forced by GOFR_BENCH_PLATFORM (no probe)"
+    detail = ""
+    # A hung tunnel is rarely transient: the retry probe gets a short budget
+    # so worst-case stall is probe_s + 60s, not 2x probe_s (round-1 rc=124
+    # was an outer-timeout kill while waiting on exactly this kind of hang).
+    for attempt, budget in ((1, probe_s), (2, min(60.0, probe_s))):
+        ok, detail = _probe_tpu(budget)
+        if ok:
+            return "tpu", f"attempt {attempt}: {detail}"
+        if "default backend is cpu" in detail:
+            break  # deterministic: no TPU plugin here, retry is wasted startup
+    _pin_cpu()
+    return "cpu", f"TPU unavailable, CPU fallback ({detail})"
+
+
+def _peak_flops(device) -> float:
+    """bf16 peak for MFU. Known TPU generations; env override wins."""
+    env = os.environ.get("GOFR_TPU_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    table = {"v6e": 918e12, "v6": 918e12, "v5p": 459e12, "v5e": 197e12,
+             "v5": 197e12, "v4": 275e12, "v3": 123e12}
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12  # assume v5e-class when unknown
+
+
+def _percentile(xs: list[float], p: float) -> float:
+    ys = sorted(xs)
+    idx = min(len(ys) - 1, max(0, int(round(p / 100.0 * (len(ys) - 1)))))
+    return ys[idx]
+
+
+def _run_once(engine_kw: dict, cfg, params, container, family, prompts,
+              max_new: int, timeout: float) -> dict:
+    """Serve all prompts through a fresh engine; return raw measurements."""
+    import numpy as np
+
+    from gofr_tpu.tpu.engine import GenerateEngine
+
+    engine = GenerateEngine(family, cfg, params, container, **engine_kw)
+    engine.start()
+    try:
+        # warmup: compile prefill + decode programs outside the timed window
+        engine.generate(prompts[0], max_new_tokens=2, timeout=timeout)
+
+        results: list[dict | None] = [None] * len(prompts)
+        errors: list[Exception] = []
+
+        def worker(i: int) -> None:
+            try:
+                results[i] = engine.generate(prompts[i], max_new_tokens=max_new, timeout=timeout)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.monotonic() - t0
+    finally:
+        engine.stop()
+
+    if errors or any(r is None for r in results):
+        raise RuntimeError(f"bench requests failed: {errors[:1]} "
+                           f"({sum(r is None for r in results)} incomplete)")
+    new_tokens = int(np.sum([len(r["tokens"]) for r in results]))
+    return {
+        "elapsed": elapsed,
+        "new_tokens": new_tokens,
+        "ttfts": [r["ttft_s"] for r in results],
+    }
 
 
 def main() -> None:
-    preset = os.environ.get("GOFR_BENCH_PRESET", "one_b")
-    n_requests = int(os.environ.get("GOFR_BENCH_REQUESTS", "64"))
-    slots = int(os.environ.get("GOFR_BENCH_SLOTS", "16"))
-    prompt_len = int(os.environ.get("GOFR_BENCH_PROMPT", "64"))
-    max_new = int(os.environ.get("GOFR_BENCH_NEW", "64"))
+    platform, backend_diag = acquire_backend()
+
+    import jax
+    import numpy as np
 
     from gofr_tpu.container import new_mock_container
     from gofr_tpu.models import LlamaConfig, llama
-    from gofr_tpu.tpu.engine import GenerateEngine
 
-    if preset == "tiny":
-        cfg = LlamaConfig.tiny()
-    else:
-        cfg = LlamaConfig.one_b()
+    on_cpu = platform == "cpu"
+    preset = os.environ.get("GOFR_BENCH_PRESET", "tiny" if on_cpu else "one_b")
+    n_requests = int(os.environ.get("GOFR_BENCH_REQUESTS", "8" if on_cpu else "64"))
+    slots = int(os.environ.get("GOFR_BENCH_SLOTS", "16"))
+    decode_chunk = int(os.environ.get("GOFR_BENCH_CHUNK", "8"))
+    prompt_len = int(os.environ.get("GOFR_BENCH_PROMPT", "64"))
+    max_new = int(os.environ.get("GOFR_BENCH_NEW", "16" if on_cpu else "64"))
+    timeout = 600.0 if on_cpu else 1200.0
+
+    cfg = LlamaConfig.tiny() if preset == "tiny" else LlamaConfig.one_b()
 
     container = new_mock_container()
     params = llama.init(cfg, jax.random.key(0))
-    max_len = prompt_len + max_new + 8
-    engine = GenerateEngine(
-        llama, cfg, params, container,
-        slots=slots, max_len=max_len,
-        max_prefill_batch=4,
-        prefill_buckets=[prompt_len],
-    )
-    engine.start()
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
 
     rng = np.random.RandomState(0)
     prompts = [rng.randint(1, cfg.vocab_size, size=prompt_len).tolist() for _ in range(n_requests)]
 
-    # warmup: compile prefill + decode programs
-    engine.generate(prompts[0], max_new_tokens=2, timeout=600)
+    def engine_kw(s: int, k: int) -> dict:
+        return dict(slots=s, max_len=prompt_len + max_new + 8,
+                    max_prefill_batch=4, decode_chunk=k,
+                    prefill_buckets=[prompt_len])
 
-    results = [None] * n_requests
-    errors: list[Exception] = []
+    best = (slots, decode_chunk)
+    sweep_log = []
+    if os.environ.get("GOFR_BENCH_SWEEP") == "1":
+        short = prompts[: max(4, n_requests // 4)]
+        best_rate = 0.0
+        # grid seeded with the operator's env-configured point so an explicit
+        # GOFR_BENCH_SLOTS/CHUNK is always measured, never silently dropped
+        grid = sorted({(s, k) for s in (8, 16, 32) for k in (4, 8, 16)} | {best})
+        for s, k in grid:
+            try:
+                m = _run_once(engine_kw(s, k), cfg, params, container, llama,
+                              short, max_new, timeout)
+            except Exception as e:  # noqa: BLE001
+                sweep_log.append({"slots": s, "chunk": k, "error": str(e)[:120]})
+                continue
+            rate = len(short) / m["elapsed"]
+            sweep_log.append({"slots": s, "chunk": k, "req_per_s": round(rate, 3)})
+            if rate > best_rate:
+                best_rate, best = rate, (s, k)
 
-    def worker(i: int) -> None:
-        try:
-            results[i] = engine.generate(prompts[i], max_new_tokens=max_new, timeout=1200)
-        except Exception as e:  # noqa: BLE001
-            errors.append(e)
-
-    t0 = time.monotonic()
-    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_requests)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    elapsed = time.monotonic() - t0
-    engine.stop()
-
-    if errors or any(r is None for r in results):
+    try:
+        m = _run_once(engine_kw(*best), cfg, params, container, llama,
+                      prompts, max_new, timeout)
+    except Exception as e:  # noqa: BLE001
         print(json.dumps({"metric": "bench_error", "value": 0, "unit": "req/s",
-                          "vs_baseline": 0, "error": str(errors[:1])}))
+                          "vs_baseline": 0, "error": str(e)[:400],
+                          "extra": {"platform": platform, "backend": backend_diag}}))
         sys.exit(1)
 
-    total_tokens = sum(len(r["tokens"]) for r in results)
+    elapsed = m["elapsed"]
     req_per_s = n_requests / elapsed
-    tok_per_s = total_tokens / elapsed
-    platform = jax.devices()[0].platform
+    tok_per_s = m["new_tokens"] / elapsed
 
+    # MFU: decode costs ~2*N FLOPs/token, prefill ~2*N per prompt token
+    # (attention FLOPs are <2% at these lengths; ignored — conservative).
+    # NB: the image's TPU plugin registers as platform 'axon', not 'tpu' —
+    # gate accelerator-only reporting on != 'cpu', same as the probe.
+    device = jax.devices()[0]
+    on_accel = device.platform != "cpu"
+    total_flops = 2.0 * n_params * (m["new_tokens"] + n_requests * prompt_len)
+    mfu = total_flops / elapsed / _peak_flops(device) if on_accel else None
+
+    extra = {
+        "decode_tokens_per_s": round(tok_per_s, 1),
+        "requests": n_requests,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "slots": best[0],
+        "decode_chunk": best[1],
+        "platform": device.platform,
+        "device_kind": getattr(device, "device_kind", "?"),
+        "backend": backend_diag,
+        "elapsed_s": round(elapsed, 2),
+        "n_params": n_params,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "ttft_p50_s": round(_percentile(m["ttfts"], 50), 4),
+        "ttft_p99_s": round(_percentile(m["ttfts"], 99), 4),
+    }
+    if sweep_log:
+        extra["sweep"] = sweep_log
+
+    # vs_baseline is only meaningful against the north-star bar (125 req/s/chip
+    # for one_b-class generate on TPU); a tiny-model CPU fallback could "beat"
+    # it vacuously, so report null there rather than an inflated ratio.
+    comparable = preset == "one_b" and on_accel
     print(json.dumps({
         "metric": f"llama_{preset}_generate_req_per_s_per_chip",
         "value": round(req_per_s, 3),
         "unit": "req/s",
-        "vs_baseline": round(req_per_s / 125.0, 4),
-        "extra": {
-            "decode_tokens_per_s": round(tok_per_s, 1),
-            "requests": n_requests,
-            "prompt_len": prompt_len,
-            "max_new_tokens": max_new,
-            "slots": slots,
-            "platform": platform,
-            "elapsed_s": round(elapsed, 2),
-        },
+        "vs_baseline": round(req_per_s / 125.0, 4) if comparable else None,
+        "extra": extra,
     }))
 
 

@@ -67,7 +67,7 @@ class Request:
     _ids = itertools.count()
 
     __slots__ = ("id", "inputs", "kw", "enqueued_at", "deadline", "stream_q",
-                 "_done", "_result", "_error", "cancelled")
+                 "_done", "_result", "_error", "cancelled", "_complete_lock")
 
     def __init__(self, inputs: Any, kw: dict[str, Any], timeout: float | None, stream: bool = False):
         self.id = next(Request._ids)
@@ -77,15 +77,22 @@ class Request:
         self.deadline = self.enqueued_at + timeout if timeout else None
         self.stream_q: queue.SimpleQueue | None = queue.SimpleQueue() if stream else None
         self._done = threading.Event()
+        self._complete_lock = threading.Lock()
         self._result: Any = None
         self._error: Exception | None = None
         self.cancelled = False
 
     def complete(self, result: Any = None, error: Exception | None = None) -> None:
-        self._result, self._error = result, error
-        if self.stream_q is not None:
-            self.stream_q.put(None)  # sentinel
-        self._done.set()
+        # Idempotent, first-writer-wins: stop()'s _fail_all can race a stuck
+        # device thread that later produces a result — the late writer must
+        # not overwrite the recorded outcome (ADVICE.md round 1).
+        with self._complete_lock:
+            if self._done.is_set():
+                return
+            self._result, self._error = result, error
+            if self.stream_q is not None:
+                self.stream_q.put(None)  # sentinel
+            self._done.set()
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -113,6 +120,9 @@ class _EngineBase:
         self.default_timeout = default_timeout
         self._queue: queue.Queue[Request] = queue.Queue()
         self._thread: threading.Thread | None = None
+        # requests currently inside a device call — visible to _fail_all so a
+        # wedged step can't strand its batch (their complete is idempotent)
+        self._inflight: list[Request] = []
         self._stop = threading.Event()
         self._compiled: set[tuple] = set()
         self._startup_error: Exception | None = None
@@ -130,12 +140,17 @@ class _EngineBase:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+            if self._thread.is_alive():
+                # Stuck device step: Request.complete is first-writer-wins,
+                # so failing everything now cannot be overwritten by a late
+                # result from the wedged thread.
+                self.logger.warn("engine thread did not stop within 10s; failing in-flight requests")
             self._thread = None
         self._fail_all(EngineClosed("engine stopped"))
 
     def _fail_all(self, error: Exception) -> None:
         """Fail everything waiting — the queue AND the drained-but-unadmitted
-        pending list (subclasses with richer state extend this)."""
+        pending list (GenerateEngine extends this with slot-resident requests)."""
         while True:
             try:
                 self._queue.get_nowait().complete(error=error)
@@ -145,6 +160,8 @@ class _EngineBase:
             req.complete(error=error)
         if hasattr(self, "_pending"):
             self._pending = []
+        for req in self._inflight:
+            req.complete(error=error)
 
     def _backlog(self) -> int:
         return self._queue.qsize() + len(getattr(self, "_pending", []))
@@ -277,6 +294,7 @@ class BatchEngine(_EngineBase):
         arrays = [np.asarray(self.encode_fn(r.inputs)) for r in batch]
         n = len(arrays)
         nb = next_bucket(n, self.batch_buckets)
+        self._inflight = list(batch)
         t0 = time.monotonic()
 
         if arrays[0].ndim == 1:  # token sequences: pad to a length bucket
@@ -298,10 +316,11 @@ class BatchEngine(_EngineBase):
             out = self.apply_fn(jnp.asarray(stacked))
 
         out = np.asarray(out)
+        self._inflight = []
         self._record_step("batch", time.monotonic() - t0, n / nb, signature)
         self.metrics.increment_counter("app_tpu_tokens_total", int(n))
         for i, r in enumerate(batch):
-            r.complete(result=self.decode_fn(out[i]))
+            r.complete(result=self.decode_fn(out[i]))  # idempotent: no-op if already failed
 
 
 # -- continuous batching (generate) --------------------------------------------
@@ -313,7 +332,8 @@ class _Slot:
     position the last token will be written to on the next decode step,
     i.e. ``prompt_len + len(generated) - 1``."""
 
-    __slots__ = ("request", "prompt_len", "pos", "generated", "max_total", "eos", "last_token")
+    __slots__ = ("request", "prompt_len", "pos", "generated", "max_total", "eos",
+                 "last_token", "first_token_at")
 
     def __init__(self, request: Request, prompt_len: int, max_total: int, eos: int | None, first_token: int):
         self.request = request
@@ -323,6 +343,7 @@ class _Slot:
         self.max_total = max_total
         self.eos = eos
         self.last_token = first_token
+        self.first_token_at = time.monotonic()
 
 
 class GenerateEngine(_EngineBase):
@@ -369,7 +390,16 @@ class GenerateEngine(_EngineBase):
         # reference's per-request goroutine equivalent) and a device-resident
         # loop; it also keeps serving fast over high-latency device links.
         self.decode_chunk = max(1, decode_chunk)
+        requested_max_len = self.max_len
         self.max_len = min(self.max_len, cfg.max_seq_len - self.decode_chunk)
+        if self.max_len < requested_max_len:
+            # Chunked decode needs decode_chunk of cache headroom past the
+            # last admitted position; surface the shrink so operators see why
+            # prompts near the advertised limit are rejected (ADVICE.md).
+            self.logger.warn(
+                f"engine max_len reduced {requested_max_len} -> {self.max_len} "
+                f"(decode_chunk={self.decode_chunk} headroom within cfg.max_seq_len={cfg.max_seq_len})"
+            )
         # cache headroom so a chunk never writes past Smax; round to a
         # kernel-friendly multiple of 128 when the model allows it
         cache_len = min(-(-(self.max_len + self.decode_chunk) // 128) * 128, cfg.max_seq_len)
@@ -457,6 +487,16 @@ class GenerateEngine(_EngineBase):
                 raise ValueError("string prompt but engine has no tokenizer; pass token ids")
             return np.asarray(self.tokenizer.encode(prompt), np.int32)
         return np.asarray(prompt, np.int32)
+
+    def _fail_all(self, error: Exception) -> None:
+        """Slot-resident requests must fail too — without this, a caller of a
+        request already admitted into a slot would block forever when the
+        engine stops with a wedged device thread."""
+        super()._fail_all(error)
+        for i, s in enumerate(self.slots):
+            if s is not None:
+                self.slots[i] = None
+                s.request.complete(error=error)
 
     def _free_slots(self) -> list[int]:
         return [i for i, s in enumerate(self.slots) if s is None]
@@ -553,11 +593,19 @@ class GenerateEngine(_EngineBase):
         t0 = time.monotonic()
         self._step_count += 1
         key = jax.random.fold_in(self._base_key, self._step_count)
+        self._inflight = [req for req, _ in ready]
         first_dev, self.cache = self._prefill_sample(
             self.params, jnp.asarray(tokens), jnp.asarray(lengths),
             self.cache, jnp.asarray(slot_ids), key, jnp.asarray(temps),
         )
+        self._inflight = []
         first = np.asarray(first_dev)  # [nb] int32 — tokens, never logits
+        if self._stop.is_set():
+            # stop() raced a wedged/slow prefill and already failed this batch
+            # (via _inflight); don't resurrect it into slots.
+            for req, _ in ready:
+                req.complete(error=EngineClosed("engine stopped"))
+            return True
         self._record_step("prefill", time.monotonic() - t0, n / nb, ("prefill", lb, nb))
         self.metrics.increment_counter("app_tpu_tokens_total", int(lengths[:n].sum()) + n)
 
@@ -610,6 +658,8 @@ class GenerateEngine(_EngineBase):
         accepted = 0
         for i in active:
             s = self.slots[i]
+            if s is None:
+                continue  # cleared by _fail_all while the step was in flight
             if s.request.cancelled or s.request.expired(now):
                 # slot invalidation: free the lane; in-flight work is discarded
                 self.slots[i] = None
@@ -648,6 +698,7 @@ class GenerateEngine(_EngineBase):
             "tokens": tokens,
             "text": self.tokenizer.decode(tokens) if self.tokenizer is not None else None,
             "finish_reason": finish,
+            "ttft_s": s.first_token_at - s.request.enqueued_at,
         }
         self.slots[slot_idx] = None
         s.request.complete(result=result)

@@ -1,0 +1,50 @@
+"""The single home for the pin-to-CPU-backend discipline.
+
+Used by tests/conftest.py, bench.py, and __graft_entry__.py — keep it
+import-light (no package imports) so it can run before anything touches jax.
+
+Image-specific constraints this encodes (see .claude/skills/verify/SKILL.md):
+- The sitecustomize/axon hook imports jax at interpreter startup. Backend
+  REGISTRATION happens then; INITIALIZATION happens at first device touch and
+  can hang indefinitely when the TPU tunnel is down.
+- ``jax.config.update("jax_platforms", "cpu")`` after import reliably avoids
+  TPU init. Setting ``JAX_PLATFORMS=cpu`` in the env of a NEW process instead
+  makes sitecustomize block at startup — never export it to children; strip
+  it from child envs and have the child call :func:`pin_cpu` itself.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def pin_cpu(n_devices: int = 1) -> None:
+    """Force the CPU backend with >= n_devices virtual chips for THIS process.
+
+    Safe to call repeatedly and after other jax imports, as long as no
+    backend has been initialized yet. XLA_FLAGS must be set before the CPU
+    client is created; an existing device-count flag is raised, never lowered.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    count = max(n_devices, int(m.group(1)) if m else 0)
+    want = f"--xla_force_host_platform_device_count={count}"
+    flags = flags.replace(m.group(0), want) if m else (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # a backend is already initialized; devices("cpu") still works
+
+
+def child_env(base: dict | None = None) -> dict:
+    """A copy of the environment safe for spawning python children: drops
+    JAX_PLATFORMS so the child's sitecustomize import cannot block."""
+    env = dict(os.environ if base is None else base)
+    env.pop("JAX_PLATFORMS", None)
+    return env

@@ -1,25 +1,20 @@
-"""Test harness: force JAX onto CPU with 8 virtual devices BEFORE jax imports.
+"""Test harness: force JAX onto CPU with 8 virtual devices BEFORE jax use.
 
 This is the analog of the reference's MockContainer strategy (SURVEY.md §4): unit
 tests run hermetically against a fake 8-chip mesh so every sharding/collective
-path is exercised without TPU hardware.
+path is exercised without TPU hardware. The pin discipline itself lives in one
+place — repo-root ``jaxpin.py`` (see its docstring for the sitecustomize/axon
+constraints) — shared with bench.py and __graft_entry__.py.
 """
 
 import os
+import sys
 
-# Force CPU. Env vars alone are too late here: the image's sitecustomize
-# imports jax at interpreter startup (registering a real-TPU backend), so
-# JAX_PLATFORMS is already captured. jax.config.update still works because
-# no backend has been *initialized* yet — but XLA_FLAGS must be in the env
-# before the CPU client is created, so set both.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from jaxpin import pin_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+pin_cpu(8)
 
 import pytest  # noqa: E402
 
