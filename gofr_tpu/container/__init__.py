@@ -88,6 +88,9 @@ class Container:
         m.new_histogram("app_tpu_step_seconds", "device step wall time (s)")
         m.new_gauge("app_tpu_queue_depth", "requests waiting for a device step")
         m.new_counter("app_tpu_tokens_total", "tokens processed (prefill+decode)")
+        m.new_gauge("app_tpu_kv_pages_free", "free pages in the paged KV pool")
+        m.new_counter("app_tpu_preemptions", "slots preempted under KV pool pressure")
+        m.new_counter("app_tpu_engine_restarts", "engine device-thread restarts")
 
     def _maybe_remote_log_level(self) -> None:
         url = self.config.get("REMOTE_LOG_URL")

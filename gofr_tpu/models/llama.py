@@ -29,8 +29,9 @@ from jax import lax
 
 from gofr_tpu.models.base import fan_in_init, truncated_normal
 from gofr_tpu.ops import apply_rope, mha_attention, rms_norm, rope_table
-from gofr_tpu.ops.attention import decode_attention
+from gofr_tpu.ops.attention import decode_attention, paged_decode_attention
 from gofr_tpu.ops.kvcache import SlotKVCache, append_tokens, write_prompts
+from gofr_tpu.ops.paged import PagedKVCache, append_tokens_paged, gather_kv, write_prompts_paged
 
 
 @dataclass(frozen=True)
@@ -331,3 +332,107 @@ def make_cache(cfg: LlamaConfig, slots: int, max_len: int | None = None) -> Slot
         cfg.num_layers, slots, max_len or cfg.max_seq_len, cfg.num_kv_heads,
         cfg.head_size, dtype=cfg.dtype,
     )
+
+
+# -- paged-cache entry points (ops.paged; SURVEY.md §7 stage 4) -----------------
+
+
+def make_paged_cache(cfg: LlamaConfig, pages: int, page_size: int = 128) -> PagedKVCache:
+    return PagedKVCache.create(
+        cfg.num_layers, pages, page_size, cfg.num_kv_heads, cfg.head_size,
+        dtype=cfg.dtype,
+    )
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=4)
+def prefill_paged(
+    cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray,
+    cache: PagedKVCache, pages: jnp.ndarray, offsets: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """Prefill prompts (or prompt CHUNKS) through per-row block tables.
+
+    tokens [B,S] (padded), lengths [B] = live tokens in THIS chunk,
+    ``pages`` [B, MaxP] = the full block table row per request (OOB = pool
+    size for padding rows / unallocated pages). ``offsets`` [B] places the
+    chunk at logical positions offsets..offsets+S (None = 0, whole-prompt
+    prefill). Chunked rows attend to the already-written cache through a
+    gathered view; whole-prompt rows attend prompt-locally, identical to
+    ``prefill``. Returns (last-chunk-token logits [B,V] f32, cache).
+    """
+    cos, sin = _rope(cfg)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    b, s = tokens.shape
+    page = cache.page_size
+    off = jnp.zeros((b,), jnp.int32) if offsets is None else offsets
+    positions = off[:, None] + jnp.arange(s)[None]  # [B,S] logical positions
+    row = jnp.arange(b)
+    chunked = offsets is not None
+    # pages holding THIS chunk's writes: logical pages off//page .. (off+s)//page
+    total = off + lengths  # [B] cache length after this chunk
+
+    def body(x, xs):
+        lp, k_layer, v_layer = xs
+        q, k, v = _qkv(cfg, lp, x)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        if chunked:
+            # scatter via per-token logical position -> physical page
+            pp = jnp.take_along_axis(
+                pages, jnp.minimum(positions // page, pages.shape[1] - 1), axis=1
+            )  # [B,S]
+            offs = positions % page
+            heads = jnp.arange(cfg.num_kv_heads)[None, None, :]
+            k_layer = k_layer.at[pp[:, :, None], heads, offs[:, :, None]].set(
+                k.astype(k_layer.dtype))
+            v_layer = v_layer.at[pp[:, :, None], heads, offs[:, :, None]].set(
+                v.astype(v_layer.dtype))
+            # attend over everything written so far (incl. this chunk)
+            k_view, v_view = gather_kv(k_layer, v_layer, pages)
+            attn = mha_attention(
+                q, k_view.swapaxes(1, 2), v_view.swapaxes(1, 2),
+                causal=True, q_offset=off, kv_lengths=total,
+            )
+        else:
+            k_layer, v_layer = write_prompts_paged(k_layer, v_layer, pages, k, v)
+            attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
+        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        x = x + _mlp(cfg, lp, x)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last = x[row, lengths - 1]  # [B,E]
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (last @ head).astype(jnp.float32)
+    return logits, PagedKVCache(k=new_k, v=new_v)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=4)
+def decode_step_paged(
+    cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, positions: jnp.ndarray,
+    cache: PagedKVCache, table: jnp.ndarray,
+) -> tuple[jnp.ndarray, PagedKVCache]:
+    """One decode step over every slot, K/V appended through the block
+    table. Contract matches ``decode_step`` with ``table`` [N, MaxP]."""
+    cos, sin = _rope(cfg)
+    x = params["embed"][tokens].astype(cfg.dtype)  # [N,E]
+    n = tokens.shape[0]
+    pos1 = positions[:, None]
+
+    def body(x, xs):
+        lp, k_layer, v_layer = xs
+        q, k, v = _qkv(cfg, lp, x[:, None])
+        q = apply_rope(q, pos1, cos, sin)[:, 0]
+        k = apply_rope(k, pos1, cos, sin)[:, 0]
+        v = v[:, 0]
+        k_layer, v_layer = append_tokens_paged(k_layer, v_layer, table, positions, k, v)
+        attn = paged_decode_attention(q, k_layer, v_layer, table, positions + 1)
+        x = x + attn.reshape(n, -1) @ lp["wo"]
+        x = x + _mlp(cfg, lp, x)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, PagedKVCache(k=new_k, v=new_v)

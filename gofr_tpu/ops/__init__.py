@@ -10,8 +10,9 @@ via the ``TPU_ATTENTION_BACKEND`` config.
 
 from gofr_tpu.ops.norms import layer_norm, rms_norm
 from gofr_tpu.ops.rope import apply_rope, rope_table
-from gofr_tpu.ops.attention import decode_attention, mha_attention
+from gofr_tpu.ops.attention import decode_attention, mha_attention, paged_decode_attention
 from gofr_tpu.ops.kvcache import SlotKVCache
+from gofr_tpu.ops.paged import PagedKVCache
 from gofr_tpu.ops.sampling import sample_token
 
 __all__ = [
@@ -21,6 +22,8 @@ __all__ = [
     "rope_table",
     "mha_attention",
     "decode_attention",
+    "paged_decode_attention",
     "SlotKVCache",
+    "PagedKVCache",
     "sample_token",
 ]

@@ -168,8 +168,12 @@ def decode_attention(
 
         smax = k_cache.shape[2]
         # An awkward Smax (e.g. prime) would degrade the kernel's kv block to
-        # a sliver and serialize the grid; the XLA path is faster then.
-        if _pick_block(smax, 512) >= min(smax, 128):
+        # a sliver and serialize the grid; the XLA path is faster then. The
+        # block must also be a multiple of 8 (f32 sublane tile) — Mosaic can
+        # reject or degrade odd second-minor block dims on hardware, and only
+        # the engine's 128-aligned caches are implicitly safe (ADVICE.md).
+        bkv = _pick_block(smax, 512)
+        if bkv >= min(smax, 128) and bkv % 8 == 0:
             return pallas_decode(
                 q, k_cache, v_cache, lengths, scale=scale, interpret=interpret_mode()
             )
@@ -183,3 +187,37 @@ def decode_attention(
     probs = _softmax(scores)
     out = jnp.einsum("bkgt,bktd->bkgd", probs.astype(v_cache.dtype), v_cache)
     return out.reshape(b, hq, d)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    backend: str = "auto",
+) -> jnp.ndarray:
+    """Single-step decode against a paged KV pool (ops.paged layout).
+
+    q [N, Hq, D]; k_pool/v_pool [P, Hkv, page, D]; table [N, MaxP] block
+    table (OOB entries == P); lengths [N] → out [N, Hq, D].
+
+    'pallas' streams pages straight out of the pool through scalar-prefetched
+    block tables (ops.pallas.paged_decode); 'xla' materializes each slot's
+    logical view with one gather (ops.paged.gather_kv) and reuses the dense
+    decode path — correct everywhere, but pays an extra HBM round trip.
+    """
+    page = k_pool.shape[2]
+    if resolve_backend(backend) == "pallas" and page % 8 == 0:
+        from gofr_tpu.ops.pallas import interpret_mode
+        from gofr_tpu.ops.pallas.paged_decode import paged_decode_attention as pallas_paged
+
+        return pallas_paged(
+            q, k_pool, v_pool, table, lengths, scale=scale, interpret=interpret_mode()
+        )
+    from gofr_tpu.ops.paged import gather_kv
+
+    k_view, v_view = gather_kv(k_pool, v_pool, table)
+    return decode_attention(q, k_view, v_view, lengths, scale=scale, backend="xla")

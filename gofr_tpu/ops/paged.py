@@ -1,0 +1,128 @@
+"""Paged (block) KV cache: fixed-size pages + per-slot block tables.
+
+The slot cache (gofr_tpu.ops.kvcache) reserves ``max_len`` of HBM per slot,
+so slot count x sequence length multiply into the HBM budget even when most
+requests are short. Here the cache is one physical POOL of pages
+
+    k, v: [L, P, Hkv, page_size, D]
+
+and each serving slot owns an ordered list of page ids — its *block table*.
+Logical position ``p`` of slot ``s`` lives at ``(table[s, p // page_size],
+p % page_size)``. HBM now scales with TOKENS IN FLIGHT, not slots x max_len:
+the engine admits more concurrent requests at equal HBM and reclaims pages
+the moment a request completes (SURVEY.md §7 stage 4 — no reference analog;
+this is the TPU-native subsystem the build plan orders).
+
+Layout mirrors the slot cache's head-major discipline: the last two dims of
+a page block are (page_size, D) = (128k, 128k)-alignable tiles, so both the
+XLA gather path and the Pallas paged-decode kernel stream [page, D] tiles
+straight out of HBM per (page, kv_head).
+
+Out-of-bounds convention: table entries for unallocated logical pages (and
+batch-padding rows) point at page id P (one past the pool). Scatter writes
+there are DROPPED by XLA, and gather reads CLAMP to page P-1 but are always
+masked by per-slot lengths — the same trick the slot engine uses for
+padding rows (engine._admit docstring).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PagedKVCache:
+    k: jnp.ndarray  # [L, P, Hkv, page, D]
+    v: jnp.ndarray  # [L, P, Hkv, page, D]
+
+    @classmethod
+    def create(
+        cls,
+        layers: int,
+        pages: int,
+        page_size: int,
+        kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "PagedKVCache":
+        shape = (layers, pages, kv_heads, page_size, head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+
+def write_prompts_paged(
+    k_layer: jnp.ndarray,  # [P, Hkv, page, D]
+    v_layer: jnp.ndarray,
+    pages: jnp.ndarray,    # [B, S_pages] physical page per logical page (P = dropped)
+    k_new: jnp.ndarray,    # [B, S, Hkv, D] activation layout
+    v_new: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write prefilled prompts at logical positions 0..S through per-row
+    block tables. ``pages[b, j]`` is the physical page holding positions
+    j*page .. (j+1)*page of row b."""
+    b, s, hkv, _ = k_new.shape
+    page = k_layer.shape[2]
+    pos = jnp.arange(s)
+    # physical page + in-page offset per (row, position)
+    pp = jnp.take_along_axis(pages, (pos // page)[None, :].repeat(b, 0), axis=1)  # [B,S]
+    off = (pos % page)[None, :].repeat(b, 0)  # [B,S]
+    rows = pp[:, :, None]
+    heads = jnp.arange(hkv)[None, None, :]
+    offs = off[:, :, None]
+    k_layer = k_layer.at[rows, heads, offs].set(k_new.astype(k_layer.dtype))
+    v_layer = v_layer.at[rows, heads, offs].set(v_new.astype(v_layer.dtype))
+    return k_layer, v_layer
+
+
+def append_tokens_paged(
+    k_layer: jnp.ndarray,   # [P, Hkv, page, D]
+    v_layer: jnp.ndarray,
+    table: jnp.ndarray,     # [N, MaxP] block table for every slot
+    positions: jnp.ndarray, # [N] logical write position per slot
+    k_new: jnp.ndarray,     # [N, Hkv, D]
+    v_new: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Append one token's K/V per slot at its current logical position."""
+    n, hkv, _ = k_new.shape
+    page = k_layer.shape[2]
+    pp = jnp.take_along_axis(table, (positions // page)[:, None], axis=1)[:, 0]  # [N]
+    off = positions % page
+    rows = pp[:, None]
+    heads = jnp.arange(hkv)[None, :]
+    k_layer = k_layer.at[rows, heads, off[:, None]].set(k_new.astype(k_layer.dtype))
+    v_layer = v_layer.at[rows, heads, off[:, None]].set(v_new.astype(v_layer.dtype))
+    return k_layer, v_layer
+
+
+def gather_kv(
+    k_layer: jnp.ndarray,  # [P, Hkv, page, D]
+    v_layer: jnp.ndarray,
+    table: jnp.ndarray,    # [N, MaxP]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Materialize the logical [N, Hkv, MaxP*page, D] view of each slot's
+    cache (XLA fallback read path; the Pallas paged-decode kernel reads the
+    pool directly instead). OOB table entries clamp — callers must mask by
+    lengths, which the attention ops already do."""
+    n, maxp = table.shape
+    _, hkv, page, d = k_layer.shape
+
+    def view(layer):
+        g = layer[jnp.minimum(table, layer.shape[0] - 1)]  # [N, MaxP, Hkv, page, D]
+        return g.transpose(0, 2, 1, 3, 4).reshape(n, hkv, maxp * page, d)
+
+    return view(k_layer), view(v_layer)

@@ -1,0 +1,126 @@
+"""Decode attention over the PAGED KV cache as a Pallas kernel.
+
+Same HBM-bound hot loop as decode_attention.py, but K/V tiles come out of
+the physical page pool [P, Hkv, page, D] through each slot's block table
+instead of a contiguous [Smax] row. The table and per-slot lengths ride in
+as SCALAR-PREFETCH operands (pltpu.PrefetchScalarGridSpec), so the BlockSpec
+index_map can resolve ``grid step (slot, head, logical_page) -> physical
+page`` BEFORE the DMA is issued — the kernel streams exactly the pages a
+slot owns, never a gather-materialized copy of the logical view (that copy
+is the XLA fallback, ops.paged.gather_kv).
+
+Grid: (slot, kv_head, logical_page); the page axis is ``arbitrary`` so the
+online-softmax scratch (common.py recurrence) carries across pages of one
+(slot, head). Unallocated logical pages (table entry == P) clamp to P-1 and
+are fully position-masked, contributing nothing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gofr_tpu.ops.pallas.common import (
+    NEG_INF,
+    init_softmax_scratch,
+    softmax_block_update,
+    softmax_finish,
+)
+
+
+def _paged_decode_kernel(
+    ln_ref,    # SMEM [N] per-slot live length (scalar prefetch)
+    table_ref, # SMEM [N, MaxP] block table (scalar prefetch)
+    q_ref,     # VMEM [1, 1, G, d]
+    k_ref,     # VMEM [1, 1, page, d] — the physical page picked by index_map
+    v_ref,     # VMEM [1, 1, page, d]
+    o_ref,     # VMEM [1, 1, G, d]
+    acc_ref,   # scratch f32 [G, d]
+    m_ref,     # scratch f32 [G, 128]
+    l_ref,     # scratch f32 [G, 128]
+    *,
+    scale: float,
+    page: int,
+    n_pages: int,
+    group: int,
+):
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+    init_softmax_scratch(pi, acc_ref, m_ref, l_ref)
+
+    q = q_ref[0, 0]  # [G, d]
+    k = k_ref[0, 0]  # [page, d]
+    v = v_ref[0, 0]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [G, page]
+
+    kv_pos = pi * page + jax.lax.broadcasted_iota(jnp.int32, (group, page), 1)
+    s = jnp.where(kv_pos < ln_ref[bi], s, NEG_INF)
+
+    softmax_block_update(s, v, acc_ref, m_ref, l_ref)
+
+    def write(out):
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+    softmax_finish(pi, n_pages, acc_ref, l_ref, write)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_decode_attention(
+    q: jnp.ndarray,        # [N, Hq, D]
+    k_pool: jnp.ndarray,   # [P, Hkv, page, D]
+    v_pool: jnp.ndarray,   # [P, Hkv, page, D]
+    table: jnp.ndarray,    # [N, MaxP] int32, OOB entries == P
+    lengths: jnp.ndarray,  # [N] live length per slot
+    *,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-step decode against the paged pool → [N, Hq, D]."""
+    n, hq, d = q.shape
+    pool, hkv, page, _ = k_pool.shape
+    _, maxp = table.shape
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not divisible by kv heads {hkv}")
+    group = hq // hkv
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+
+    q4 = q.reshape(n, hkv, group, d)
+    safe_table = jnp.minimum(table, pool - 1).astype(jnp.int32)
+
+    def kv_map(bi, hi, pi, ln_ref, table_ref):
+        return (table_ref[bi, pi], hi, 0, 0)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, scale=scale, page=page, n_pages=maxp, group=group
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(n, hkv, maxp),
+            in_specs=[
+                pl.BlockSpec((1, 1, group, d), lambda bi, hi, pi, ln, tb: (bi, hi, 0, 0)),
+                pl.BlockSpec((1, 1, page, d), kv_map),
+                pl.BlockSpec((1, 1, page, d), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, group, d), lambda bi, hi, pi, ln, tb: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, d), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, hkv, group, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), safe_table, q4, k_pool, v_pool)
+    return out.reshape(n, hq, d)

@@ -333,9 +333,10 @@ class _Slot:
     i.e. ``prompt_len + len(generated) - 1``."""
 
     __slots__ = ("request", "prompt_len", "pos", "generated", "max_total", "eos",
-                 "last_token", "first_token_at")
+                 "last_token", "first_token_at", "admit_seq", "prompt_tokens")
 
-    def __init__(self, request: Request, prompt_len: int, max_total: int, eos: int | None, first_token: int):
+    def __init__(self, request: Request, prompt_len: int, max_total: int, eos: int | None,
+                 first_token: int, admit_seq: int = 0, prompt_tokens: Any = None):
         self.request = request
         self.prompt_len = prompt_len
         self.pos = prompt_len
@@ -344,6 +345,8 @@ class _Slot:
         self.eos = eos
         self.last_token = first_token
         self.first_token_at = time.monotonic()
+        self.admit_seq = admit_seq       # preemption order (paged layout)
+        self.prompt_tokens = prompt_tokens  # kept for preemption re-prefill
 
 
 class GenerateEngine(_EngineBase):
@@ -368,6 +371,9 @@ class GenerateEngine(_EngineBase):
         tokenizer: Any = None,
         default_timeout: float | None = None,
         seed: int = 0,
+        kv_layout: str = "slot",
+        page_size: int = 128,
+        total_pages: int | None = None,
     ):
         super().__init__(container, default_timeout=default_timeout)
         self.family = family
@@ -400,10 +406,39 @@ class GenerateEngine(_EngineBase):
                 f"engine max_len reduced {requested_max_len} -> {self.max_len} "
                 f"(decode_chunk={self.decode_chunk} headroom within cfg.max_seq_len={cfg.max_seq_len})"
             )
-        # cache headroom so a chunk never writes past Smax; round to a
-        # kernel-friendly multiple of 128 when the model allows it
-        cache_len = min(-(-(self.max_len + self.decode_chunk) // 128) * 128, cfg.max_seq_len)
-        self.cache = family.make_cache(cfg, slots, cache_len)
+
+        if kv_layout not in ("slot", "paged"):
+            raise ValueError(f"kv_layout {kv_layout!r}: use 'slot' or 'paged'")
+        if kv_layout == "paged" and not hasattr(family, "make_paged_cache"):
+            raise ValueError(f"model family {family.__name__} has no paged-cache support")
+        self.kv_layout = kv_layout
+
+        if kv_layout == "paged":
+            # Paged cache (ops.paged): HBM scales with tokens in flight, not
+            # slots x max_len. Per-slot logical capacity stays max_len +
+            # decode_chunk; physical pages are pooled and allocated on demand
+            # (admission gate + preemption-by-recompute in _admit/_decode).
+            self.page_size = page_size
+            self.pages_per_slot = -(-(self.max_len + self.decode_chunk) // page_size)
+            # default pool = same HBM as the slot cache; shrink to
+            # oversubscribe, or keep and raise `slots` for more concurrency
+            self.total_pages = total_pages if total_pages else slots * self.pages_per_slot
+            if self.total_pages < self.pages_per_slot:
+                raise ValueError(
+                    f"total_pages {self.total_pages} < pages_per_slot "
+                    f"{self.pages_per_slot}: one max-length request cannot fit"
+                )
+            self.cache = family.make_paged_cache(cfg, self.total_pages, page_size)
+            self._free_pages: list[int] = list(range(self.total_pages))
+            self._slot_pages: list[list[int]] = [[] for _ in range(slots)]
+            # OOB convention: unallocated entries point one past the pool
+            self._table = np.full((slots, self.pages_per_slot), self.total_pages, np.int32)
+            self._admit_seq = 0  # preemption order: newest admitted goes first
+        else:
+            # cache headroom so a chunk never writes past Smax; round to a
+            # kernel-friendly multiple of 128 when the model allows it
+            cache_len = min(-(-(self.max_len + self.decode_chunk) // 128) * 128, cfg.max_seq_len)
+            self.cache = family.make_cache(cfg, slots, cache_len)
         self.slots: list[_Slot | None] = [None] * slots
         self._pending: list[tuple[Request, np.ndarray]] = []
         self._base_key = jax.random.key(seed)
@@ -411,25 +446,46 @@ class GenerateEngine(_EngineBase):
 
         ts = (top_k, top_p)
 
-        @partial(jax.jit, donate_argnums=(3,))
-        def _prefill_sample(params, tokens, lengths, cache, slot_ids, key, temps):
-            logits, cache = family.prefill(cfg, params, tokens, lengths, cache, slot_ids)
-            toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
-            return toks, cache
+        if kv_layout == "paged":
+            @partial(jax.jit, donate_argnums=(3,))
+            def _prefill_sample(params, tokens, lengths, cache, pages, key, temps):
+                logits, cache = family.prefill_paged(cfg, params, tokens, lengths, cache, pages)
+                toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
+                return toks, cache
 
-        @partial(jax.jit, static_argnums=(6,), donate_argnums=(3,))
-        def _decode_chunk(params, tokens, positions, cache, key, temps, steps):
-            def body(carry, _):
-                toks, pos, cache, key = carry
-                logits, cache = family.decode_step(cfg, params, toks, pos, cache)
-                key, sub = jax.random.split(key)
-                nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
-                return (nxt, pos + 1, cache, key), nxt
+            @partial(jax.jit, static_argnums=(6,), donate_argnums=(3,))
+            def _decode_chunk(params, tokens, positions, cache, key, temps, steps, table):
+                def body(carry, _):
+                    toks, pos, cache, key = carry
+                    logits, cache = family.decode_step_paged(cfg, params, toks, pos, cache, table)
+                    key, sub = jax.random.split(key)
+                    nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
+                    return (nxt, pos + 1, cache, key), nxt
 
-            (toks, pos, cache, key), out = jax.lax.scan(
-                body, (tokens, positions, cache, key), None, length=steps
-            )
-            return out.T, cache  # [slots, K]
+                (toks, pos, cache, key), out = jax.lax.scan(
+                    body, (tokens, positions, cache, key), None, length=steps
+                )
+                return out.T, cache  # [slots, K]
+        else:
+            @partial(jax.jit, donate_argnums=(3,))
+            def _prefill_sample(params, tokens, lengths, cache, slot_ids, key, temps):
+                logits, cache = family.prefill(cfg, params, tokens, lengths, cache, slot_ids)
+                toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
+                return toks, cache
+
+            @partial(jax.jit, static_argnums=(6,), donate_argnums=(3,))
+            def _decode_chunk(params, tokens, positions, cache, key, temps, steps):
+                def body(carry, _):
+                    toks, pos, cache, key = carry
+                    logits, cache = family.decode_step(cfg, params, toks, pos, cache)
+                    key, sub = jax.random.split(key)
+                    nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
+                    return (nxt, pos + 1, cache, key), nxt
+
+                (toks, pos, cache, key), out = jax.lax.scan(
+                    body, (tokens, positions, cache, key), None, length=steps
+                )
+                return out.T, cache  # [slots, K]
 
         self._prefill_sample = _prefill_sample
         self._decode_chunk = _decode_chunk
@@ -495,8 +551,61 @@ class GenerateEngine(_EngineBase):
         super()._fail_all(error)
         for i, s in enumerate(self.slots):
             if s is not None:
-                self.slots[i] = None
+                self._free_slot(i)
                 s.request.complete(error=error)
+
+    # -- slot/page bookkeeping -------------------------------------------------
+
+    def _free_slot(self, idx: int) -> None:
+        """Vacate a slot; in the paged layout its pages return to the pool."""
+        self.slots[idx] = None
+        if self.kv_layout == "paged":
+            pages = self._slot_pages[idx]
+            if pages:
+                self._free_pages.extend(pages)
+                self._slot_pages[idx] = []
+                self._table[idx, :] = self.total_pages
+            self.metrics.set_gauge("app_tpu_kv_pages_free", len(self._free_pages))
+
+    def _ensure_pages(self, slot_idx: int, upto_pos: int) -> bool:
+        """Grow slot_idx's block table until it covers logical position
+        ``upto_pos``; False when the pool is exhausted."""
+        need = upto_pos // self.page_size + 1
+        cur = self._slot_pages[slot_idx]
+        while len(cur) < need:
+            if not self._free_pages:
+                return False
+            p = self._free_pages.pop()
+            self._table[slot_idx, len(cur)] = p
+            cur.append(p)
+        return True
+
+    def _preempt_newest(self, except_slot: int | None = None) -> bool:
+        """Pool pressure valve: evict the MOST RECENTLY admitted active slot
+        (LIFO keeps almost-done requests running), fold its generated tokens
+        into its prompt, and requeue it for re-prefill — preemption by
+        recompute. Greedy decode continues bit-identically; sampled decode
+        resumes from a fresh RNG fold (documented engine semantics)."""
+        candidates = [
+            (s.admit_seq, i) for i, s in enumerate(self.slots)
+            if s is not None and i != except_slot
+        ]
+        if not candidates:
+            return False
+        _, idx = max(candidates)
+        s = self.slots[idx]
+        self._free_slot(idx)
+        req = s.request
+        req.kw["_prior_tokens"] = list(req.kw.get("_prior_tokens", [])) + list(s.generated)
+        req.kw["max_new_tokens"] = max(
+            1, int(req.kw.get("max_new_tokens", 64)) - len(s.generated)
+        )
+        new_prompt = np.concatenate(
+            [np.asarray(s.prompt_tokens, np.int32), np.asarray(s.generated, np.int32)]
+        )
+        self._pending.append((req, new_prompt))
+        self.metrics.increment_counter("app_tpu_preemptions", 1)
+        return True
 
     def _free_slots(self) -> list[int]:
         return [i for i, s in enumerate(self.slots) if s is None]
@@ -570,13 +679,28 @@ class GenerateEngine(_EngineBase):
         ready = [self._pending[i] for i in plan.chosen]
         taken = set(plan.chosen) | set(plan.expired)
         self._pending = [p for i, p in enumerate(self._pending) if i not in taken]
+
+        if self.kv_layout == "paged":
+            # admission gate: each admitted prompt needs pages covering its
+            # prefill writes NOW. On pool exhaustion the leader (most urgent)
+            # stops admission entirely — later arrivals must not starve it.
+            admitted: list[tuple[Request, np.ndarray]] = []
+            exhausted = False
+            for req, toks in ready:
+                if not exhausted and self._ensure_pages(free[len(admitted)], int(toks.shape[0]) - 1):
+                    admitted.append((req, toks))
+                else:
+                    exhausted = True
+                    self._pending.append((req, toks))
+            ready = admitted
         if not ready:
             return False
 
         # one prefill call, padded to (len_bucket, batch_bucket). Padding
         # rows point at slot index == num_slots, which is out of bounds for
         # the cache's slot dimension — XLA scatter DROPS out-of-bounds
-        # updates, so they write nowhere (verified in tests).
+        # updates, so they write nowhere (verified in tests). Paged rows use
+        # the same trick through all-OOB block-table rows (ops.paged).
         n = len(ready)
         nb = plan.batch_bucket
         lb = plan.len_bucket
@@ -589,6 +713,13 @@ class GenerateEngine(_EngineBase):
             lengths[i] = toks.shape[0]
             slot_ids[i] = free[i]
             temps[i] = float(req.kw.get("temperature", 0.0))
+        if self.kv_layout == "paged":
+            pages_rows = np.full((nb, self.pages_per_slot), self.total_pages, np.int32)
+            for i in range(n):
+                pages_rows[i] = self._table[free[i]]
+            device_rows = jnp.asarray(pages_rows)
+        else:
+            device_rows = jnp.asarray(slot_ids)
 
         t0 = time.monotonic()
         self._step_count += 1
@@ -596,7 +727,7 @@ class GenerateEngine(_EngineBase):
         self._inflight = [req for req, _ in ready]
         first_dev, self.cache = self._prefill_sample(
             self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-            self.cache, jnp.asarray(slot_ids), key, jnp.asarray(temps),
+            self.cache, device_rows, key, jnp.asarray(temps),
         )
         self._inflight = []
         first = np.asarray(first_dev)  # [nb] int32 — tokens, never logits
@@ -611,13 +742,18 @@ class GenerateEngine(_EngineBase):
 
         for i, (req, toks) in enumerate(ready):
             tok = int(first[i])
+            req.kw.setdefault("_first_token_at", time.monotonic())
             slot = _Slot(
                 req,
                 prompt_len=int(lengths[i]),
                 max_total=min(int(lengths[i]) + int(req.kw.get("max_new_tokens", 64)), self.max_len),
                 eos=req.kw.get("eos_token_id", self.eos_token_id),
                 first_token=tok,
+                admit_seq=getattr(self, "_admit_seq", 0),
+                prompt_tokens=toks,
             )
+            if self.kv_layout == "paged":
+                self._admit_seq += 1
             self.slots[free[i]] = slot
             self._emit(slot, tok)
             self._maybe_finish(free[i])
@@ -631,13 +767,35 @@ class GenerateEngine(_EngineBase):
             return False
         n = self.num_slots
         k = self.decode_chunk
+
+        if self.kv_layout == "paged":
+            # every active slot must own pages covering this chunk's writes
+            # (pos .. pos+k-1) BEFORE the table snapshot; pool exhaustion
+            # preempts the newest-admitted slot (LIFO, recompute on return)
+            for i in list(active):
+                s = self.slots[i]
+                if s is None:
+                    continue  # preempted by an earlier iteration's pressure
+                while not self._ensure_pages(i, s.pos + k - 1):
+                    if not self._preempt_newest(except_slot=i):
+                        # alone and still short — can't happen when
+                        # total_pages >= pages_per_slot (ctor guard)
+                        self._free_slot(i)
+                        s.request.complete(error=RuntimeError(
+                            "KV page pool exhausted for a single request"))
+                        break
+            active = self._active()
+            if not active:
+                return False
+
         tokens = np.zeros((n,), np.int32)
         positions = np.zeros((n,), np.int32)
         temps = np.zeros((n,), np.float32)
         # always the FULL chunk — one compiled decode program for the whole
         # serving lifetime. A slot that hits its budget/EOS mid-chunk simply
         # has its surplus tokens discarded (the cache carries decode_chunk
-        # slack past max_len, so overshoot writes stay in bounds).
+        # slack past max_len, so overshoot writes stay in bounds; paged
+        # slots' tables carry the same slack via pages_per_slot).
         for i in active:
             s = self.slots[i]
             tokens[i] = s.last_token
@@ -647,10 +805,16 @@ class GenerateEngine(_EngineBase):
         t0 = time.monotonic()
         self._step_count += 1
         key = jax.random.fold_in(self._base_key, self._step_count)
-        chunk_dev, self.cache = self._decode_chunk(
-            self.params, jnp.asarray(tokens), jnp.asarray(positions),
-            self.cache, key, jnp.asarray(temps), k,
-        )
+        if self.kv_layout == "paged":
+            chunk_dev, self.cache = self._decode_chunk(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                self.cache, key, jnp.asarray(temps), k, jnp.asarray(self._table),
+            )
+        else:
+            chunk_dev, self.cache = self._decode_chunk(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                self.cache, key, jnp.asarray(temps), k,
+            )
         chunk = np.asarray(chunk_dev)  # [slots, k] int32 — tokens, never logits
         self._record_step("decode", time.monotonic() - t0, len(active) / n, ("decode", n, k))
 
@@ -662,7 +826,7 @@ class GenerateEngine(_EngineBase):
                 continue  # cleared by _fail_all while the step was in flight
             if s.request.cancelled or s.request.expired(now):
                 # slot invalidation: free the lane; in-flight work is discarded
-                self.slots[i] = None
+                self._free_slot(i)
                 s.request.complete(error=RequestTimeout())
                 continue
             for j in range(k):
@@ -693,14 +857,17 @@ class GenerateEngine(_EngineBase):
             finish = "length"
         else:
             return
-        tokens = s.generated[:-1] if finish == "stop" else list(s.generated)
+        # tokens generated before any preemption round-trips lead the result
+        prior = list(s.request.kw.get("_prior_tokens", []))
+        tokens = prior + (s.generated[:-1] if finish == "stop" else list(s.generated))
         result = {
             "tokens": tokens,
             "text": self.tokenizer.decode(tokens) if self.tokenizer is not None else None,
             "finish_reason": finish,
-            "ttft_s": s.first_token_at - s.request.enqueued_at,
+            "ttft_s": s.request.kw.get("_first_token_at", s.first_token_at)
+            - s.request.enqueued_at,
         }
-        self.slots[slot_idx] = None
+        self._free_slot(slot_idx)
         s.request.complete(result=result)
 
 
@@ -767,12 +934,16 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
         eos = kw.pop("eos_token_id", None)
         if eos is None and tokenizer is not None:
             eos = tokenizer.eos_token_id
+        default_layout = "paged" if hasattr(family, "make_paged_cache") else "slot"
         return GenerateEngine(
             family, cfg, params, container,
             slots=int(kw.pop("slots", conf.get_int("ENGINE_SLOTS", 8))),
             max_len=int(kw.pop("max_len", conf.get_int("ENGINE_MAX_LEN", 2048))),
             decode_chunk=int(kw.pop("decode_chunk", conf.get_int("ENGINE_DECODE_CHUNK", 8))),
             max_prefill_batch=int(kw.pop("max_prefill_batch", conf.get_int("ENGINE_PREFILL_BATCH", 4))),
+            kv_layout=str(kw.pop("kv_layout", conf.get_or_default("ENGINE_KV_LAYOUT", default_layout))),
+            page_size=int(kw.pop("page_size", conf.get_int("ENGINE_PAGE_SIZE", 128))),
+            total_pages=int(kw.pop("total_pages", conf.get_int("ENGINE_TOTAL_PAGES", 0))) or None,
             eos_token_id=eos,
             tokenizer=tokenizer,
             default_timeout=default_timeout,

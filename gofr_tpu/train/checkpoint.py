@@ -75,7 +75,13 @@ def load_params(directory: str, like: Any) -> Any:
 
 def is_checkpoint_dir(path: str) -> bool:
     """Heuristic used by build_engine to tell a checkpoint directory from an
-    HF model id: a local dir containing at least one numeric step dir."""
+    HF model id: a local dir containing at least one numeric step DIRECTORY
+    that itself holds orbax items. A bare numeric file (e.g. in a local HF
+    snapshot) must not divert weights away from the HF converter (ADVICE.md)."""
     if not os.path.isdir(path):
         return False
-    return any(name.isdigit() for name in os.listdir(path))
+    for name in os.listdir(path):
+        step_dir = os.path.join(path, name)
+        if name.isdigit() and os.path.isdir(step_dir) and os.listdir(step_dir):
+            return True
+    return False

@@ -70,6 +70,55 @@ class TestLlama:
                 np.asarray(step_logits[0]), np.asarray(full[0, i]), rtol=2e-4, atol=2e-4
             )
 
+    def test_paged_cache_matches_forward(self):
+        """Paged prefill + decode through a shuffled block table must match
+        the full forward pass (and therefore the dense slot cache)."""
+        params = llama.init(self.cfg, jax.random.key(0))
+        seq = jax.random.randint(jax.random.key(1), (1, 8), 0, 256)
+        prompt_len = 5
+        page_size, maxp, pool = 8, 4, 12
+        cache = llama.make_paged_cache(self.cfg, pages=pool, page_size=page_size)
+        # slot 0 owns shuffled, non-contiguous pages; slot 1 unallocated
+        table = jnp.array([[3, 7, 1, 5], [pool, pool, pool, pool]], jnp.int32)
+        logits, cache = llama.prefill_paged(
+            self.cfg, params, seq[:, :prompt_len], jnp.array([prompt_len]),
+            cache, table[:1],
+        )
+        full = llama.forward(self.cfg, params, seq)
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), np.asarray(full[0, prompt_len - 1]), rtol=2e-4, atol=2e-4
+        )
+        for i in range(prompt_len, 8):
+            tok = jnp.array([seq[0, i], 0], jnp.int32)
+            pos = jnp.array([i, 0], jnp.int32)
+            step_logits, cache = llama.decode_step_paged(
+                self.cfg, params, tok, pos, cache, table
+            )
+            np.testing.assert_allclose(
+                np.asarray(step_logits[0]), np.asarray(full[0, i]), rtol=2e-4, atol=2e-4
+            )
+
+    def test_paged_chunked_prefill_matches_forward(self):
+        """Two prefill chunks (the second at a nonzero offset attending to
+        the first through the block table) == one whole-prompt prefill."""
+        params = llama.init(self.cfg, jax.random.key(0))
+        seq = jax.random.randint(jax.random.key(2), (1, 16), 0, 256)
+        page_size, pool = 8, 6
+        cache = llama.make_paged_cache(self.cfg, pages=pool, page_size=page_size)
+        table = jnp.array([[4, 1, 2]], jnp.int32)
+        # chunk 1: positions 0..8 (whole-page), chunk 2: positions 8..16
+        _, cache = llama.prefill_paged(
+            self.cfg, params, seq[:, :8], jnp.array([8]), cache, table,
+        )
+        logits, cache = llama.prefill_paged(
+            self.cfg, params, seq[:, 8:], jnp.array([8]), cache, table,
+            offsets=jnp.array([8], jnp.int32),
+        )
+        full = llama.forward(self.cfg, params, seq)
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), np.asarray(full[0, 15]), rtol=2e-4, atol=2e-4
+        )
+
     def test_tied_embeddings(self):
         cfg = LlamaConfig.tiny(tie_embeddings=True)
         params = llama.init(cfg, jax.random.key(0))

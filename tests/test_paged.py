@@ -1,0 +1,125 @@
+"""Paged KV cache: pool write/read semantics and the Pallas paged-decode
+kernel vs the XLA gather path (hermetic CPU tests, SURVEY.md §4 analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops.attention import decode_attention, paged_decode_attention
+from gofr_tpu.ops.kvcache import SlotKVCache, append_tokens, write_prompts
+from gofr_tpu.ops.paged import (
+    PagedKVCache,
+    append_tokens_paged,
+    gather_kv,
+    write_prompts_paged,
+)
+
+
+PAGE = 8  # small page for tests; engine default is 128
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype)
+
+
+def test_write_prompts_paged_round_trip():
+    """A prompt scattered through an arbitrary (non-contiguous) block table
+    reads back identical to the slot-cache layout."""
+    b, s, hkv, d = 2, 20, 2, 16
+    pool_pages, maxp = 12, 4
+    k_new = _rand(jax.random.key(0), (b, s, hkv, d))
+    v_new = _rand(jax.random.key(1), (b, s, hkv, d))
+
+    # deliberately shuffled, interleaved page assignment
+    pages = jnp.array([[7, 2, 9, 11], [0, 5, 3, 1]], jnp.int32)
+    k_layer = jnp.zeros((pool_pages, hkv, PAGE, d))
+    v_layer = jnp.zeros((pool_pages, hkv, PAGE, d))
+    k_layer, v_layer = write_prompts_paged(k_layer, v_layer, pages, k_new, v_new)
+
+    k_view, v_view = gather_kv(k_layer, v_layer, pages)
+    # logical view is [B, Hkv, maxp*PAGE, D]; positions 0..s hold the prompt
+    np.testing.assert_allclose(k_view[:, :, :s], k_new.swapaxes(1, 2), rtol=1e-6)
+    np.testing.assert_allclose(v_view[:, :, :s], v_new.swapaxes(1, 2), rtol=1e-6)
+
+
+def test_oob_page_writes_dropped():
+    """Padding rows point every logical page at P (out of bounds): their
+    writes must vanish, leaving the pool untouched."""
+    b, s, hkv, d = 2, PAGE, 2, 8
+    pool_pages = 4
+    k_new = _rand(jax.random.key(2), (b, s, hkv, d))
+    pages = jnp.array([[1], [pool_pages]], jnp.int32)  # row 1 is padding
+    k_layer = jnp.zeros((pool_pages, hkv, PAGE, d))
+    v_layer = jnp.zeros((pool_pages, hkv, PAGE, d))
+    k_layer, v_layer = write_prompts_paged(k_layer, v_layer, pages, k_new, k_new)
+    # page 1 holds row 0's prompt; every other page still zero
+    np.testing.assert_allclose(k_layer[1], k_new[0].swapaxes(0, 1), rtol=1e-6)
+    assert float(jnp.abs(k_layer[jnp.array([0, 2, 3])]).sum()) == 0.0
+
+
+def test_append_tokens_paged_matches_slot_semantics():
+    """Appending tokens one at a time through block tables must equal the
+    slot cache's contiguous append."""
+    n, hkv, d = 3, 2, 8
+    maxp = 3
+    pool_pages = n * maxp
+    # identity-ish table: slot i owns pages [3i, 3i+1, 3i+2]
+    table = jnp.arange(pool_pages, dtype=jnp.int32).reshape(n, maxp)
+
+    k_pool = jnp.zeros((pool_pages, hkv, PAGE, d))
+    v_pool = jnp.zeros((pool_pages, hkv, PAGE, d))
+    k_slot = jnp.zeros((n, hkv, maxp * PAGE, d))
+    v_slot = jnp.zeros((n, hkv, maxp * PAGE, d))
+
+    positions = jnp.array([0, PAGE - 1, PAGE], jnp.int32)  # page-boundary cases
+    for step in range(4):
+        kn = _rand(jax.random.key(10 + step), (n, hkv, d))
+        vn = _rand(jax.random.key(20 + step), (n, hkv, d))
+        pos = positions + step
+        k_pool, v_pool = append_tokens_paged(k_pool, v_pool, table, pos, kn, vn)
+        k_slot, v_slot = append_tokens(k_slot, v_slot, pos, kn, vn)
+
+    k_view, v_view = gather_kv(k_pool, v_pool, table)
+    np.testing.assert_allclose(k_view, k_slot, rtol=1e-6)
+    np.testing.assert_allclose(v_view, v_slot, rtol=1e-6)
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2)])
+def test_paged_decode_kernel_matches_gather_path(monkeypatch, hq, hkv):
+    """Pallas paged-decode (scalar-prefetched block tables) vs the XLA
+    gather fallback, with ragged lengths and shuffled tables."""
+    n, d, maxp, pool_pages = 3, 32, 4, 16
+    page = 16
+    q = _rand(jax.random.key(0), (n, hq, d))
+    k_pool = _rand(jax.random.key(1), (pool_pages, hkv, page, d))
+    v_pool = _rand(jax.random.key(2), (pool_pages, hkv, page, d))
+    rng = np.random.RandomState(0)
+    perm = rng.permutation(pool_pages)[: n * maxp].reshape(n, maxp)
+    table = jnp.asarray(perm, jnp.int32)
+    # OOB-mark the unallocated tail of slot 2's table
+    table = table.at[2, 2:].set(pool_pages)
+    lengths = jnp.array([page * maxp, 19, page + 3], jnp.int32)
+
+    want = paged_decode_attention(q, k_pool, v_pool, table, lengths, backend="xla")
+    monkeypatch.setenv("GOFR_PALLAS_INTERPRET", "1")
+    got = paged_decode_attention(q, k_pool, v_pool, table, lengths, backend="pallas")
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_matches_dense_decode():
+    """Paged attention over a contiguous table == dense decode over the
+    equivalent [N, Hkv, Smax, D] cache."""
+    n, hq, hkv, d, maxp = 2, 4, 2, 16, 3
+    page = 8
+    pool_pages = n * maxp
+    table = jnp.arange(pool_pages, dtype=jnp.int32).reshape(n, maxp)
+    q = _rand(jax.random.key(5), (n, hq, d))
+    k_pool = _rand(jax.random.key(6), (pool_pages, hkv, page, d))
+    v_pool = _rand(jax.random.key(7), (pool_pages, hkv, page, d))
+    lengths = jnp.array([maxp * page, 11], jnp.int32)
+
+    k_view, v_view = gather_kv(k_pool, v_pool, table)
+    want = decode_attention(q, k_view, v_view, lengths, backend="xla")
+    got = paged_decode_attention(q, k_pool, v_pool, table, lengths, backend="xla")
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)

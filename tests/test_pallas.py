@@ -2,6 +2,8 @@
 interpreter on the CPU test mesh (SURVEY.md §4 analog: hermetic device
 tests without TPU hardware)."""
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -98,3 +100,40 @@ def test_llama_forward_with_pallas_backend(monkeypatch):
     got = llama.forward(cfg, params, tokens, lengths)
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
     jax.clear_caches()
+
+
+def test_flash_grad_matches_xla(monkeypatch):
+    """Training routes gradients through the _flash_mha custom_vjp when
+    backend='auto' resolves to pallas — the backward pass must match XLA,
+    including the kv_lengths/q_offset chunked-prefill arguments (ADVICE.md)."""
+    b, sq, skv = 2, 16, 32
+    q, k, v = _qkv(jax.random.key(7), b, sq, skv, 4, 2, 16)
+    lengths = jnp.array([32, 11], jnp.int32)
+    offset = jnp.array([16, 3], jnp.int32)
+
+    def loss(q, k, v, backend):
+        out = mha_attention(
+            q, k, v, causal=True, q_offset=offset, kv_lengths=lengths, backend=backend
+        )
+        # non-uniform weighting so every output element contributes distinctly
+        w = jnp.arange(out.size, dtype=out.dtype).reshape(out.shape)
+        return jnp.sum(out * w)
+
+    want = jax.grad(partial(loss, backend="xla"), argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("GOFR_PALLAS_INTERPRET", "1")
+    got = jax.grad(partial(loss, backend="auto"), argnums=(0, 1, 2))(q, k, v)
+    for g, w_ in zip(got, want):
+        np.testing.assert_allclose(g, w_, atol=2e-3, rtol=2e-3)
+
+
+def test_flash_grad_matches_xla_plain_causal(monkeypatch):
+    q, k, v = _qkv(jax.random.key(8), 2, 32, 32, 8, 2, 32)
+
+    def loss(q, k, v, backend):
+        return jnp.sum(mha_attention(q, k, v, causal=True, backend=backend) ** 2)
+
+    want = jax.grad(partial(loss, backend="xla"), argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("GOFR_PALLAS_INTERPRET", "1")
+    got = jax.grad(partial(loss, backend="auto"), argnums=(0, 1, 2))(q, k, v)
+    for g, w_ in zip(got, want):
+        np.testing.assert_allclose(g, w_, atol=2e-3, rtol=2e-3)
