@@ -21,14 +21,21 @@ NEG_INF = -1e30
 
 
 def resolve_backend(backend: str) -> str:
-    """'auto' picks the Pallas kernels when the platform supports them
-    (TPU, or CPU under the interpreter for tests) and XLA otherwise; an
-    explicit 'pallas' likewise degrades to 'xla' off-TPU so one model code
-    path serves the test mesh and real chips."""
-    if backend in ("auto", "pallas"):
+    """'auto' picks XLA unless the Pallas kernels are opted in
+    (GOFR_PALLAS=1 on TPU, or the interpreter for tests) — on v5e the XLA
+    paths measured faster than the current kernels (see
+    ops/pallas/__init__.flash_attention_available). An explicit 'pallas'
+    is honored whenever the platform can lower kernels at all, degrading
+    to 'xla' only off-TPU so one model code path serves the CPU test mesh
+    and real chips."""
+    if backend == "auto":
         from gofr_tpu.ops.pallas import flash_attention_available
 
         return "pallas" if flash_attention_available() else "xla"
+    if backend == "pallas":
+        from gofr_tpu.ops.pallas import kernel_platform
+
+        return "pallas" if kernel_platform() else "xla"
     if backend != "xla":
         raise ValueError(f"unknown attention backend {backend!r}; use 'auto', 'xla' or 'pallas'")
     return backend
@@ -210,13 +217,22 @@ def paged_decode_attention(
     decode path — correct everywhere, but pays an extra HBM round trip.
     """
     page = k_pool.shape[2]
-    if resolve_backend(backend) == "pallas" and page % 8 == 0:
-        from gofr_tpu.ops.pallas import interpret_mode
-        from gofr_tpu.ops.pallas.paged_decode import paged_decode_attention as pallas_paged
+    if resolve_backend(backend) == "pallas":
+        if page % 8 == 0:
+            from gofr_tpu.ops.pallas import interpret_mode
+            from gofr_tpu.ops.pallas.paged_decode import paged_decode_attention as pallas_paged
 
-        return pallas_paged(
-            q, k_pool, v_pool, table, lengths, scale=scale, interpret=interpret_mode()
-        )
+            return pallas_paged(
+                q, k_pool, v_pool, table, lengths, scale=scale, interpret=interpret_mode()
+            )
+        if backend == "pallas":
+            # Only 'auto' may degrade silently — an explicit request the
+            # kernel cannot satisfy must not be ignored (ADVICE.md round 2).
+            raise ValueError(
+                f"backend='pallas' requested but page size {page} is not a "
+                f"multiple of 8 (f32 sublane tile); use a page_size % 8 == 0 "
+                f"or backend='auto'"
+            )
     from gofr_tpu.ops.paged import gather_kv
 
     k_view, v_view = gather_kv(k_pool, v_pool, table)

@@ -112,7 +112,8 @@ class Request:
 class _EngineBase:
     """Queue + device thread + metrics plumbing shared by both engines."""
 
-    def __init__(self, container, *, default_timeout: float | None = None):
+    def __init__(self, container, *, default_timeout: float | None = None,
+                 max_restarts: int = 3):
         self.container = container
         self.logger = container.logger
         self.metrics = container.metrics
@@ -124,12 +125,34 @@ class _EngineBase:
         # wedged step can't strand its batch (their complete is idempotent)
         self._inflight: list[Request] = []
         self._stop = threading.Event()
+        self._poisoned = False  # set when a wedged thread failed to join
+        # Serializes _pending/_inflight/slot bookkeeping between the device
+        # thread and stop()/_fail_all on the caller thread (VERDICT r2 weak
+        # #3: unsynchronized list mutation could corrupt state mid-_admit).
+        self._state_lock = threading.RLock()
         self._compiled: set[tuple] = set()
         self._startup_error: Exception | None = None
+        # Supervision (SURVEY §5.3; reference reconnects SQL in a loop,
+        # sql.go:108-133): a crashed device loop restarts with backoff
+        # instead of dying permanently. In-flight/slot-resident work fails
+        # (its device state is suspect); queued work survives the restart.
+        self.max_restarts = max_restarts
+        self._restarts = 0
+        self._restarting = False
+        # crashes further apart than this don't count against the restart
+        # budget — the give-up is for crash LOOPS, not lifetime fault totals
+        self.restart_window_s = 60.0
+        self._last_crash_at = 0.0
 
     # -- lifecycle -------------------------------------------------------------
 
     def start(self) -> None:
+        if self._poisoned:
+            # the wedged device thread from the previous life may still wake;
+            # a fresh thread would share (and race) its state
+            raise EngineClosed(
+                "engine was stopped with a wedged device thread; build a new engine"
+            )
         if self._thread is not None:
             return
         self._stop.clear()
@@ -143,7 +166,10 @@ class _EngineBase:
             if self._thread.is_alive():
                 # Stuck device step: Request.complete is first-writer-wins,
                 # so failing everything now cannot be overwritten by a late
-                # result from the wedged thread.
+                # result from the wedged thread. Poison the engine so that a
+                # LATE-waking loop iteration exits before touching slot/page
+                # bookkeeping we are about to mutate here (ADVICE.md round 2).
+                self._poisoned = True
                 self.logger.warn("engine thread did not stop within 10s; failing in-flight requests")
             self._thread = None
         self._fail_all(EngineClosed("engine stopped"))
@@ -151,34 +177,66 @@ class _EngineBase:
     def _fail_all(self, error: Exception) -> None:
         """Fail everything waiting — the queue AND the drained-but-unadmitted
         pending list (GenerateEngine extends this with slot-resident requests)."""
-        while True:
-            try:
-                self._queue.get_nowait().complete(error=error)
-            except queue.Empty:
-                break
-        for req, _ in getattr(self, "_pending", []):
-            req.complete(error=error)
-        if hasattr(self, "_pending"):
-            self._pending = []
-        for req in self._inflight:
-            req.complete(error=error)
+        with self._state_lock:
+            while True:
+                try:
+                    self._queue.get_nowait().complete(error=error)
+                except queue.Empty:
+                    break
+            for req, _ in getattr(self, "_pending", []):
+                req.complete(error=error)
+            if hasattr(self, "_pending"):
+                self._pending = []
+            for req, _ in getattr(self, "_pending_long", []):
+                req.complete(error=error)
+            if hasattr(self, "_pending_long"):
+                self._pending_long = []
+            for req in self._inflight:
+                req.complete(error=error)
+
+    def _crash_recover(self, error: Exception) -> None:
+        """Fail work whose device state the crash made suspect (in-flight
+        batches; GenerateEngine adds slot-resident requests + page pool
+        reset). Queued/pending work survives — it re-plans after restart."""
+        with self._state_lock:
+            for req in self._inflight:
+                req.complete(error=error)
+            self._inflight = []
 
     def _backlog(self) -> int:
-        return self._queue.qsize() + len(getattr(self, "_pending", []))
+        return (self._queue.qsize() + len(getattr(self, "_pending", []))
+                + len(getattr(self, "_pending_long", [])))
 
     def _run(self) -> None:
-        try:
-            from gofr_tpu.ops.pallas import platform_hint
+        from gofr_tpu.ops.pallas import platform_hint
 
-            # Pin kernel-backend resolution to where this engine's device
-            # actually is (a CPU test mesh under an attached TPU would
-            # otherwise trace Pallas kernels it can't lower).
-            with platform_hint(getattr(self.tpu, "platform", None)):
-                self._loop()
-        except Exception as e:  # noqa: BLE001
-            self._startup_error = e
-            self.logger.log_exception(e, "model engine thread died")
-            self._fail_all(e)
+        while True:
+            try:
+                # Pin kernel-backend resolution to where this engine's device
+                # actually is (a CPU test mesh under an attached TPU would
+                # otherwise trace Pallas kernels it can't lower).
+                with platform_hint(getattr(self.tpu, "platform", None)):
+                    self._loop()
+                return  # clean stop
+            except Exception as e:  # noqa: BLE001
+                self.logger.log_exception(e, "model engine step crashed")
+                self._crash_recover(e)
+                now = time.monotonic()
+                if now - self._last_crash_at > self.restart_window_s:
+                    self._restarts = 0  # isolated fault, not a crash loop
+                self._last_crash_at = now
+                if self._stop.is_set() or self._restarts >= self.max_restarts:
+                    self._startup_error = e
+                    self._fail_all(e)
+                    return
+                self._restarts += 1
+                self.metrics.increment_counter("app_tpu_engine_restarts", 1)
+                self._restarting = True
+                time.sleep(min(0.1 * (2 ** self._restarts), 5.0))
+                self._restarting = False
+                self.logger.warn(
+                    f"engine device loop restarting (attempt {self._restarts}/{self.max_restarts})"
+                )
 
     def _loop(self) -> None:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -207,9 +265,12 @@ class _EngineBase:
     def health_check(self) -> dict[str, Any]:
         if self._startup_error is not None:
             return {"status": "DOWN", "details": {"error": str(self._startup_error)}}
+        if self._restarting:
+            return {"status": "DEGRADED",
+                    "details": {"restarting": True, "restarts": self._restarts}}
         return {
             "status": "UP" if self._thread is not None and self._thread.is_alive() else "DEGRADED",
-            "details": {"queue_depth": self._backlog()},
+            "details": {"queue_depth": self._backlog(), "restarts": self._restarts},
         }
 
 
@@ -237,8 +298,9 @@ class BatchEngine(_EngineBase):
         len_buckets: list[int] | None = None,
         max_wait_ms: float = 2.0,
         default_timeout: float | None = None,
+        max_restarts: int = 3,
     ):
-        super().__init__(container, default_timeout=default_timeout)
+        super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.apply_fn = apply_fn
         self.encode_fn = encode_fn or (lambda x: np.asarray(x))
         self.decode_fn = decode_fn or (lambda row: row)
@@ -279,7 +341,7 @@ class BatchEngine(_EngineBase):
         return live
 
     def _loop(self) -> None:
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not self._poisoned:
             batch = self._drain()
             if not batch:
                 continue
@@ -330,23 +392,36 @@ class _Slot:
     """One active generation. Invariants: ``generated`` holds every output
     token so far (last one's K/V not yet in cache); ``pos`` is the cache
     position the last token will be written to on the next decode step,
-    i.e. ``prompt_len + len(generated) - 1``."""
+    i.e. ``prompt_len + len(generated) - 1``.
+
+    A slot admitted with ``first_token=None`` is in the *chunked-prefill*
+    stage: ``written`` counts prompt tokens already in the cache; the slot
+    joins decode only once the final chunk samples its first token
+    (SURVEY §7 hard parts (a)/(b): long prompts stream into the cache in
+    bucket-sized chunks between decode steps instead of inflating one
+    batch's padding or being rejected)."""
 
     __slots__ = ("request", "prompt_len", "pos", "generated", "max_total", "eos",
-                 "last_token", "first_token_at", "admit_seq", "prompt_tokens")
+                 "last_token", "first_token_at", "admit_seq", "prompt_tokens",
+                 "written")
 
     def __init__(self, request: Request, prompt_len: int, max_total: int, eos: int | None,
-                 first_token: int, admit_seq: int = 0, prompt_tokens: Any = None):
+                 first_token: int | None, admit_seq: int = 0, prompt_tokens: Any = None):
         self.request = request
         self.prompt_len = prompt_len
         self.pos = prompt_len
-        self.generated = [first_token]
+        self.generated = [first_token] if first_token is not None else []
         self.max_total = max_total
         self.eos = eos
         self.last_token = first_token
         self.first_token_at = time.monotonic()
         self.admit_seq = admit_seq       # preemption order (paged layout)
         self.prompt_tokens = prompt_tokens  # kept for preemption re-prefill
+        self.written = prompt_len if first_token is not None else 0
+
+    @property
+    def prefilling(self) -> bool:
+        return self.written < self.prompt_len
 
 
 class GenerateEngine(_EngineBase):
@@ -374,8 +449,9 @@ class GenerateEngine(_EngineBase):
         kv_layout: str = "slot",
         page_size: int = 128,
         total_pages: int | None = None,
+        max_restarts: int = 3,
     ):
-        super().__init__(container, default_timeout=default_timeout)
+        super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
         self.cfg = cfg
         self.params = params
@@ -438,9 +514,13 @@ class GenerateEngine(_EngineBase):
             # cache headroom so a chunk never writes past Smax; round to a
             # kernel-friendly multiple of 128 when the model allows it
             cache_len = min(-(-(self.max_len + self.decode_chunk) // 128) * 128, cfg.max_seq_len)
+            self._cache_len = cache_len
             self.cache = family.make_cache(cfg, slots, cache_len)
         self.slots: list[_Slot | None] = [None] * slots
         self._pending: list[tuple[Request, np.ndarray]] = []
+        # prompts longer than the largest prefill bucket: admitted one at a
+        # time and streamed into the cache chunk-by-chunk (paged layout only)
+        self._pending_long: list[tuple[Request, np.ndarray]] = []
         self._base_key = jax.random.key(seed)
         self._step_count = 0
 
@@ -452,6 +532,16 @@ class GenerateEngine(_EngineBase):
                 logits, cache = family.prefill_paged(cfg, params, tokens, lengths, cache, pages)
                 toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
                 return toks, cache
+
+            @partial(jax.jit, donate_argnums=(3,))
+            def _chunk_prefill(params, tokens, lengths, cache, pages, offsets, key, temps):
+                logits, cache = family.prefill_paged(
+                    cfg, params, tokens, lengths, cache, pages, offsets
+                )
+                toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
+                return toks, cache
+
+            self._chunk_prefill = _chunk_prefill
 
             @partial(jax.jit, static_argnums=(6,), donate_argnums=(3,))
             def _decode_chunk(params, tokens, positions, cache, key, temps, steps, table):
@@ -491,6 +581,64 @@ class GenerateEngine(_EngineBase):
         self._decode_chunk = _decode_chunk
 
     # -- public API ------------------------------------------------------------
+
+    def warmup(self, len_buckets: list[int] | None = None,
+               batch_buckets: list[int] | None = None) -> int:
+        """Pre-compile every (prefill len-bucket × batch-bucket) signature
+        plus the decode program, so no XLA compile lands inside the serving
+        window (compiles cost seconds; over a tunneled device they dominate
+        early-traffic latency). Safe for cache contents: prefill warmup rows
+        use out-of-bounds slot ids / block tables, whose scatter writes XLA
+        drops; decode warmup writes are below any live slot's attention
+        length mask. Call before serving traffic, not concurrently with it.
+        Returns the number of programs compiled."""
+        lbs = sorted(len_buckets) if len_buckets else self.prefill_buckets
+        bbs = sorted(batch_buckets) if batch_buckets else _pow2_buckets(1, self.max_prefill_batch)
+        key = jax.random.key(0)
+        count = 0
+        for lb in lbs:
+            for nb in bbs:
+                tokens = jnp.zeros((nb, lb), jnp.int32)
+                lengths = jnp.ones((nb,), jnp.int32)
+                temps = jnp.zeros((nb,), jnp.float32)
+                if self.kv_layout == "paged":
+                    rows = jnp.full((nb, self.pages_per_slot), self.total_pages, jnp.int32)
+                else:
+                    rows = jnp.full((nb,), self.num_slots, jnp.int32)
+                toks, self.cache = self._prefill_sample(
+                    self.params, tokens, lengths, self.cache, rows, key, temps
+                )
+                jax.block_until_ready(toks)
+                self._compiled.add(("prefill", lb, nb))
+                count += 1
+        if self.kv_layout == "paged":
+            # chunked-prefill programs (batch 1, one per len bucket)
+            for lb in lbs:
+                rows = jnp.full((1, self.pages_per_slot), self.total_pages, jnp.int32)
+                toks, self.cache = self._chunk_prefill(
+                    self.params, jnp.zeros((1, lb), jnp.int32), jnp.ones((1,), jnp.int32),
+                    self.cache, rows, jnp.zeros((1,), jnp.int32), key,
+                    jnp.zeros((1,), jnp.float32),
+                )
+                jax.block_until_ready(toks)
+                self._compiled.add(("prefill_chunk", lb, 1))
+                count += 1
+        n, k = self.num_slots, self.decode_chunk
+        tokens = jnp.zeros((n,), jnp.int32)
+        positions = jnp.zeros((n,), jnp.int32)
+        temps0 = jnp.zeros((n,), jnp.float32)
+        if self.kv_layout == "paged":
+            out, self.cache = self._decode_chunk(
+                self.params, tokens, positions, self.cache, key, temps0, k,
+                jnp.asarray(self._table),
+            )
+        else:
+            out, self.cache = self._decode_chunk(
+                self.params, tokens, positions, self.cache, key, temps0, k
+            )
+        jax.block_until_ready(out)
+        self._compiled.add(("decode", n, k))
+        return count + 1
 
     def generate(
         self,
@@ -549,10 +697,39 @@ class GenerateEngine(_EngineBase):
         request already admitted into a slot would block forever when the
         engine stops with a wedged device thread."""
         super()._fail_all(error)
-        for i, s in enumerate(self.slots):
-            if s is not None:
-                self._free_slot(i)
-                s.request.complete(error=error)
+        with self._state_lock:
+            for i, s in enumerate(self.slots):
+                if s is not None:
+                    self._free_slot(i)
+                    s.request.complete(error=error)
+
+    def _crash_recover(self, error: Exception) -> None:
+        """Slot-resident requests rode the crashed device state — fail them
+        and reset slot/page bookkeeping; queued/pending prompts survive and
+        re-plan after the restart."""
+        super()._crash_recover(error)
+        with self._state_lock:
+            for i, s in enumerate(self.slots):
+                if s is not None:
+                    self._free_slot(i)
+                    s.request.complete(error=error)
+            # The crashed call may have DONATED the cache buffer before
+            # dying — self.cache can reference a deleted array, and every
+            # post-restart step would fail on it, burning the whole restart
+            # budget on one fault. Rebuild it (all slots are empty now).
+            if self.kv_layout == "paged":
+                self.cache = self.family.make_paged_cache(
+                    self.cfg, self.total_pages, self.page_size
+                )
+                self._free_pages = list(range(self.total_pages))
+                self._slot_pages = [[] for _ in range(self.num_slots)]
+                self._table = np.full(
+                    (self.num_slots, self.pages_per_slot), self.total_pages, np.int32
+                )
+            else:
+                self.cache = self.family.make_cache(
+                    self.cfg, self.num_slots, self._cache_len
+                )
 
     # -- slot/page bookkeeping -------------------------------------------------
 
@@ -569,15 +746,24 @@ class GenerateEngine(_EngineBase):
 
     def _ensure_pages(self, slot_idx: int, upto_pos: int) -> bool:
         """Grow slot_idx's block table until it covers logical position
-        ``upto_pos``; False when the pool is exhausted."""
+        ``upto_pos``; False when the pool is exhausted. Failure rolls back
+        the pages allocated by THIS call: a partial allocation on a slot
+        that stays unoccupied (the admission path) would be invisible to
+        preemption and strand pool capacity forever (ADVICE.md round 2)."""
         need = upto_pos // self.page_size + 1
         cur = self._slot_pages[slot_idx]
+        added = 0
         while len(cur) < need:
             if not self._free_pages:
+                for _ in range(added):
+                    p = cur.pop()
+                    self._table[slot_idx, len(cur)] = self.total_pages
+                    self._free_pages.append(p)
                 return False
             p = self._free_pages.pop()
             self._table[slot_idx, len(cur)] = p
             cur.append(p)
+            added += 1
         return True
 
     def _preempt_newest(self, except_slot: int | None = None) -> bool:
@@ -602,8 +788,14 @@ class GenerateEngine(_EngineBase):
         )
         new_prompt = np.concatenate(
             [np.asarray(s.prompt_tokens, np.int32), np.asarray(s.generated, np.int32)]
-        )
-        self._pending.append((req, new_prompt))
+        ).astype(np.int32)
+        if new_prompt.shape[0] > self.prefill_buckets[-1]:
+            # the regrown prompt outgrew the bucket ladder: it re-enters
+            # through the chunked-prefill path rather than being expired
+            # (ADVICE.md round 2 medium)
+            self._pending_long.append((req, new_prompt))
+        else:
+            self._pending.append((req, new_prompt))
         self.metrics.increment_counter("app_tpu_preemptions", 1)
         return True
 
@@ -611,13 +803,20 @@ class GenerateEngine(_EngineBase):
         return [i for i, s in enumerate(self.slots) if s is None]
 
     def _active(self) -> list[int]:
-        return [i for i, s in enumerate(self.slots) if s is not None]
+        """Slots in the decode stage (chunk-prefilling slots excluded)."""
+        return [i for i, s in enumerate(self.slots) if s is not None and not s.prefilling]
+
+    def _prefilling(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if s is not None and s.prefilling]
 
     def _loop(self) -> None:
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not self._poisoned:
             admitted = self._admit()
+            # one chunk of ONE long prompt per iteration, so decode of the
+            # other slots keeps stepping between chunks (TTFT fairness)
+            chunked = self._advance_chunked()
             stepped = self._decode() if self._active() else False
-            if not admitted and not stepped:
+            if not admitted and not chunked and not stepped:
                 # idle: block briefly for work
                 try:
                     req = self._queue.get(timeout=0.2)
@@ -642,165 +841,276 @@ class GenerateEngine(_EngineBase):
                 if toks.shape[0] >= self.max_len:
                     raise ValueError(f"prompt length {toks.shape[0]} ≥ engine max_len {self.max_len}")
                 if toks.shape[0] > self.prefill_buckets[-1]:
-                    raise ValueError(
-                        f"prompt length {toks.shape[0]} exceeds the largest prefill "
-                        f"bucket {self.prefill_buckets[-1]}"
-                    )
-                self._pending.append((req, toks))
+                    if self.kv_layout != "paged":
+                        raise ValueError(
+                            f"prompt length {toks.shape[0]} exceeds the largest prefill "
+                            f"bucket {self.prefill_buckets[-1]} (chunked prefill needs "
+                            f"kv_layout='paged')"
+                        )
+                    self._pending_long.append((req, toks))
+                else:
+                    self._pending.append((req, toks))
             except Exception as e:  # noqa: BLE001
                 req.complete(error=e)
 
-    def _admit(self) -> bool:
-        self._drain_pending()
-        self.metrics.set_gauge("app_tpu_queue_depth", self._backlog())
-        free = self._free_slots()
-        if not self._pending:
-            return False
-        still = []
-        for r, t in self._pending:
-            if r.cancelled:
-                r.complete(error=RequestTimeout())
-            else:
-                still.append((r, t))
-        self._pending = still
+    def _admit_long(self) -> None:
+        """Claim a free slot for each waiting long prompt (paged layout).
+        No device work here — _advance_chunked streams the prompt into the
+        cache one bucket-sized chunk per loop iteration. Caller holds the
+        state lock."""
+        while self._pending_long and self._free_slots():
+            req, toks = self._pending_long.pop(0)
+            if req.cancelled or req.expired(time.monotonic()):
+                req.complete(error=RequestTimeout())
+                continue
+            idx = self._free_slots()[0]
+            slot = _Slot(
+                req,
+                prompt_len=int(toks.shape[0]),
+                max_total=min(int(toks.shape[0]) + int(req.kw.get("max_new_tokens", 64)),
+                              self.max_len),
+                eos=req.kw.get("eos_token_id", self.eos_token_id),
+                first_token=None,
+                admit_seq=self._admit_seq,
+                prompt_tokens=toks,
+            )
+            self._admit_seq += 1
+            self.slots[idx] = slot
 
-        # EDF + bucket-affinity packing (native planner when available):
-        # the most urgent request leads and sets the length bucket; only
-        # prompts fitting that bucket join, so one long prompt never
-        # inflates the whole batch's padding.
-        now_us = int(time.monotonic() * 1e6)
-        plan = plan_prefill(
-            [t.shape[0] for _, t in self._pending],
-            [int(r.deadline * 1e6) if r.deadline else 0 for r, _ in self._pending],
-            now_us, len(free), self.max_prefill_batch, self.prefill_buckets,
+    def _advance_chunked(self) -> bool:
+        """Write the next chunk of the OLDEST-admitted prefilling slot; the
+        final chunk samples the request's first token and flips the slot to
+        the decode stage. One chunk per loop iteration keeps decode stepping
+        between chunks. Returns True when device work happened."""
+        if self.kv_layout != "paged":
+            return False
+        with self._state_lock:
+            pre = self._prefilling()
+            if not pre:
+                return False
+            idx = min(pre, key=lambda i: self.slots[i].admit_seq)
+            s = self.slots[idx]
+            if s.request.cancelled or s.request.expired(time.monotonic()):
+                self._free_slot(idx)
+                s.request.complete(error=RequestTimeout())
+                return True  # state changed; re-loop without idling
+            chunk = min(s.prompt_len - s.written, self.prefill_buckets[-1])
+            lb = next_bucket(chunk, self.prefill_buckets)
+            # pages must cover this chunk's writes before the table snapshot
+            while not self._ensure_pages(idx, s.written + chunk - 1):
+                if not self._preempt_newest(except_slot=idx):
+                    self._free_slot(idx)
+                    s.request.complete(error=RuntimeError(
+                        "KV page pool exhausted for a single request"))
+                    return True  # state changed; re-loop without idling
+            if self.slots[idx] is None:  # preemption pressure evicted US
+                return True
+            last = s.written + chunk == s.prompt_len
+            tokens = np.zeros((1, lb), np.int32)
+            tokens[0, :chunk] = s.prompt_tokens[s.written:s.written + chunk]
+            lengths = np.array([chunk], np.int32)
+            offsets = np.array([s.written], np.int32)
+            temps = np.array([float(s.request.kw.get("temperature", 0.0))], np.float32)
+            pages_row = self._table[idx][None]
+            self._step_count += 1
+            key = jax.random.fold_in(self._base_key, self._step_count)
+            self._inflight = [s.request]
+            t0 = time.monotonic()
+
+        first_dev, self.cache = self._chunk_prefill(
+            self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+            self.cache, jnp.asarray(pages_row), jnp.asarray(offsets),
+            key, jnp.asarray(temps),
         )
-        for i in plan.expired:
-            self._pending[i][0].complete(error=RequestTimeout())
-        ready = [self._pending[i] for i in plan.chosen]
-        taken = set(plan.chosen) | set(plan.expired)
-        self._pending = [p for i, p in enumerate(self._pending) if i not in taken]
+        first = np.asarray(first_dev)
 
-        if self.kv_layout == "paged":
-            # admission gate: each admitted prompt needs pages covering its
-            # prefill writes NOW. On pool exhaustion the leader (most urgent)
-            # stops admission entirely — later arrivals must not starve it.
-            admitted: list[tuple[Request, np.ndarray]] = []
-            exhausted = False
-            for req, toks in ready:
-                if not exhausted and self._ensure_pages(free[len(admitted)], int(toks.shape[0]) - 1):
-                    admitted.append((req, toks))
+        with self._state_lock:
+            self._inflight = []
+            if self._poisoned or self._stop.is_set() or self.slots[idx] is not s:
+                return True  # stop()/crash/preemption took over while in flight
+            self._record_step("prefill_chunk", time.monotonic() - t0,
+                              chunk / lb, ("prefill_chunk", lb, 1))
+            self.metrics.increment_counter("app_tpu_tokens_total", chunk)
+            s.written += chunk
+            if last:
+                tok = int(first[0])
+                s.request.kw.setdefault("_first_token_at", time.monotonic())
+                s.generated = [tok]
+                s.last_token = tok
+                s.pos = s.prompt_len
+                s.first_token_at = time.monotonic()
+                self._emit(s, tok)
+                self._maybe_finish(idx)
+            return True
+
+    def _admit(self) -> bool:
+        # Planning/bookkeeping under the state lock; the device call outside
+        # it (a wedged device call must never hold the lock, or stop()'s
+        # _fail_all would deadlock behind it).
+        with self._state_lock:
+            self._drain_pending()
+            self.metrics.set_gauge("app_tpu_queue_depth", self._backlog())
+            self._admit_long()
+            free = self._free_slots()
+            if not self._pending:
+                return False
+            still = []
+            for r, t in self._pending:
+                if r.cancelled:
+                    r.complete(error=RequestTimeout())
                 else:
-                    exhausted = True
-                    self._pending.append((req, toks))
-            ready = admitted
-        if not ready:
-            return False
+                    still.append((r, t))
+            self._pending = still
 
-        # one prefill call, padded to (len_bucket, batch_bucket). Padding
-        # rows point at slot index == num_slots, which is out of bounds for
-        # the cache's slot dimension — XLA scatter DROPS out-of-bounds
-        # updates, so they write nowhere (verified in tests). Paged rows use
-        # the same trick through all-OOB block-table rows (ops.paged).
-        n = len(ready)
-        nb = plan.batch_bucket
-        lb = plan.len_bucket
-        tokens = np.zeros((nb, lb), np.int32)
-        lengths = np.ones((nb,), np.int32)
-        slot_ids = np.full((nb,), self.num_slots, np.int32)
-        temps = np.zeros((nb,), np.float32)
-        for i, (req, toks) in enumerate(ready):
-            tokens[i, : toks.shape[0]] = toks
-            lengths[i] = toks.shape[0]
-            slot_ids[i] = free[i]
-            temps[i] = float(req.kw.get("temperature", 0.0))
-        if self.kv_layout == "paged":
-            pages_rows = np.full((nb, self.pages_per_slot), self.total_pages, np.int32)
-            for i in range(n):
-                pages_rows[i] = self._table[free[i]]
-            device_rows = jnp.asarray(pages_rows)
-        else:
-            device_rows = jnp.asarray(slot_ids)
+            # EDF + bucket-affinity packing (native planner when available):
+            # the most urgent request leads and sets the length bucket; only
+            # prompts fitting that bucket join, so one long prompt never
+            # inflates the whole batch's padding.
+            now_us = int(time.monotonic() * 1e6)
+            plan = plan_prefill(
+                [t.shape[0] for _, t in self._pending],
+                [int(r.deadline * 1e6) if r.deadline else 0 for r, _ in self._pending],
+                now_us, len(free), self.max_prefill_batch, self.prefill_buckets,
+            )
+            for i in plan.expired:
+                self._pending[i][0].complete(error=RequestTimeout())
+            ready = [self._pending[i] for i in plan.chosen]
+            taken = set(plan.chosen) | set(plan.expired)
+            self._pending = [p for i, p in enumerate(self._pending) if i not in taken]
 
-        t0 = time.monotonic()
-        self._step_count += 1
-        key = jax.random.fold_in(self._base_key, self._step_count)
-        self._inflight = [req for req, _ in ready]
+            if self.kv_layout == "paged":
+                # admission gate: each admitted prompt needs pages covering its
+                # prefill writes NOW. On pool exhaustion the leader (most urgent)
+                # stops admission entirely — later arrivals must not starve it.
+                admitted: list[tuple[Request, np.ndarray]] = []
+                exhausted = False
+                for req, toks in ready:
+                    if not exhausted and self._ensure_pages(free[len(admitted)], int(toks.shape[0]) - 1):
+                        admitted.append((req, toks))
+                    else:
+                        exhausted = True
+                        self._pending.append((req, toks))
+                ready = admitted
+            if not ready:
+                return False
+
+            # one prefill call, padded to (len_bucket, batch_bucket). Padding
+            # rows point at slot index == num_slots, which is out of bounds for
+            # the cache's slot dimension — XLA scatter DROPS out-of-bounds
+            # updates, so they write nowhere (verified in tests). Paged rows use
+            # the same trick through all-OOB block-table rows (ops.paged).
+            n = len(ready)
+            nb = plan.batch_bucket
+            lb = plan.len_bucket
+            tokens = np.zeros((nb, lb), np.int32)
+            lengths = np.ones((nb,), np.int32)
+            slot_ids = np.full((nb,), self.num_slots, np.int32)
+            temps = np.zeros((nb,), np.float32)
+            for i, (req, toks) in enumerate(ready):
+                tokens[i, : toks.shape[0]] = toks
+                lengths[i] = toks.shape[0]
+                slot_ids[i] = free[i]
+                temps[i] = float(req.kw.get("temperature", 0.0))
+            if self.kv_layout == "paged":
+                pages_rows = np.full((nb, self.pages_per_slot), self.total_pages, np.int32)
+                for i in range(n):
+                    pages_rows[i] = self._table[free[i]]
+                device_rows = jnp.asarray(pages_rows)
+            else:
+                device_rows = jnp.asarray(slot_ids)
+
+            t0 = time.monotonic()
+            self._step_count += 1
+            key = jax.random.fold_in(self._base_key, self._step_count)
+            self._inflight = [req for req, _ in ready]
+
         first_dev, self.cache = self._prefill_sample(
             self.params, jnp.asarray(tokens), jnp.asarray(lengths),
             self.cache, device_rows, key, jnp.asarray(temps),
         )
-        self._inflight = []
         first = np.asarray(first_dev)  # [nb] int32 — tokens, never logits
-        if self._stop.is_set():
-            # stop() raced a wedged/slow prefill and already failed this batch
-            # (via _inflight); don't resurrect it into slots.
-            for req, _ in ready:
-                req.complete(error=EngineClosed("engine stopped"))
-            return True
-        self._record_step("prefill", time.monotonic() - t0, n / nb, ("prefill", lb, nb))
-        self.metrics.increment_counter("app_tpu_tokens_total", int(lengths[:n].sum()) + n)
 
-        for i, (req, toks) in enumerate(ready):
-            tok = int(first[i])
-            req.kw.setdefault("_first_token_at", time.monotonic())
-            slot = _Slot(
-                req,
-                prompt_len=int(lengths[i]),
-                max_total=min(int(lengths[i]) + int(req.kw.get("max_new_tokens", 64)), self.max_len),
-                eos=req.kw.get("eos_token_id", self.eos_token_id),
-                first_token=tok,
-                admit_seq=getattr(self, "_admit_seq", 0),
-                prompt_tokens=toks,
-            )
-            if self.kv_layout == "paged":
-                self._admit_seq += 1
-            self.slots[free[i]] = slot
-            self._emit(slot, tok)
-            self._maybe_finish(free[i])
-        return True
+        with self._state_lock:
+            self._inflight = []
+            if self._stop.is_set():
+                # stop() raced a wedged/slow prefill and already failed this batch
+                # (via _inflight); don't resurrect it into slots.
+                for req, _ in ready:
+                    req.complete(error=EngineClosed("engine stopped"))
+                return True
+            self._record_step("prefill", time.monotonic() - t0, n / nb, ("prefill", lb, nb))
+            self.metrics.increment_counter("app_tpu_tokens_total", int(lengths[:n].sum()) + n)
+
+            for i, (req, toks) in enumerate(ready):
+                tok = int(first[i])
+                req.kw.setdefault("_first_token_at", time.monotonic())
+                slot = _Slot(
+                    req,
+                    prompt_len=int(lengths[i]),
+                    max_total=min(int(lengths[i]) + int(req.kw.get("max_new_tokens", 64)), self.max_len),
+                    eos=req.kw.get("eos_token_id", self.eos_token_id),
+                    first_token=tok,
+                    admit_seq=getattr(self, "_admit_seq", 0),
+                    prompt_tokens=toks,
+                )
+                if self.kv_layout == "paged":
+                    self._admit_seq += 1
+                self.slots[free[i]] = slot
+                self._emit(slot, tok)
+                self._maybe_finish(free[i])
+            return True
 
     # -- decode ----------------------------------------------------------------
 
     def _decode(self) -> bool:
-        active = self._active()
-        if not active:
-            return False
-        n = self.num_slots
-        k = self.decode_chunk
-
-        if self.kv_layout == "paged":
-            # every active slot must own pages covering this chunk's writes
-            # (pos .. pos+k-1) BEFORE the table snapshot; pool exhaustion
-            # preempts the newest-admitted slot (LIFO, recompute on return)
-            for i in list(active):
-                s = self.slots[i]
-                if s is None:
-                    continue  # preempted by an earlier iteration's pressure
-                while not self._ensure_pages(i, s.pos + k - 1):
-                    if not self._preempt_newest(except_slot=i):
-                        # alone and still short — can't happen when
-                        # total_pages >= pages_per_slot (ctor guard)
-                        self._free_slot(i)
-                        s.request.complete(error=RuntimeError(
-                            "KV page pool exhausted for a single request"))
-                        break
+        with self._state_lock:
             active = self._active()
             if not active:
                 return False
+            n = self.num_slots
+            k = self.decode_chunk
 
-        tokens = np.zeros((n,), np.int32)
-        positions = np.zeros((n,), np.int32)
-        temps = np.zeros((n,), np.float32)
-        # always the FULL chunk — one compiled decode program for the whole
-        # serving lifetime. A slot that hits its budget/EOS mid-chunk simply
-        # has its surplus tokens discarded (the cache carries decode_chunk
-        # slack past max_len, so overshoot writes stay in bounds; paged
-        # slots' tables carry the same slack via pages_per_slot).
-        for i in active:
-            s = self.slots[i]
-            tokens[i] = s.last_token
-            positions[i] = s.pos
-            temps[i] = float(s.request.kw.get("temperature", 0.0))
+            if self.kv_layout == "paged":
+                # every active slot must own pages covering this chunk's writes
+                # (pos .. pos+k-1) BEFORE the table snapshot; pool exhaustion
+                # preempts the newest-admitted slot (LIFO, recompute on return)
+                for i in list(active):
+                    s = self.slots[i]
+                    if s is None:
+                        continue  # preempted by an earlier iteration's pressure
+                    while not self._ensure_pages(i, s.pos + k - 1):
+                        if not self._preempt_newest(except_slot=i):
+                            # alone and still short — can't happen when
+                            # total_pages >= pages_per_slot (ctor guard)
+                            self._free_slot(i)
+                            s.request.complete(error=RuntimeError(
+                                "KV page pool exhausted for a single request"))
+                            break
+                active = self._active()
+                if not active:
+                    return False
+
+            tokens = np.zeros((n,), np.int32)
+            positions = np.zeros((n,), np.int32)
+            temps = np.zeros((n,), np.float32)
+            # always the FULL chunk — one compiled decode program for the whole
+            # serving lifetime. A slot that hits its budget/EOS mid-chunk simply
+            # has its surplus tokens discarded (the cache carries decode_chunk
+            # slack past max_len, so overshoot writes stay in bounds; paged
+            # slots' tables carry the same slack via pages_per_slot).
+            for i in active:
+                s = self.slots[i]
+                tokens[i] = s.last_token
+                positions[i] = s.pos
+                temps[i] = float(s.request.kw.get("temperature", 0.0))
+            if self.kv_layout == "paged":
+                # snapshot with NON-decoding rows masked out: a chunk-prefilling
+                # slot owns real pages, and the decode scatter (which writes all
+                # rows uniformly) would corrupt its position 0 otherwise; empty
+                # slots are already all-OOB via _free_slot
+                table_snapshot = self._table.copy()
+                for i in self._prefilling():
+                    table_snapshot[i, :] = self.total_pages
 
         t0 = time.monotonic()
         self._step_count += 1
@@ -808,7 +1118,7 @@ class GenerateEngine(_EngineBase):
         if self.kv_layout == "paged":
             chunk_dev, self.cache = self._decode_chunk(
                 self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                self.cache, key, jnp.asarray(temps), k, jnp.asarray(self._table),
+                self.cache, key, jnp.asarray(temps), k, jnp.asarray(table_snapshot),
             )
         else:
             chunk_dev, self.cache = self._decode_chunk(
@@ -816,31 +1126,36 @@ class GenerateEngine(_EngineBase):
                 self.cache, key, jnp.asarray(temps), k,
             )
         chunk = np.asarray(chunk_dev)  # [slots, k] int32 — tokens, never logits
-        self._record_step("decode", time.monotonic() - t0, len(active) / n, ("decode", n, k))
+        if self._poisoned:
+            # stop() declared this thread wedged and already failed/cleared
+            # everything; the slot/page state now belongs to the caller.
+            return False
+        with self._state_lock:
+            self._record_step("decode", time.monotonic() - t0, len(active) / n, ("decode", n, k))
 
-        now = time.monotonic()
-        accepted = 0
-        for i in active:
-            s = self.slots[i]
-            if s is None:
-                continue  # cleared by _fail_all while the step was in flight
-            if s.request.cancelled or s.request.expired(now):
-                # slot invalidation: free the lane; in-flight work is discarded
-                self._free_slot(i)
-                s.request.complete(error=RequestTimeout())
-                continue
-            for j in range(k):
-                tok = int(chunk[i, j])
-                s.pos += 1
-                s.last_token = tok
-                s.generated.append(tok)
-                accepted += 1
-                self._emit(s, tok)
-                self._maybe_finish(i)
-                if self.slots[i] is None:  # EOS/length mid-chunk: rest discarded
-                    break
-        self.metrics.increment_counter("app_tpu_tokens_total", accepted)
-        return True
+            now = time.monotonic()
+            accepted = 0
+            for i in active:
+                s = self.slots[i]
+                if s is None:
+                    continue  # cleared by _fail_all while the step was in flight
+                if s.request.cancelled or s.request.expired(now):
+                    # slot invalidation: free the lane; in-flight work is discarded
+                    self._free_slot(i)
+                    s.request.complete(error=RequestTimeout())
+                    continue
+                for j in range(k):
+                    tok = int(chunk[i, j])
+                    s.pos += 1
+                    s.last_token = tok
+                    s.generated.append(tok)
+                    accepted += 1
+                    self._emit(s, tok)
+                    self._maybe_finish(i)
+                    if self.slots[i] is None:  # EOS/length mid-chunk: rest discarded
+                        break
+            self.metrics.increment_counter("app_tpu_tokens_total", accepted)
+            return True
 
     # -- completion ------------------------------------------------------------
 
@@ -929,6 +1244,7 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
 
     tokenizer = _load_tokenizer(spec.tokenizer)
     default_timeout = conf.get_float("ENGINE_TIMEOUT", 0.0) or None
+    kw.setdefault("max_restarts", conf.get_int("ENGINE_MAX_RESTARTS", 3))
 
     if spec.task == "generate":
         eos = kw.pop("eos_token_id", None)

@@ -342,6 +342,66 @@ class TestBuildEngine:
             eng.stop()
 
 
+class TestEngineSupervision:
+    """SURVEY §5.3 / VERDICT r2 #4: a crashed device loop restarts with
+    backoff instead of dying permanently (reference analog: the SQL driver's
+    reconnect loop, sql.go:108-133)."""
+
+    def test_engine_recovers_from_step_crash(self, gen_setup):
+        cfg, params, ref = gen_setup
+        eng = make_gen_engine(cfg, params, make_container())
+        real = eng._decode_chunk
+        boom = {"left": 1}
+
+        def flaky(*a, **kw):
+            if boom["left"] > 0:
+                boom["left"] -= 1
+                # simulate a fault AFTER buffer donation: the cache the
+                # engine holds is dead, recovery must rebuild it
+                jax.tree.map(lambda x: x.delete(), a[3])
+                raise RuntimeError("injected device fault")
+            return real(*a, **kw)
+
+        eng._decode_chunk = flaky
+        try:
+            # the in-flight request rides the crashed state and fails...
+            with pytest.raises(Exception):
+                eng.generate([5, 3, 9], max_new_tokens=6, timeout=60)
+            # ...but the engine restarted: later requests succeed exactly
+            out = eng.generate([5, 3, 9], max_new_tokens=6, timeout=60)
+            assert out["tokens"] == ref([5, 3, 9], 6)
+            restarts = eng.metrics.get("app_tpu_engine_restarts")
+            assert restarts is not None and sum(restarts._values.values()) >= 1
+            assert eng.health_check()["status"] == "UP"
+            assert eng.health_check()["details"]["restarts"] >= 1
+        finally:
+            eng.stop()
+
+    def test_engine_gives_up_after_max_restarts(self, gen_setup):
+        cfg, params, _ = gen_setup
+        eng = make_gen_engine(cfg, params, make_container(), max_restarts=1)
+
+        def always_boom(*a, **kw):
+            raise RuntimeError("permanent device fault")
+
+        eng._decode_chunk = always_boom
+        try:
+            # crash #1 consumes the single restart; crash #2 exhausts the
+            # budget and the engine goes DOWN permanently
+            with pytest.raises(Exception):
+                eng.generate([5, 3, 9], max_new_tokens=4, timeout=60)
+            with pytest.raises(Exception):
+                eng.generate([1, 2], max_new_tokens=2, timeout=60)
+            deadline = time.monotonic() + 10
+            while eng.health_check()["status"] != "DOWN" and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert eng.health_check()["status"] == "DOWN"
+            with pytest.raises(Exception):
+                eng.generate([7, 8], max_new_tokens=2, timeout=10)
+        finally:
+            eng.stop()
+
+
 class TestPagedGenerateEngine:
     """GenerateEngine on the paged KV cache (ops.paged): identical results
     to the sequential reference, page accounting, preemption-by-recompute."""
@@ -431,6 +491,120 @@ class TestPagedGenerateEngine:
         cfg, params, _ = gen_setup
         with pytest.raises(ValueError, match="total_pages"):
             self._engine(cfg, params, total_pages=4)
+
+    def test_ensure_pages_rolls_back_partial_allocation(self, gen_setup):
+        """ADVICE r2 (high): a failed _ensure_pages must not leave pages on
+        a slot that stays unoccupied — they'd be invisible to preemption and
+        permanently strand pool capacity."""
+        cfg, params, _ = gen_setup
+        eng = self._engine(cfg, params, total_pages=9)  # pages_per_slot = 9
+        try:
+            assert eng._ensure_pages(0, 7 * eng.page_size - 1)  # 7 of 9 pages
+            free_before = sorted(eng._free_pages)
+            assert not eng._ensure_pages(1, 3 * eng.page_size - 1)  # needs 3, 2 left
+            assert sorted(eng._free_pages) == free_before, "partial alloc leaked"
+            assert eng._slot_pages[1] == []
+            assert (eng._table[1] == eng.total_pages).all()
+            # the slot that legitimately owns pages keeps them
+            assert len(eng._slot_pages[0]) == 7
+        finally:
+            eng.stop()
+
+    def test_preempted_regrown_prompt_exceeds_custom_bucket(self, gen_setup):
+        """ADVICE r2 (medium): preemption folds generated tokens into the
+        prompt; with a custom bucket ladder below max_len the regrown prompt
+        must still be admittable, not spuriously expired."""
+        cfg, params, ref = gen_setup
+        eng = self._engine(cfg, params, total_pages=10, prefill_buckets=[4])
+        prompts = [[i + 1, (3 * i) % 200 + 1, (5 * i) % 150] for i in range(4)]
+        want = [ref(p, 20) for p in prompts]
+        results = [None] * len(prompts)
+
+        def worker(i):
+            results[i] = eng.generate(prompts[i], max_new_tokens=20, timeout=300)
+
+        try:
+            threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            for i, r in enumerate(results):
+                assert r is not None, f"request {i} did not complete"
+                assert r["tokens"] == want[i], f"request {i} diverged after preemption"
+            preempts = eng.metrics.get("app_tpu_preemptions")
+            assert preempts is not None and sum(preempts._values.values()) >= 1
+        finally:
+            eng.stop()
+
+    def test_chunked_prefill_long_prompt_matches_reference(self, gen_setup):
+        """VERDICT r2 #3: a prompt longer than the largest prefill bucket is
+        streamed into the cache in chunks and must decode identically to the
+        dense reference, while short requests admitted alongside it still
+        complete (decode interleaves with the chunks)."""
+        cfg, params, ref = gen_setup
+        eng = self._engine(cfg, params, prefill_buckets=[8])
+        long_prompt = [(7 * i) % 190 + 1 for i in range(21)]  # 21 > bucket 8
+        short_prompts = [[i + 1, (2 * i) % 99 + 1] for i in range(3)]
+        want_long = ref(long_prompt, 6)
+        want_short = [ref(p, 4) for p in short_prompts]
+        results = {"long": None, "short": [None] * 3}
+
+        def run_long():
+            results["long"] = eng.generate(long_prompt, max_new_tokens=6, timeout=300)
+
+        def run_short(i):
+            results["short"][i] = eng.generate(short_prompts[i], max_new_tokens=4, timeout=300)
+
+        try:
+            threads = [threading.Thread(target=run_long)] + [
+                threading.Thread(target=run_short, args=(i,)) for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert results["long"] is not None, "long prompt never completed"
+            assert results["long"]["tokens"] == want_long, "chunked prefill diverged"
+            assert [r["tokens"] for r in results["short"]] == want_short
+            steps = eng.metrics.get("app_tpu_step_seconds")
+            kinds = {k for k in steps._totals} if steps is not None else set()
+            assert any("prefill_chunk" in str(k) for k in kinds), (
+                "long prompt did not take the chunked path — test premise broken"
+            )
+        finally:
+            eng.stop()
+
+    def test_chunked_prefill_under_pool_pressure(self, gen_setup):
+        """Chunked admission + preemption compose: a long prompt re-entering
+        after preemption (regrown past the bucket ladder) still finishes
+        with exact tokens."""
+        cfg, params, ref = gen_setup
+        eng = self._engine(cfg, params, prefill_buckets=[8], total_pages=12)
+        long_prompt = [(3 * i) % 150 + 2 for i in range(17)]
+        want = ref(long_prompt, 8)
+        others = [[i + 1, i + 2] for i in range(3)]
+        want_others = [ref(p, 12) for p in others]
+        res = [None] * 4
+
+        def w(i):
+            if i == 0:
+                res[0] = eng.generate(long_prompt, max_new_tokens=8, timeout=300)
+            else:
+                res[i] = eng.generate(others[i - 1], max_new_tokens=12, timeout=300)
+
+        try:
+            threads = [threading.Thread(target=w, args=(i,)) for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert all(r is not None for r in res)
+            assert res[0]["tokens"] == want
+            assert [r["tokens"] for r in res[1:]] == want_others
+            assert sorted(eng._free_pages) == list(range(eng.total_pages))
+        finally:
+            eng.stop()
 
     def test_more_slots_at_equal_hbm(self, gen_setup):
         """The headline arithmetic: at the slot cache's HBM budget, the paged
