@@ -34,7 +34,36 @@ def build_app(config=None, *, preset: str = "tiny") -> App:
             timeout=body.get("timeout", 120),
         )
 
+    def generate_stream(ctx):
+        """SSE: tokens arrive as `data:` events while decode is running."""
+        from gofr_tpu.http.streaming import StreamingResponse
+
+        body = ctx.bind(dict)
+        it = ctx.generate(
+            "lm", body["prompt"],
+            max_new_tokens=int(body.get("max_new_tokens", 8)),
+            temperature=float(body.get("temperature", 0.0)),
+            timeout=body.get("timeout", 120),
+            stream=True,
+        )
+        return StreamingResponse(it, event="token")
+
+    def ws_generate(ctx):
+        """Websocket: one message per token (websocket.go:37-53 parity)."""
+        from gofr_tpu.http.streaming import StreamingResponse
+
+        body = ctx.bind(dict)
+        it = ctx.generate(
+            "lm", body["prompt"],
+            max_new_tokens=int(body.get("max_new_tokens", 8)),
+            timeout=body.get("timeout", 120),
+            stream=True,
+        )
+        return StreamingResponse(it)
+
     app.post("/generate", generate)
+    app.post("/generate/stream", generate_stream)
+    app.websocket("/ws/generate", ws_generate)
     return app
 
 

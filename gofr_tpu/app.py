@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import math
+import os
 import signal
 import threading
 import uuid
@@ -35,6 +37,7 @@ from gofr_tpu.http.middleware import (
 )
 from gofr_tpu.http.request import HTTPRequest
 from gofr_tpu.http.responder import respond, to_json
+from gofr_tpu.http.streaming import StreamingResponse
 from gofr_tpu.websocket import ConnectionHub, WSConnection
 
 Handler = Callable[[Context], Any]
@@ -221,6 +224,10 @@ class App:
         http_app.router.add_get("/.well-known/alive", self._alive_handler)
         http_app.router.add_get("/favicon.ico", self._favicon_handler)
         self._add_openapi_routes(http_app)
+        if self._debug_env():
+            # profiling tier, gated like the reference's pprof routes
+            # (http_server.go:53-60): trace capture on demand
+            http_app.router.add_get("/debug/profile", self._profile_handler)
 
         for method, path, handler in self._routes:
             http_app.router.add_route(method, path, self._wrap(handler))
@@ -286,6 +293,8 @@ class App:
                 err = e
                 if not hasattr(e, "status_code"):
                     self.logger.log_exception(e, f"handler {request.method} {request.path}")
+            if err is None and isinstance(result, StreamingResponse):
+                return await self._stream_sse(request, result)
             wire = respond(result, err, request.method)
             return web.Response(
                 body=wire.body,
@@ -295,6 +304,50 @@ class App:
             )
 
         return aio_handler
+
+    async def _stream_sse(self, request: web.Request, stream: StreamingResponse) -> web.StreamResponse:
+        """Drive a StreamingResponse as text/event-stream. Items are pulled
+        on the executor (the engine's stream queue blocks); each flush makes
+        the token visible to the client before generation finishes."""
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache",
+                     "X-Accel-Buffering": "no"},
+        )
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        sentinel = object()
+        try:
+            while True:
+                item = await loop.run_in_executor(self._executor, next, stream.iterator, sentinel)
+                if item is sentinel:
+                    break
+                await resp.write(stream.encode_sse(item))
+            await resp.write(StreamingResponse.sse_done())
+        except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+            # client went away mid-decode: cancel the generation so the
+            # engine frees the slot/pages instead of decoding for a ghost
+            self._cancel_stream(stream)
+            raise
+        except Exception as e:  # noqa: BLE001 - surface mid-stream failure in-band
+            self.logger.log_exception(e, "sse stream")
+            self._cancel_stream(stream)
+            try:
+                await resp.write(StreamingResponse.sse_error(str(e)))
+            except Exception:  # noqa: BLE001 - client already gone
+                return resp
+        try:
+            await resp.write_eof()
+        except Exception:  # noqa: BLE001 - broken transport on eof
+            pass
+        return resp
+
+    @staticmethod
+    def _cancel_stream(stream: StreamingResponse) -> None:
+        cancel = getattr(stream.iterator, "cancel", None)
+        if callable(cancel):
+            cancel()
 
     def _wrap_ws(self, handler: Handler):
         is_coro = inspect.iscoroutinefunction(handler)
@@ -324,7 +377,30 @@ class App:
                         self.logger.log_exception(e, "websocket handler")
                         await ws.send_str(to_json({"error": {"message": "handler error"}}).decode())
                         continue
-                    if result is not None:
+                    if isinstance(result, StreamingResponse):
+                        # token streaming: one ws message per item, pulled on
+                        # the executor (websocket.go:37-53 parity, per-token).
+                        # A mid-stream engine error becomes an in-band error
+                        # frame — the connection survives; a transport error
+                        # cancels the generation so the slot is freed.
+                        sentinel = object()
+                        try:
+                            while True:
+                                item = await loop.run_in_executor(
+                                    self._executor, next, result.iterator, sentinel)
+                                if item is sentinel:
+                                    break
+                                await ws.send_str(result.encode_ws(item))
+                            await ws.send_str(to_json({"done": True}).decode())
+                        except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+                            self._cancel_stream(result)
+                            raise
+                        except Exception as e:  # noqa: BLE001
+                            self.logger.log_exception(e, "websocket token stream")
+                            self._cancel_stream(result)
+                            await ws.send_str(to_json(
+                                {"error": {"message": str(e)}, "done": True}).decode())
+                    elif result is not None:
                         payload = result if isinstance(result, str) else to_json(result).decode()
                         await ws.send_str(payload)
             finally:
@@ -348,6 +424,56 @@ class App:
 
     async def _not_found_handler(self, _request: web.Request) -> web.Response:
         return web.json_response({"error": {"message": "route not registered"}}, status=404)
+
+    # -- profiling (SURVEY §5.1; reference http_server.go:53-60) ---------------
+
+    def _debug_env(self) -> bool:
+        return self.config.get_or_default("APP_ENV", "").upper() == "DEBUG"
+
+    def _start_profiler_server(self) -> None:
+        """jax.profiler gRPC server for live tensorboard/xprof attach, on
+        PROFILER_PORT (0 disables). DEBUG-gated like the pprof routes."""
+        port = self.config.get_int("PROFILER_PORT", 9999)
+        if port <= 0:
+            return
+        try:
+            import jax
+
+            jax.profiler.start_server(port)
+            self.logger.infof("jax profiler server on :%d (APP_ENV=DEBUG)", port)
+        except Exception as e:  # noqa: BLE001 - profiling must never block serving
+            self.logger.warn(f"profiler server failed to start: {e}")
+
+    async def _profile_handler(self, request: web.Request) -> web.Response:
+        """GET /debug/profile?seconds=N → capture an xplane trace of whatever
+        the engines/handlers are doing for N seconds; returns the trace dir
+        (open with tensorboard/xprof)."""
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+            if not math.isfinite(seconds):
+                raise ValueError(seconds)
+            seconds = min(max(seconds, 0.1), 60.0)
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "seconds must be a finite number"}}, status=400)
+        out_root = self.config.get_or_default("PROFILER_DIR", "/tmp/gofr_tpu_profile")
+
+        def capture() -> str:
+            import time as _time
+
+            import jax
+
+            path = os.path.join(out_root, _time.strftime("trace-%Y%m%d-%H%M%S"))
+            with jax.profiler.trace(path):
+                _time.sleep(seconds)
+            return path
+
+        loop = asyncio.get_running_loop()
+        try:
+            path = await loop.run_in_executor(self._executor, capture)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": {"message": str(e)}}, status=500)
+        return web.json_response({"data": {"trace_dir": path, "seconds": seconds}})
 
     def _add_openapi_routes(self, http_app: web.Application) -> None:
         from gofr_tpu.swagger import openapi_handler, swagger_ui_handler
@@ -411,6 +537,9 @@ class App:
             except (NotImplementedError, RuntimeError):
                 pass
 
+        if self._debug_env():
+            self._start_profiler_server()
+
         # engines first (device warm-up), then servers
         for name, engine in self.container.engines.items():
             if hasattr(engine, "start"):
@@ -423,7 +552,7 @@ class App:
         self._runners.append(metrics_runner)
         self.logger.infof("metrics server on :%d/metrics", self.metrics_port)
 
-        if self._routes or self._ws_routes or self._static:
+        if self._routes or self._ws_routes or self._static or self._debug_env():
             http_runner = web.AppRunner(self._build_http_app())
             await http_runner.setup()
             await web.TCPSite(http_runner, host="0.0.0.0", port=self.http_port).start()

@@ -55,6 +55,7 @@ class Container:
         c = cls(config)
         c._register_framework_metrics()
         c.metrics.add_collect_hook(sample_runtime_metrics)
+        c.metrics.add_collect_hook(c._sample_tpu_metrics)
         c.tracer = tracer_from_config(config, c.logger, c.app_name)
         c._maybe_remote_log_level()
         c._maybe_sql()
@@ -91,6 +92,18 @@ class Container:
         m.new_gauge("app_tpu_kv_pages_free", "free pages in the paged KV pool")
         m.new_counter("app_tpu_preemptions", "slots preempted under KV pool pressure")
         m.new_counter("app_tpu_engine_restarts", "engine device-thread restarts")
+
+    def _sample_tpu_metrics(self, _registry=None) -> None:
+        """Collect hook: live HBM gauges on every /metrics scrape (the
+        reference pushes pool gauges on a ticker, sql.go:190-203). Only if
+        the TPU datasource is already materialized — a scrape must never be
+        the thing that initializes a device backend."""
+        tpu = self._tpu
+        if tpu is not None:
+            try:
+                tpu._push_memory_gauges()
+            except Exception:  # noqa: BLE001 - scrape must not fail on device hiccup
+                pass
 
     def _maybe_remote_log_level(self) -> None:
         url = self.config.get("REMOTE_LOG_URL")
@@ -285,5 +298,6 @@ def new_mock_container(config: dict[str, str] | None = None) -> Container:
 
     c = Container(DictConfig(config or {}), logger=MockLogger(level=Level.DEBUG))
     c._register_framework_metrics()
+    c.metrics.add_collect_hook(c._sample_tpu_metrics)
     c.pubsub = InMemoryBroker()
     return c

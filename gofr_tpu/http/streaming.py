@@ -1,0 +1,43 @@
+"""Streaming responses: SSE over HTTP, per-message over websocket.
+
+The reference streams long work over websockets (`pkg/gofr/websocket.go:37-53`);
+the TPU-native analog is token streaming out of a generate engine. A handler
+returns ``StreamingResponse(engine-or-ctx stream iterator)`` and the app
+drives it:
+
+- HTTP route: ``text/event-stream`` — one ``data: <json>`` event per item,
+  then a terminal ``event: done`` (or ``event: error``) frame.
+- Websocket route: one websocket message per item.
+
+The iterator may block (the engine's stream queue does), so the app pulls
+items on the handler executor, never on the event loop.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable, Iterator
+
+
+class StreamingResponse:
+    """Wraps a (possibly blocking) iterator of items for incremental
+    delivery. ``event`` names the SSE event type for data frames."""
+
+    def __init__(self, iterator: Iterable[Any], *, event: str | None = None):
+        self.iterator: Iterator[Any] = iter(iterator)
+        self.event = event
+
+    def encode_sse(self, item: Any) -> bytes:
+        prefix = f"event: {self.event}\n" if self.event else ""
+        return f"{prefix}data: {json.dumps(item)}\n\n".encode()
+
+    @staticmethod
+    def sse_done() -> bytes:
+        return b"event: done\ndata: {}\n\n"
+
+    @staticmethod
+    def sse_error(message: str) -> bytes:
+        return f"event: error\ndata: {json.dumps({'message': message})}\n\n".encode()
+
+    def encode_ws(self, item: Any) -> str:
+        return item if isinstance(item, str) else json.dumps(item)

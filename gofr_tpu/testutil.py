@@ -1,0 +1,87 @@
+"""Test utilities — the reference's `pkg/gofr/testutil` analog (SURVEY §2.7),
+extended with the TPU build's own needs: shared mesh-serving correctness
+checks used by both the pytest tier and the driver's multichip dryrun, so
+the two can't silently drift apart.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def tiny_f32_llama():
+    """A tiny FLOAT32 llama config + params for cross-sharding greedy-token
+    comparisons. f32 matters: sharded matmul reduction order differs from
+    the dense single-device order, and on a random bf16 model near-tie
+    argmaxes flip — which would test numerics, not the serving path."""
+    from gofr_tpu.models import LlamaConfig, llama
+
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=160,
+        num_layers=2, num_heads=8, num_kv_heads=4, max_seq_len=128,
+        dtype=jnp.float32,
+    )
+    params = llama.init(cfg, jax.random.key(3))
+    return cfg, params
+
+
+def greedy_reference(cfg, params) -> Callable[[list[int], int], list[int]]:
+    """Single-device incremental-forward greedy decoder (the ground truth
+    every engine/sharding path must reproduce token-for-token)."""
+    from gofr_tpu.models import llama
+
+    def ref(prompt: list[int], n: int) -> list[int]:
+        seq = list(prompt)
+        for _ in range(n):
+            logits = llama.forward(cfg, params, jnp.asarray([seq], jnp.int32))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        return seq[len(prompt):]
+
+    return ref
+
+
+def check_mesh_serving(config: dict[str, str], *, n_requests: int = 6,
+                       max_new: int = 5, timeout: float = 600.0,
+                       **engine_kw) -> None:
+    """Build an engine on a mesh container (per ``config``, e.g.
+    ``{"TPU_MESH": "dp:2,tp:4"}``), serve ``n_requests`` concurrent greedy
+    requests, and require token-exact agreement with single-device decoding.
+    Raises AssertionError on divergence."""
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import ModelSpec
+    from gofr_tpu.tpu.engine import build_engine
+
+    cfg, params = tiny_f32_llama()
+    ref = greedy_reference(cfg, params)
+
+    container = new_mock_container(config)
+    engine_kw.setdefault("slots", 4)
+    engine_kw.setdefault("max_len", 64)
+    engine_kw.setdefault("max_prefill_batch", 2)
+    eng = build_engine(ModelSpec(family="llama", task="generate", config=cfg),
+                       container, seed=3, **engine_kw)
+    prompts = [[i + 1, (2 * i) % 200 + 1, (7 * i) % 150 + 1] for i in range(n_requests)]
+    want = [ref(p, max_new) for p in prompts]
+    results: list = [None] * len(prompts)
+
+    def worker(i):
+        results[i] = eng.generate(prompts[i], max_new_tokens=max_new, timeout=timeout)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+        for i, r in enumerate(results):
+            assert r is not None, f"request {i} did not complete"
+            assert r["tokens"] == want[i], (
+                f"request {i} diverged on mesh {config.get('TPU_MESH')}: "
+                f"{r['tokens']} != {want[i]}"
+            )
+    finally:
+        eng.stop()

@@ -4,8 +4,15 @@ Wraps the visible accelerator devices plus the configured mesh the way the
 reference wraps a connection pool (SQL: `datasource/sql/sql.go:37-89` —
 lazy connect, pushed pool gauges, health check). Config keys:
 
-    TPU_MESH       mesh topology, e.g. "dp:2,tp:4" (default: all on dp)
-    TPU_DEVICES    cap the number of devices used (default: all)
+    TPU_MESH            mesh topology, e.g. "dp:2,tp:4" (default: all on dp)
+    TPU_DEVICES         cap the number of devices used (default: all)
+    JAX_COORDINATOR     host:port of process 0 → multi-host (DCN) mode:
+                        ``jax.distributed.initialize`` runs before any device
+                        access and the mesh spans the GLOBAL device set
+                        (SURVEY §5.8; the reference's backend-by-config
+                        switch, container.go:95-122)
+    JAX_NUM_PROCESSES   total processes in the job (with JAX_COORDINATOR)
+    JAX_PROCESS_ID      this process's index (with JAX_COORDINATOR)
 
 Everything degrades gracefully on CPU (the virtual test mesh) — memory
 stats are best-effort because the CPU PJRT client doesn't report them.
@@ -21,6 +28,33 @@ import jax
 from gofr_tpu.parallel import ShardingRules, mesh_from_config
 
 
+def _maybe_init_distributed(config, logger) -> bool:
+    """Config-gated multi-host bring-up. Unset coordinator ⇒ single-process
+    (the 'unset host ⇒ feature off' rule every datasource follows). Must run
+    before the first device touch in the process; `jax.distributed` raises
+    if already initialized, which we treat as wired."""
+    coordinator = config.get("JAX_COORDINATOR")
+    if not coordinator:
+        return False
+    num_processes = config.get_int("JAX_NUM_PROCESSES", 1)
+    process_id = config.get_int("JAX_PROCESS_ID", 0)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        logger.infof(
+            "jax.distributed initialized: process %d/%d via %s",
+            process_id, num_processes, coordinator,
+        )
+        return True
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            return True
+        raise
+
+
 class TPUDevices:
     def __init__(self, config, logger, metrics):
         self.config = config
@@ -28,8 +62,12 @@ class TPUDevices:
         self.metrics = metrics
         self._lock = threading.Lock()
 
+        self.distributed = _maybe_init_distributed(config, logger)
         limit = config.get_int("TPU_DEVICES", 0)
+        # multi-host: the mesh MUST span the global device set so pjit
+        # programs agree across processes; local-only work uses local_devices
         devices = jax.devices()
+        self.local_devices = jax.local_devices() if self.distributed else devices
         self.devices = devices[:limit] if limit > 0 else devices
         self.platform = self.devices[0].platform if self.devices else "none"
         self.mesh = mesh_from_config(config, devices=self.devices)
@@ -48,9 +86,11 @@ class TPUDevices:
 
     def memory_stats(self) -> dict[str, dict[str, int]]:
         """Per-device HBM stats (empty entries where the backend doesn't
-        report them, e.g. CPU)."""
+        report them, e.g. CPU). Multi-host: only this process's devices are
+        addressable, so gauges cover the local slice."""
         stats: dict[str, dict[str, int]] = {}
-        for d in self.devices:
+        local = [d for d in self.devices if d in self.local_devices] or self.devices
+        for d in local:
             try:
                 s = d.memory_stats() or {}
             except Exception:  # noqa: BLE001

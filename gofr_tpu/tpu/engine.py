@@ -424,6 +424,25 @@ class _Slot:
         return self.written < self.prompt_len
 
 
+class _StreamIterator:
+    """Token-stream iterator with an explicit ``cancel()`` so transports can
+    free the slot when the client disconnects mid-generation (otherwise the
+    engine would decode to max_new_tokens for a client that is gone)."""
+
+    def __init__(self, req: Request, gen: Iterator[Any]):
+        self._req = req
+        self._gen = gen
+
+    def __iter__(self) -> "_StreamIterator":
+        return self
+
+    def __next__(self) -> Any:
+        return next(self._gen)
+
+    def cancel(self) -> None:
+        self._req.cancel()
+
+
 class GenerateEngine(_EngineBase):
     """Slot-based continuous batching for decoder LMs (family must expose
     ``prefill``, ``decode_step``, ``make_cache`` — see models.llama)."""
@@ -592,10 +611,20 @@ class GenerateEngine(_EngineBase):
         drops; decode warmup writes are below any live slot's attention
         length mask. Call before serving traffic, not concurrently with it.
         Returns the number of programs compiled."""
+        from gofr_tpu.ops.pallas import platform_hint
+
         lbs = sorted(len_buckets) if len_buckets else self.prefill_buckets
         bbs = sorted(batch_buckets) if batch_buckets else _pow2_buckets(1, self.max_prefill_batch)
         key = jax.random.key(0)
         count = 0
+        # same platform pin as the device thread (_run): without it, warmup
+        # traces on the caller thread could resolve kernels for the wrong
+        # backend (e.g. Pallas for a CPU test mesh under an attached TPU),
+        # and jit would cache that mis-resolved program per shape
+        with platform_hint(getattr(self.tpu, "platform", None)):
+            return self._warmup_traced(lbs, bbs, key, count)
+
+    def _warmup_traced(self, lbs: list[int], bbs: list[int], key, count: int) -> int:
         for lb in lbs:
             for nb in bbs:
                 tokens = jnp.zeros((nb, lb), jnp.int32)
@@ -664,7 +693,7 @@ class GenerateEngine(_EngineBase):
     def infer(self, inputs: Any, **kw: Any):
         return self.generate(inputs, **kw)
 
-    def _stream_iter(self, req: Request, timeout: float | None) -> Iterator[Any]:
+    def _stream_iter(self, req: Request, timeout: float | None) -> "_StreamIterator":
         per_token_timeout = timeout if timeout is not None else self.default_timeout
 
         def it():
@@ -681,7 +710,7 @@ class GenerateEngine(_EngineBase):
                     return
                 yield item
 
-        return it()
+        return _StreamIterator(req, it())
 
     # -- device loop -----------------------------------------------------------
 

