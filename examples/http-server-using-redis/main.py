@@ -1,0 +1,54 @@
+"""Redis-backed HTTP server (reference `examples/http-server-using-redis`):
+SET/GET/pipeline against the container's Redis datasource — the from-scratch
+RESP wire client (`gofr_tpu/datasource/redis.py`), wired only when
+REDIS_HOST is configured (`container.go:91` semantics).
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.http.errors import EntityNotFound
+
+REDIS_EXPIRY_S = 5 * 60
+
+
+def build_app(config=None) -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+
+    def redis_set(ctx):
+        body = ctx.bind(dict)
+        for key, value in body.items():
+            ctx.redis.set(key, value, ex=REDIS_EXPIRY_S)
+        return "Successful"
+
+    def redis_get(ctx):
+        key = ctx.path_param("key")
+        value = ctx.redis.get(key)
+        if value is None:
+            raise EntityNotFound(f"key {key!r}")
+        return value.decode() if isinstance(value, bytes) else value
+
+    def redis_pipeline(ctx):
+        results = (
+            ctx.redis.pipeline()
+            .command("SET", "pipe-key", "pipe-value", "EX", REDIS_EXPIRY_S)
+            .command("GET", "pipe-key")
+            .execute()
+        )
+        return [r.decode() if isinstance(r, bytes) else r for r in results]
+
+    app.post("/redis", redis_set)
+    app.get("/redis/{key}", redis_get)
+    app.get("/redis-pipeline", redis_pipeline)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,55 @@
+"""Custom application metrics (reference `examples/using-custom-metrics`):
+an e-commerce store registering its own counter / up-down counter / gauge /
+histogram alongside the framework metrics, recorded from handlers and
+scraped from the separate metrics port.
+"""
+
+import os as _os
+import sys as _sys
+import time
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+
+TRANSACTION_SUCCESS = "transaction_success"
+TRANSACTION_TIME = "transaction_time"
+TOTAL_CREDIT_DAY_SALES = "total_credit_day_sale"
+PRODUCT_STOCK = "product_stock"
+
+
+def build_app(config=None) -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+    m = app.container.metrics
+
+    m.new_counter(TRANSACTION_SUCCESS, "count of successful transactions")
+    m.new_updown_counter(TOTAL_CREDIT_DAY_SALES, "total credit sales in a day")
+    m.new_gauge(PRODUCT_STOCK, "number of products in stock")
+    m.new_histogram(TRANSACTION_TIME, "time taken by a transaction (ms)",
+                    buckets=[5, 10, 15, 20, 25, 35])
+
+    def transaction(ctx):
+        start = time.monotonic()
+        # ... transaction logic ...
+        ctx.metrics.increment_counter(TRANSACTION_SUCCESS)
+        ctx.metrics.record_histogram(TRANSACTION_TIME, (time.monotonic() - start) * 1e3)
+        ctx.metrics.delta_updown_counter(TOTAL_CREDIT_DAY_SALES, 1000, sale_type="credit")
+        ctx.metrics.set_gauge(PRODUCT_STOCK, 10)
+        return "Transaction Successful"
+
+    def sale_return(ctx):
+        ctx.metrics.delta_updown_counter(TOTAL_CREDIT_DAY_SALES, -1000, sale_type="credit_return")
+        ctx.metrics.set_gauge(PRODUCT_STOCK, 50)
+        return "Return Successful"
+
+    app.post("/transaction", transaction)
+    app.post("/return", sale_return)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

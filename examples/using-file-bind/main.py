@@ -1,0 +1,48 @@
+"""Multipart file binding (reference `examples/using-file-bind`): a POST
+/upload route binding a multipart form into a dataclass — a plain form
+field, a generic uploaded file, and a zip archive expanded in memory
+(`pkg/gofr/http/multipart_file_bind.go` + `pkg/gofr/file/zip.go` parity).
+"""
+
+import os as _os
+import sys as _sys
+from dataclasses import dataclass, field
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+from gofr_tpu.http.multipart import UploadFile, Zip
+
+
+@dataclass
+class Data:
+    name: str = ""
+    # zip archive under form key "upload", expanded in memory
+    upload: Zip = field(default_factory=Zip)
+    # generic file under form key "a"
+    a: UploadFile | None = None
+
+
+def build_app(config=None) -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+
+    def upload(ctx):
+        d = ctx.bind(Data)
+        return {
+            "name": d.name,
+            "zip_files": sorted(d.upload.files),
+            "zip_bytes": sum(len(v) for v in d.upload.files.values()),
+            "file": None if d.a is None else
+            {"filename": d.a.filename, "size": len(d.a.content)},
+        }
+
+    app.post("/upload", upload)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

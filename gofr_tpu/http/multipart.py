@@ -111,7 +111,7 @@ def bind_multipart(content_type: str, body: bytes, target: typing.Any) -> typing
                 raise BindError(f"missing multipart field {f.name!r}")
             continue
         filename, ptype, data = by_name[f.name]
-        ann = hints.get(f.name, typing.Any)
+        ann = binder.unwrap_optional(hints.get(f.name, typing.Any))
         if ann is UploadFile:
             kwargs[f.name] = UploadFile(filename or f.name, data, ptype)
         elif ann is Zip:

@@ -223,6 +223,14 @@ class Registry:
             m.inc(value, **labels)
             self._warn_cardinality(m)
 
+    def delta_updown_counter(self, name: str, value: float, **labels: str) -> None:
+        """Apply a signed delta to an up-down counter (gofr
+        `DeltaUpDownCounter` parity)."""
+        m = self._metrics.get(name)
+        if isinstance(m, UpDownCounter):
+            m.inc(value, **labels)
+            self._warn_cardinality(m)
+
     def set_gauge(self, name: str, value: float, **labels: str) -> None:
         m = self._metrics.get(name)
         if isinstance(m, Gauge):

@@ -18,6 +18,16 @@ class BindError(Exception):
     status_code = 400
 
 
+def unwrap_optional(annotation: Any) -> Any:
+    """``X | None`` / ``Optional[X]`` → ``X``; anything else unchanged.
+    Shared by the JSON and multipart binders so union handling can't drift."""
+    if typing.get_origin(annotation) in (typing.Union, types.UnionType):
+        args = [a for a in typing.get_args(annotation) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return annotation
+
+
 def bind_value(value: Any, annotation: Any) -> Any:
     """Coerce ``value`` to ``annotation`` (best effort, raises BindError)."""
     if annotation in (None, Any, typing.Any):

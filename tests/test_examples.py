@@ -198,3 +198,171 @@ def test_http_service_example():
             body = r.json()["data"]
             assert body["status"] == 200
             assert body["downstream"]["data"]["sku"] == "tpu-v5e"
+
+
+def test_custom_metrics_example():
+    app = load_example("using-custom-metrics").build_app()
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:
+        assert c.post("/transaction", json={}).status_code == 201
+        assert c.post("/transaction", json={}).status_code == 201
+        assert c.post("/return", json={}).status_code == 201
+        m = httpx.get(f"http://127.0.0.1:{app.metrics_port}/metrics").text
+        assert "transaction_success 2" in m
+        assert 'total_credit_day_sale{sale_type="credit"} 2000' in m
+        assert 'total_credit_day_sale{sale_type="credit_return"} -1000' in m
+        assert "product_stock 50" in m
+        assert "transaction_time_bucket" in m
+
+
+def test_file_bind_example():
+    import io
+    import zipfile
+
+    app = load_example("using-file-bind").build_app()
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("a.txt", "alpha")
+        zf.writestr("dir/b.txt", "beta!")
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:
+        r = c.post("/upload",
+                   data={"name": "bundle"},
+                   files={"upload": ("arch.zip", buf.getvalue(), "application/zip"),
+                          "a": ("notes.md", b"# hi", "text/markdown")})
+        assert r.status_code == 201, r.text
+        data = r.json()["data"]
+        assert data["name"] == "bundle"
+        assert data["zip_files"] == ["a.txt", "dir/b.txt"]
+        assert data["zip_bytes"] == len("alpha") + len("beta!")
+        assert data["file"] == {"filename": "notes.md", "size": 4}
+
+
+def test_grpc_server_example():
+    """Drives the framework gRPC server end to end (interceptor chain,
+    current_grpc_context, panic recovery) — no generated stubs needed."""
+    import json as _json
+
+    import grpc
+
+    mod = load_example("grpc-server")
+    app = mod.build_app()
+    with AppHarness(app):
+        with grpc.insecure_channel(f"127.0.0.1:{app.grpc_port}") as channel:
+            say_hello = channel.unary_unary(
+                f"/{mod.SERVICE}/SayHello",
+                request_serializer=lambda o: _json.dumps(o).encode(),
+                response_deserializer=lambda b: _json.loads(b.decode()),
+            )
+            assert say_hello({"name": "Ada"}, timeout=10) == {"message": "Hello Ada!"}
+
+            boom = channel.unary_unary(
+                f"/{mod.SERVICE}/Boom",
+                request_serializer=lambda o: _json.dumps(o).encode(),
+                response_deserializer=lambda b: _json.loads(b.decode()),
+            )
+            try:
+                boom({}, timeout=10)
+                raise AssertionError("panic was not surfaced as an RPC error")
+            except grpc.RpcError as e:
+                assert e.code() in (grpc.StatusCode.INTERNAL, grpc.StatusCode.UNKNOWN)
+
+            # server survived the panic
+            assert say_hello({"name": "Bob"}, timeout=10) == {"message": "Hello Bob!"}
+
+
+class MiniRedisServer:
+    """A minimal in-process RESP server (SET/GET/DEL/PING/EXPIRE + inline
+    pipelining) so the example's REAL wire-protocol client paths execute —
+    the sandbox stand-in for the reference CI's Redis service container."""
+
+    def __init__(self):
+        import socket
+        import threading
+
+        self.store = {}
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        import threading
+
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._client, args=(conn,), daemon=True).start()
+
+    def _client(self, conn):
+        f = conn.makefile("rwb")
+        try:
+            while True:
+                line = f.readline()
+                if not line:
+                    return
+                if not line.startswith(b"*"):
+                    continue
+                n = int(line[1:].strip())
+                parts = []
+                for _ in range(n):
+                    ln = f.readline()  # $<len>
+                    size = int(ln[1:].strip())
+                    parts.append(f.read(size))
+                    f.read(2)  # trailing CRLF
+                self._dispatch(parts, f)
+                f.flush()
+        except Exception:  # noqa: BLE001 - test server: drop the connection
+            pass
+        finally:
+            conn.close()
+
+    def _dispatch(self, parts, f):
+        cmd = parts[0].upper()
+        if cmd == b"PING":
+            f.write(b"+PONG\r\n")
+        elif cmd == b"SELECT" or cmd == b"AUTH":
+            f.write(b"+OK\r\n")
+        elif cmd == b"SET":
+            self.store[parts[1]] = parts[2]
+            f.write(b"+OK\r\n")
+        elif cmd == b"GET":
+            v = self.store.get(parts[1])
+            if v is None:
+                f.write(b"$-1\r\n")
+            else:
+                f.write(b"$%d\r\n%s\r\n" % (len(v), v))
+        elif cmd == b"DEL":
+            n = sum(1 for k in parts[1:] if self.store.pop(k, None) is not None)
+            f.write(b":%d\r\n" % n)
+        elif cmd == b"EXPIRE":
+            f.write(b":1\r\n")
+        else:
+            f.write(b"-ERR unknown command\r\n")
+
+    def close(self):
+        self._stop = True
+        self._srv.close()
+
+
+def test_redis_example():
+    from gofr_tpu.config import DictConfig
+
+    srv = MiniRedisServer()
+    try:
+        config = DictConfig({
+            "APP_NAME": "http-server-using-redis",
+            "HTTP_PORT": "8818", "METRICS_PORT": "2818",
+            "REDIS_HOST": "127.0.0.1", "REDIS_PORT": str(srv.port),
+        })
+        app = load_example("http-server-using-redis").build_app(config)
+        with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:
+            assert c.post("/redis", json={"greeting": "hello"}).status_code == 201
+            assert c.get("/redis/greeting").json()["data"] == "hello"
+            assert c.get("/redis/absent").status_code == 404
+            assert c.get("/redis-pipeline").json()["data"] == ["OK", "pipe-value"]
+            health = c.get("/.well-known/health").json()["data"]
+            assert health["services"]["redis"]["status"] == "UP"
+    finally:
+        srv.close()
