@@ -36,6 +36,15 @@ class HelloServicer:
     def Boom(self, request: dict, context) -> dict:
         raise RuntimeError("intentional panic — recovered by the interceptor")
 
+    def Countdown(self, request: dict, context):
+        """Server-streaming RPC — also wrapped by the interceptor chain
+        (unlike the reference, which intercepts only unary RPCs)."""
+        n = int(request.get("from", 3))
+        if n > 100:
+            raise ValueError("countdown too long")
+        for i in range(n, 0, -1):
+            yield {"tick": i}
+
 
 def add_hello_to_server(servicer: HelloServicer, server: grpc.Server) -> None:
     """Hand-rolled equivalent of a generated ``add_*_to_server``."""
@@ -47,6 +56,11 @@ def add_hello_to_server(servicer: HelloServicer, server: grpc.Server) -> None:
         ),
         "Boom": grpc.unary_unary_rpc_method_handler(
             servicer.Boom,
+            request_deserializer=lambda b: json.loads(b.decode() or "{}"),
+            response_serializer=lambda o: json.dumps(o).encode(),
+        ),
+        "Countdown": grpc.unary_stream_rpc_method_handler(
+            servicer.Countdown,
             request_deserializer=lambda b: json.loads(b.decode() or "{}"),
             response_serializer=lambda o: json.dumps(o).encode(),
         ),

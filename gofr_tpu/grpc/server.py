@@ -27,45 +27,87 @@ def current_grpc_context() -> Context | None:
 
 
 class RPCLog:
-    def __init__(self, method: str, status_code: int, duration_us: int, trace_id: str):
+    def __init__(self, method: str, status_code: int, duration_us: int, trace_id: str,
+                 messages: int | None = None):
         self.method = method
         self.status_code = status_code
         self.duration_us = duration_us
         self.trace_id = trace_id
+        self.messages = messages  # response count for streaming RPCs
 
     def to_log_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "message": "rpc",
             "method": self.method,
             "status_code": self.status_code,
             "duration_us": self.duration_us,
             "trace_id": self.trace_id,
         }
+        if self.messages is not None:
+            out["messages"] = self.messages
+        return out
 
     def pretty_print(self, w) -> None:
-        w.write(f"  RPC {self.method} status={self.status_code} {self.duration_us}µs\n")
+        extra = f" msgs={self.messages}" if self.messages is not None else ""
+        w.write(f"  RPC {self.method} status={self.status_code} {self.duration_us}µs{extra}\n")
 
 
 class GofrGrpcInterceptor(grpc.ServerInterceptor):
+    """Recovery + span + RPCLog for ALL four RPC kinds — the reference
+    intercepts only unary calls (`grpc.go:24`); here streaming RPCs get the
+    same treatment so a streaming handler crash becomes INTERNAL with a
+    logged span instead of a bare connection reset."""
+
     def __init__(self, container):
         self._container = container
 
     def intercept_service(self, continuation, handler_call_details):
         handler = continuation(handler_call_details)
-        if handler is None or not handler.unary_unary:
+        if handler is None:
             return handler
-        container = self._container
         method = handler_call_details.method
         metadata = dict(handler_call_details.invocation_metadata or ())
-        inner = handler.unary_unary
+
+        dispatch = (
+            ("unary_unary", self._wrap_unary, grpc.unary_unary_rpc_method_handler),
+            ("unary_stream", self._wrap_stream, grpc.unary_stream_rpc_method_handler),
+            ("stream_unary", self._wrap_unary, grpc.stream_unary_rpc_method_handler),
+            ("stream_stream", self._wrap_stream, grpc.stream_stream_rpc_method_handler),
+        )
+        for attr, wrap, factory in dispatch:
+            inner = getattr(handler, attr)
+            if inner:
+                return factory(
+                    wrap(inner, method, metadata),
+                    request_deserializer=handler.request_deserializer,
+                    response_serializer=handler.response_serializer,
+                )
+        return handler
+
+    def _begin(self, request, method: str, metadata: dict[str, str]):
+        container = self._container
+        span = container.tracer.start_span(
+            f"grpc {method}", traceparent=metadata.get("traceparent"), kind="SERVER",
+            set_current=False,
+        )
+        ctx = Context(_GRPCRequestAdapter(request, metadata), container, span=span)
+        token = _grpc_ctx.set(ctx)
+        return span, token
+
+    def _end(self, span, token, method: str, status: int, start: float,
+             messages: int | None = None) -> None:
+        _grpc_ctx.reset(token)
+        span.finish()
+        self._container.logger.info(
+            RPCLog(method, status, int((time.perf_counter() - start) * 1e6),
+                   span.trace_id, messages=messages)
+        )
+
+    def _wrap_unary(self, inner, method: str, metadata: dict[str, str]):
+        container = self._container
 
         def wrapped(request, servicer_context):
-            span = container.tracer.start_span(
-                f"grpc {method}", traceparent=metadata.get("traceparent"), kind="SERVER",
-                set_current=False,
-            )
-            ctx = Context(_GRPCRequestAdapter(request, metadata), container, span=span)
-            token = _grpc_ctx.set(ctx)
+            span, token = self._begin(request, method, metadata)
             start = time.perf_counter()
             status = 0
             try:
@@ -76,17 +118,37 @@ class GofrGrpcInterceptor(grpc.ServerInterceptor):
                 container.logger.log_exception(e, f"grpc handler {method}")
                 servicer_context.abort(grpc.StatusCode.INTERNAL, "internal error")
             finally:
-                _grpc_ctx.reset(token)
-                span.finish()
-                container.logger.info(
-                    RPCLog(method, status, int((time.perf_counter() - start) * 1e6), span.trace_id)
-                )
+                self._end(span, token, method, status, start)
 
-        return grpc.unary_unary_rpc_method_handler(
-            wrapped,
-            request_deserializer=handler.request_deserializer,
-            response_serializer=handler.response_serializer,
-        )
+        return wrapped
+
+    def _wrap_stream(self, inner, method: str, metadata: dict[str, str]):
+        container = self._container
+
+        def wrapped(request, servicer_context):
+            span, token = self._begin(request, method, metadata)
+            start = time.perf_counter()
+            status = 0
+            sent = 0
+            try:
+                for item in inner(request, servicer_context):
+                    sent += 1
+                    yield item
+            except GeneratorExit:
+                # client cancelled mid-stream — log it as CANCELLED, not OK,
+                # so cancellation storms are visible in logs/traces
+                status = 1  # grpc CANCELLED
+                span.set_status("CANCELLED")
+                raise
+            except Exception as e:  # noqa: BLE001 - panic recovery → INTERNAL
+                status = 13
+                span.set_status("ERROR")
+                container.logger.log_exception(e, f"grpc stream handler {method}")
+                servicer_context.abort(grpc.StatusCode.INTERNAL, "internal error")
+            finally:
+                self._end(span, token, method, status, start, messages=sent)
+
+        return wrapped
 
 
 class _GRPCRequestAdapter:
@@ -105,10 +167,22 @@ class _GRPCRequestAdapter:
         return [v] if v else []
 
     def path_param(self, key: str) -> str:
-        return ""
+        # gRPC has no path; metadata is the closest analog, so handlers
+        # written against the HTTP Context shape still resolve something
+        return str(self.metadata.get(key, ""))
 
     def bind(self, target: Any = None) -> Any:
-        return self.message
+        """No target → the raw message (protobuf or decoded JSON); a
+        JSON-shaped message coerces through the SAME binder as the HTTP
+        path (`http/request.py:95`), so dataclass/annotated-class targets
+        behave identically across transports."""
+        if target is None:
+            return self.message
+        if isinstance(self.message, (dict, list, str, int, float, bool)):
+            from gofr_tpu.utils import bind as binder
+
+            return binder.bind(self.message, target)
+        return self.message  # protobuf message: handler works with it directly
 
     def host_name(self) -> str:
         return "grpc"

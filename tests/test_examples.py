@@ -268,6 +268,24 @@ def test_grpc_server_example():
             # server survived the panic
             assert say_hello({"name": "Bob"}, timeout=10) == {"message": "Hello Bob!"}
 
+            # server-streaming RPC through the interceptor
+            countdown = channel.unary_stream(
+                f"/{mod.SERVICE}/Countdown",
+                request_serializer=lambda o: _json.dumps(o).encode(),
+                response_deserializer=lambda b: _json.loads(b.decode()),
+            )
+            ticks = [m["tick"] for m in countdown({"from": 3}, timeout=10)]
+            assert ticks == [3, 2, 1]
+
+            # streaming handler crash → INTERNAL, not a connection reset
+            try:
+                list(countdown({"from": 1000}, timeout=10))
+                raise AssertionError("stream error was not surfaced")
+            except grpc.RpcError as e:
+                assert e.code() in (grpc.StatusCode.INTERNAL, grpc.StatusCode.UNKNOWN)
+            # and the server still serves
+            assert say_hello({"name": "Eve"}, timeout=10) == {"message": "Hello Eve!"}
+
 
 class MiniRedisServer:
     """A minimal in-process RESP server (SET/GET/DEL/PING/EXPIRE + inline
