@@ -96,7 +96,13 @@ class GofrGrpcInterceptor(grpc.ServerInterceptor):
 
     def _end(self, span, token, method: str, status: int, start: float,
              messages: int | None = None) -> None:
-        _grpc_ctx.reset(token)
+        try:
+            _grpc_ctx.reset(token)
+        except ValueError:
+            # a cancelled stream generator can be finalized by the GC on a
+            # different thread; the token belongs to the serving thread's
+            # context then. The span/log below must still run.
+            pass
         span.finish()
         self._container.logger.info(
             RPCLog(method, status, int((time.perf_counter() - start) * 1e6),

@@ -30,6 +30,7 @@ from jax import lax
 from gofr_tpu.models.base import fan_in_init, truncated_normal
 from gofr_tpu.ops import apply_rope, mha_attention, rms_norm, rope_table
 from gofr_tpu.ops.attention import decode_attention, paged_decode_attention
+from gofr_tpu.ops.quant import qdot
 from gofr_tpu.ops.kvcache import SlotKVCache, append_tokens, write_prompts
 from gofr_tpu.ops.paged import PagedKVCache, append_tokens_paged, gather_kv, write_prompts_paged
 
@@ -116,6 +117,10 @@ def init(cfg: LlamaConfig, key: jax.Array) -> dict:
     return params
 
 
+# every linear site routes through ops.quant.qdot, so QTensor params serve
+QUANTIZABLE = True
+
+
 def param_axes(cfg: LlamaConfig) -> dict:
     """Logical sharding axes matching ``init``'s pytree (see
     gofr_tpu.parallel.sharding)."""
@@ -150,16 +155,16 @@ def _qkv(cfg: LlamaConfig, lp: dict, x: jnp.ndarray):
     """x [B,S,E] → q [B,S,Hq,D], k/v [B,S,Hkv,D] (post-norm, pre-rope)."""
     b, s, _ = x.shape
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_size)
-    k = (h @ lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_size)
-    v = (h @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_size)
+    q = qdot(h, lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_size)
+    k = qdot(h, lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_size)
+    v = qdot(h, lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_size)
     return q, k, v
 
 
 def _mlp(cfg: LlamaConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
-    return gated @ lp["w_down"]
+    gated = jax.nn.silu(qdot(h, lp["w_gate"])) * qdot(h, lp["w_up"])
+    return qdot(gated, lp["w_down"])
 
 
 # -- entry points --------------------------------------------------------------
@@ -186,14 +191,14 @@ def forward(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
         a = attn(q, k, v, causal=True, kv_lengths=lengths)
-        x = x + a.reshape(b, s, -1) @ lp["wo"]
+        x = x + qdot(a.reshape(b, s, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
         return x, None
 
     x, _ = lax.scan(body, x, params["blocks"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return (x @ head).astype(jnp.float32)
+    return qdot(x, head).astype(jnp.float32)
 
 
 @partial(jax.jit, static_argnums=(0, 4, 5))
@@ -255,7 +260,7 @@ def forward_pipelined(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
     x = pp_forward(stage, params["blocks"], x, lengths)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return (x @ head).astype(jnp.float32)
+    return qdot(x, head).astype(jnp.float32)
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
@@ -280,7 +285,7 @@ def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.nd
         k = apply_rope(k, positions, cos, sin)
         k_layer, v_layer = write_prompts(k_layer, v_layer, slots, k, v)
         attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
-        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        x = x + qdot(attn.reshape(b, s, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
         return x, (k_layer, v_layer)
 
@@ -288,7 +293,7 @@ def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.nd
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last = x[row, lengths - 1]  # [B,E]
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (last @ head).astype(jnp.float32)
+    logits = qdot(last, head).astype(jnp.float32)
     return logits, SlotKVCache(k=new_k, v=new_v)
 
 
@@ -316,14 +321,14 @@ def decode_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, positions: 
         v = v[:, 0]
         k_layer, v_layer = append_tokens(k_layer, v_layer, positions, k, v)
         attn = decode_attention(q, k_layer, v_layer, positions + 1)
-        x = x + attn.reshape(n, -1) @ lp["wo"]
+        x = x + qdot(attn.reshape(n, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
         return x, (k_layer, v_layer)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head).astype(jnp.float32)
+    logits = qdot(x, head).astype(jnp.float32)
     return logits, SlotKVCache(k=new_k, v=new_v)
 
 
@@ -395,7 +400,7 @@ def prefill_paged(
         else:
             k_layer, v_layer = write_prompts_paged(k_layer, v_layer, pages, k, v)
             attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
-        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        x = x + qdot(attn.reshape(b, s, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
         return x, (k_layer, v_layer)
 
@@ -403,7 +408,7 @@ def prefill_paged(
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last = x[row, lengths - 1]  # [B,E]
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (last @ head).astype(jnp.float32)
+    logits = qdot(last, head).astype(jnp.float32)
     return logits, PagedKVCache(k=new_k, v=new_v)
 
 
@@ -427,12 +432,12 @@ def decode_step_paged(
         v = v[:, 0]
         k_layer, v_layer = append_tokens_paged(k_layer, v_layer, table, positions, k, v)
         attn = paged_decode_attention(q, k_layer, v_layer, table, positions + 1)
-        x = x + attn.reshape(n, -1) @ lp["wo"]
+        x = x + qdot(attn.reshape(n, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
         return x, (k_layer, v_layer)
 
     x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head).astype(jnp.float32)
+    logits = qdot(x, head).astype(jnp.float32)
     return logits, PagedKVCache(k=new_k, v=new_v)

@@ -96,11 +96,17 @@ def append_tokens(
     v_new: jnp.ndarray,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Append one token's K/V per slot: k_new [B, Hkv, D] written at
-    ``positions`` [B] in each slot's sequence dimension."""
-    b, hkv, _ = k_new.shape
-    rows = jnp.arange(b)[:, None]
-    heads = jnp.arange(hkv)[None, :]
-    pos = positions[:, None]
-    k_layer = k_layer.at[rows, heads, pos].set(k_new.astype(k_layer.dtype))
-    v_layer = v_layer.at[rows, heads, pos].set(v_new.astype(v_layer.dtype))
+    ``positions`` [B] in each slot's sequence dimension.
+
+    Implemented as a masked full-buffer select, NOT a scatter. Measured on
+    TPU v5e (round 3, 1B llama decode chunk, 64 slots): XLA lowers the
+    advanced-indexing scatter inside the decode scan to something that
+    scales with Smax and dominates the step — 6429 tok/s (scatter) vs 8893
+    (select) at Smax=256, 2123 vs 4074 at Smax=1024. The select rewrites
+    the whole layer buffer but fuses into one bandwidth-shaped pass, which
+    the scatter evidently also pays (a non-aliased copy) without the fusion."""
+    smax = k_layer.shape[2]
+    mask = (positions[:, None] == jnp.arange(smax)[None, :])[:, None, :, None]
+    k_layer = jnp.where(mask, k_new.astype(k_layer.dtype)[:, :, None, :], k_layer)
+    v_layer = jnp.where(mask, v_new.astype(v_layer.dtype)[:, :, None, :], v_layer)
     return k_layer, v_layer

@@ -27,6 +27,7 @@ padding rows (engine._admit docstring).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import jax
@@ -97,11 +98,38 @@ def append_tokens_paged(
     k_new: jnp.ndarray,     # [N, Hkv, D]
     v_new: jnp.ndarray,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Append one token's K/V per slot at its current logical position."""
-    n, hkv, _ = k_new.shape
-    page = k_layer.shape[2]
+    """Append one token's K/V per slot at its current logical position.
+
+    Two lowerings, chosen by ``GOFR_PAGED_KV_WRITE`` (default ``select``;
+    anything else means ``scatter``): ``select`` rebuilds the pool through
+    a one-hot einsum + masked select — the same trick that beat XLA's
+    scatter ~1.4-2x for the slot cache on v5e (ops/kvcache.append_tokens) —
+    while ``scatter`` keeps the advanced-indexing scatter (cheaper
+    asymptotically for very large pools, where the one-hot matmul and
+    full-pool rewrite start to dominate). NOTE: the env var is read at
+    TRACE time and jit caches traces process-globally, so the choice is
+    effectively FIXED FOR THE LIFE OF THE PROCESS — A/B the two lowerings
+    across separate processes, not by flipping the var between engine
+    builds. OOB semantics are
+    preserved either way: OOB rows' flat position falls outside the one-hot
+    range, producing an all-false mask row (the scatter path relies on XLA
+    dropping OOB updates)."""
+    n, hkv, d = k_new.shape
+    p_total, _, page, _ = k_layer.shape
     pp = jnp.take_along_axis(table, (positions // page)[:, None], axis=1)[:, 0]  # [N]
     off = positions % page
+
+    if os.environ.get("GOFR_PAGED_KV_WRITE", "select") == "select":
+        flat = pp * page + off  # [N]; OOB rows land >= p_total*page
+        grid = jnp.arange(p_total * page)
+        m = flat[:, None] == grid[None, :]  # [N, P*page]
+        any_m = m.reshape(n, p_total, page).any(axis=0)[:, None, :, None]
+        def fold(new, layer):
+            upd = jnp.einsum("np,nhd->phd", m.astype(layer.dtype), new.astype(layer.dtype))
+            upd = upd.reshape(p_total, page, hkv, d).transpose(0, 2, 1, 3)
+            return jnp.where(any_m, upd, layer)
+        return fold(k_new, k_layer), fold(v_new, v_layer)
+
     rows = pp[:, None]
     heads = jnp.arange(hkv)[None, :]
     k_layer = k_layer.at[rows, heads, off[:, None]].set(k_new.astype(k_layer.dtype))

@@ -1,0 +1,112 @@
+"""Weight-only int8 quantization (ops/quant.py): numerics stay close to the
+fp reference, the QTensor pytree flows through jit/donation, and the engine
+serves a quantized model end to end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import LlamaConfig, ModelSpec, llama
+from gofr_tpu.ops.quant import QTensor, qdot, quantize, quantize_tree
+from gofr_tpu.tpu.engine import build_engine
+
+
+def test_quantize_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.key(0), (128, 64), jnp.float32)
+    qt = quantize(w)
+    assert qt.q.dtype == jnp.int8 and qt.s.shape == (1, 64)
+    deq = qt.q.astype(jnp.float32) * qt.s
+    # symmetric per-channel int8: error <= scale/2 per element
+    assert float(jnp.max(jnp.abs(deq - w) / jnp.squeeze(qt.s))) <= 0.5 + 1e-6
+
+
+def test_qdot_matches_dense_within_quant_error():
+    key = jax.random.key(1)
+    x = jax.random.normal(key, (4, 128), jnp.float32)
+    w = jax.random.normal(jax.random.key(2), (128, 64), jnp.float32)
+    dense = x @ w
+    quant_out = qdot(x, quantize(w))
+    rel = float(jnp.max(jnp.abs(quant_out - dense)) / jnp.max(jnp.abs(dense)))
+    assert rel < 0.05, rel
+    # plain arrays pass through untouched
+    assert jnp.allclose(qdot(x, w), dense)
+
+
+def test_quantized_forward_mostly_agrees():
+    cfg = LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(7))
+    qparams = quantize_tree(params)
+    # stacked [L, in, out] block weights became QTensors; norms didn't
+    assert isinstance(qparams["blocks"]["wq"], QTensor)
+    assert not isinstance(qparams["blocks"]["attn_norm"], QTensor)
+
+    tokens = jax.random.randint(jax.random.key(3), (2, 24), 1, cfg.vocab_size)
+    dense = llama.forward(cfg, params, tokens)
+    quant_logits = llama.forward(cfg, qparams, tokens)
+    agree = float(jnp.mean(
+        (jnp.argmax(dense, -1) == jnp.argmax(quant_logits, -1)).astype(jnp.float32)
+    ))
+    assert agree >= 0.8, f"top-1 agreement {agree} too low for weight-only int8"
+
+
+def test_engine_serves_quantized_model():
+    cfg = LlamaConfig.tiny()
+    container = new_mock_container()
+    spec = ModelSpec(family="llama", task="generate", config=cfg)
+    eng = build_engine(spec, container, seed=7, slots=2, max_len=48,
+                       max_prefill_batch=2, quantize="int8")
+    try:
+        assert isinstance(eng.params["blocks"]["wq"], QTensor)
+        out = eng.generate([5, 3, 9], max_new_tokens=6, timeout=120)
+        assert len(out["tokens"]) == 6
+        assert all(0 <= t < cfg.vocab_size for t in out["tokens"])
+    finally:
+        eng.stop()
+
+
+def test_engine_serves_quantized_model_on_mesh():
+    """QTensor params flow through mesh sharding (quantize runs AFTER
+    shard_pytree and inherits shardings from the computation)."""
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=160,
+        num_layers=2, num_heads=8, num_kv_heads=4, max_seq_len=128,
+        dtype=jnp.float32,
+    )
+    container = new_mock_container({"TPU_MESH": "dp:2,tp:4"})
+    spec = ModelSpec(family="llama", task="generate", config=cfg)
+    eng = build_engine(spec, container, seed=3, slots=2, max_len=48,
+                       max_prefill_batch=2, quantize="int8")
+    try:
+        out = eng.generate([5, 3, 9], max_new_tokens=5, timeout=300)
+        assert len(out["tokens"]) == 5
+    finally:
+        eng.stop()
+
+
+def test_unknown_quantize_mode_rejected():
+    cfg = LlamaConfig.tiny()
+    spec = ModelSpec(family="llama", task="generate", config=cfg)
+    with pytest.raises(ValueError, match="int8"):
+        build_engine(spec, new_mock_container(), seed=0, quantize="fp4")
+
+
+def test_unquantizable_family_explicit_request_errors_config_warns():
+    from gofr_tpu.models import BertConfig
+
+    spec = ModelSpec(family="bert", task="embed", config=BertConfig.tiny())
+    # explicit per-model request: hard error
+    with pytest.raises(ValueError, match="does not support"):
+        build_engine(spec, new_mock_container(), quantize="int8")
+    # process-wide config: warn and serve unquantized (the env may target a
+    # different engine in the same app)
+    container = new_mock_container({"ENGINE_QUANTIZE": "int8"})
+    eng = build_engine(spec, container)
+    try:
+        out = eng.infer([1, 2, 3, 4], timeout=120)
+        assert np.asarray(out).ndim >= 1
+        assert any("ENGINE_QUANTIZE=int8 ignored" in r.get("message", "")
+                   for r in container.logger.records), "no warning logged"
+    finally:
+        eng.stop()
