@@ -15,16 +15,21 @@ timeout, retry once, and on failure pin the CPU backend and run a scaled
 preset — the JSON line always appears, with the platform reported honestly.
 
 Env knobs:
-    GOFR_BENCH_PRESET        one_b (default on TPU) | tiny (default on CPU fallback)
-    GOFR_BENCH_REQUESTS      total requests (default 64 TPU / 8 CPU)
-    GOFR_BENCH_SLOTS         decode slots (default 16)
-    GOFR_BENCH_CHUNK         decode chunk (default 8)
-    GOFR_BENCH_PROMPT        prompt length (default 64)
-    GOFR_BENCH_NEW           generated tokens per request (default 64)
-    GOFR_BENCH_PLATFORM      force 'cpu' or 'tpu' (skips the probe)
-    GOFR_BENCH_PROBE_S       TPU init probe timeout seconds (default 240)
-    GOFR_BENCH_SWEEP         1 = sweep slots x decode_chunk, keep best
-    GOFR_TPU_PEAK_TFLOPS     override bf16 peak for MFU (default by device kind)
+    GOFR_BENCH_PRESET         one_b (default on TPU) | tiny (default on CPU fallback)
+    GOFR_BENCH_REQUESTS       total requests (default 512 TPU / 8 CPU)
+    GOFR_BENCH_SLOTS          decode slots (default 128 TPU / 16 CPU)
+    GOFR_BENCH_CHUNK          decode chunk (default 32 TPU / 8 CPU)
+    GOFR_BENCH_PREFILL_BATCH  max prompts per prefill call (default 128 TPU / 4 CPU)
+    GOFR_BENCH_QUANTIZE       'int8' (TPU default) | '' = bf16
+    GOFR_BENCH_PROMPT         prompt length (default 64)
+    GOFR_BENCH_NEW            generated tokens per request (default 64)
+    GOFR_BENCH_PLATFORM       force 'cpu' or 'tpu' (skips the probe)
+    GOFR_BENCH_PROBE_S        TPU init probe timeout seconds (default 240)
+    GOFR_BENCH_SWEEP          1 = sweep slots x decode_chunk, keep best
+    GOFR_BENCH_PALLAS_AB      1 = record kernel-on/off engine A/B
+    GOFR_BENCH_DEBUG          1 = per-phase device-call accounting in extra
+    GOFR_TPU_PEAK_TFLOPS      override bf16 peak for MFU (default by device kind)
+    GOFR_TPU_PEAK_GBS         override HBM GB/s for MBU (default by device kind)
 """
 
 from __future__ import annotations
@@ -33,7 +38,6 @@ import json
 import os
 import subprocess
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -148,18 +152,16 @@ def _run_once(engine_kw: dict, cfg, params, container, family, prompts,
         results: list[dict | None] = [None] * len(prompts)
         errors: list[Exception] = []
 
-        def worker(i: int) -> None:
+        # futures submission (engine.submit): all requests in flight from one
+        # thread — the shape the asyncio transports use, and it keeps N
+        # client threads from fighting the device thread for the GIL
+        t0 = time.monotonic()
+        reqs = [engine.submit(p, max_new_tokens=max_new, timeout=timeout) for p in prompts]
+        for i, r in enumerate(reqs):
             try:
-                results[i] = engine.generate(prompts[i], max_new_tokens=max_new, timeout=timeout)
+                results[i] = r.result(timeout)
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
-
-        t0 = time.monotonic()
-        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
         elapsed = time.monotonic() - t0
     finally:
         engine.stop()
@@ -168,11 +170,24 @@ def _run_once(engine_kw: dict, cfg, params, container, family, prompts,
         raise RuntimeError(f"bench requests failed: {errors[:1]} "
                            f"({sum(r is None for r in results)} incomplete)")
     new_tokens = int(np.sum([len(r["tokens"]) for r in results]))
-    return {
+    out = {
         "elapsed": elapsed,
         "new_tokens": new_tokens,
         "ttfts": [r["ttft_s"] for r in results],
     }
+    if os.environ.get("GOFR_BENCH_DEBUG") == "1":
+        # device-call accounting from the engine's own histograms: how much
+        # of the wall clock the device steps explain vs host/RTT overhead
+        steps = engine.metrics.get("app_tpu_step_seconds")
+        if steps is not None:
+            phases = {}
+            for kind in ("prefill", "prefill_chunk", "decode"):
+                calls = steps.count(kind=kind)
+                if calls:
+                    phases[kind] = {"calls": calls, "seconds": round(steps.sum(kind=kind), 3)}
+            out["phases"] = phases
+            out["device_seconds"] = round(sum(p["seconds"] for p in phases.values()), 3)
+    return out
 
 
 def main() -> None:
@@ -195,13 +210,14 @@ def main() -> None:
 
     on_cpu = platform == "cpu"
     preset = os.environ.get("GOFR_BENCH_PRESET", "tiny" if on_cpu else "one_b")
-    n_requests = int(os.environ.get("GOFR_BENCH_REQUESTS", "8" if on_cpu else "256"))
+    n_requests = int(os.environ.get("GOFR_BENCH_REQUESTS", "8" if on_cpu else "512"))
     # Round-3 TPU lesson (diag: 100ms tunnel RTT per host sync, ~3ms/step
     # device compute): throughput is won by amortizing round trips — large
-    # decode chunks, wide prefill batches, many slots.
-    slots = int(os.environ.get("GOFR_BENCH_SLOTS", "16" if on_cpu else "32"))
+    # decode chunks, wide prefill batches, many slots. Defaults are the
+    # measured round-3 grid winner (143.7 req/s, vs_baseline 1.15).
+    slots = int(os.environ.get("GOFR_BENCH_SLOTS", "16" if on_cpu else "128"))
     decode_chunk = int(os.environ.get("GOFR_BENCH_CHUNK", "8" if on_cpu else "32"))
-    prefill_batch = int(os.environ.get("GOFR_BENCH_PREFILL_BATCH", "4" if on_cpu else "16"))
+    prefill_batch = int(os.environ.get("GOFR_BENCH_PREFILL_BATCH", "4" if on_cpu else "128"))
     prompt_len = int(os.environ.get("GOFR_BENCH_PROMPT", "64"))
     max_new = int(os.environ.get("GOFR_BENCH_NEW", "16" if on_cpu else "64"))
     timeout = 600.0 if on_cpu else 1200.0
@@ -307,6 +323,9 @@ def main() -> None:
         "ttft_p50_s": round(_percentile(m["ttfts"], 50), 4),
         "ttft_p99_s": round(_percentile(m["ttfts"], 99), 4),
     }
+    if "phases" in m:
+        extra["phases"] = m["phases"]
+        extra["device_seconds"] = m["device_seconds"]
     if sweep_log:
         extra["sweep"] = sweep_log
 

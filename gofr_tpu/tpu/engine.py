@@ -544,26 +544,64 @@ class GenerateEngine(_EngineBase):
         self._step_count = 0
 
         ts = (top_k, top_p)
+        W = self.pages_per_slot if kv_layout == "paged" else 1
+
+        # Every step ships its host inputs as ONE packed int32 array (floats
+        # bitcast, RNG step folded in on device from the resident base key).
+        # Over a tunneled device each separate H2D transfer and out-of-jit
+        # RNG op costs a round trip (~70ms measured on the round-3 tunnel);
+        # packing turns 4-6 of them into one.
+        #
+        # Prefill packed layout [nb, lb + W + 3] (W = 1 slot-id column for
+        # the slot layout, pages_per_slot block-table columns for paged):
+        #   [:, :lb] tokens | [:, lb] lengths | [:, lb+1:lb+1+W] rows
+        #   | [:, lb+1+W] temps (f32 bitcast) | [0, lb+2+W] rng step
+        # Chunked-prefill adds an offsets column before temps.
+        # Decode packed layout [3 + W_t, n] (W_t = pages_per_slot table rows
+        # for paged, 0 for slot):
+        #   [0] tokens | [1] positions | [2] temps | [3 0] rng step | [4:] table.T
+
+        def _unpack_prefill(packed, w, chunked=False):
+            extra = 1 if chunked else 0
+            lb = packed.shape[1] - (w + 3 + extra)
+            tokens = packed[:, :lb]
+            lengths = packed[:, lb]
+            rows = packed[:, lb + 1:lb + 1 + w]
+            offsets = packed[:, lb + 1 + w] if chunked else None
+            temps = jax.lax.bitcast_convert_type(
+                packed[:, lb + 1 + w + extra], jnp.float32)
+            step = packed[0, lb + 2 + w + extra]
+            return tokens, lengths, rows, offsets, temps, step
 
         if kv_layout == "paged":
-            @partial(jax.jit, donate_argnums=(3,))
-            def _prefill_sample(params, tokens, lengths, cache, pages, key, temps):
-                logits, cache = family.prefill_paged(cfg, params, tokens, lengths, cache, pages)
+            @partial(jax.jit, donate_argnums=(2,))
+            def _prefill_sample(params, base_key, cache, packed):
+                tokens, lengths, rows, _, temps, step = _unpack_prefill(packed, W)
+                key = jax.random.fold_in(base_key, step)
+                logits, cache = family.prefill_paged(cfg, params, tokens, lengths, cache, rows)
                 toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
                 return toks, cache
 
-            @partial(jax.jit, donate_argnums=(3,))
-            def _chunk_prefill(params, tokens, lengths, cache, pages, offsets, key, temps):
+            @partial(jax.jit, donate_argnums=(2,))
+            def _chunk_prefill(params, base_key, cache, packed):
+                tokens, lengths, rows, offsets, temps, step = _unpack_prefill(
+                    packed, W, chunked=True)
+                key = jax.random.fold_in(base_key, step)
                 logits, cache = family.prefill_paged(
-                    cfg, params, tokens, lengths, cache, pages, offsets
+                    cfg, params, tokens, lengths, cache, rows, offsets
                 )
                 toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
                 return toks, cache
 
             self._chunk_prefill = _chunk_prefill
 
-            @partial(jax.jit, static_argnums=(6,), donate_argnums=(3,))
-            def _decode_chunk(params, tokens, positions, cache, key, temps, steps, table):
+            @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
+            def _decode_chunk(params, base_key, cache, steps, packed):
+                tokens, positions = packed[0], packed[1]
+                temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
+                key = jax.random.fold_in(base_key, packed[3, 0])
+                table = packed[4:].T
+
                 def body(carry, _):
                     toks, pos, cache, key = carry
                     logits, cache = family.decode_step_paged(cfg, params, toks, pos, cache, table)
@@ -576,14 +614,20 @@ class GenerateEngine(_EngineBase):
                 )
                 return out.T, cache  # [slots, K]
         else:
-            @partial(jax.jit, donate_argnums=(3,))
-            def _prefill_sample(params, tokens, lengths, cache, slot_ids, key, temps):
-                logits, cache = family.prefill(cfg, params, tokens, lengths, cache, slot_ids)
+            @partial(jax.jit, donate_argnums=(2,))
+            def _prefill_sample(params, base_key, cache, packed):
+                tokens, lengths, rows, _, temps, step = _unpack_prefill(packed, W)
+                key = jax.random.fold_in(base_key, step)
+                logits, cache = family.prefill(cfg, params, tokens, lengths, cache, rows[:, 0])
                 toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
                 return toks, cache
 
-            @partial(jax.jit, static_argnums=(6,), donate_argnums=(3,))
-            def _decode_chunk(params, tokens, positions, cache, key, temps, steps):
+            @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
+            def _decode_chunk(params, base_key, cache, steps, packed):
+                tokens, positions = packed[0], packed[1]
+                temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
+                key = jax.random.fold_in(base_key, packed[3, 0])
+
                 def body(carry, _):
                     toks, pos, cache, key = carry
                     logits, cache = family.decode_step(cfg, params, toks, pos, cache)
@@ -615,27 +659,24 @@ class GenerateEngine(_EngineBase):
 
         lbs = sorted(len_buckets) if len_buckets else self.prefill_buckets
         bbs = sorted(batch_buckets) if batch_buckets else _pow2_buckets(1, self.max_prefill_batch)
-        key = jax.random.key(0)
-        count = 0
         # same platform pin as the device thread (_run): without it, warmup
         # traces on the caller thread could resolve kernels for the wrong
         # backend (e.g. Pallas for a CPU test mesh under an attached TPU),
         # and jit would cache that mis-resolved program per shape
         with platform_hint(getattr(self.tpu, "platform", None)):
-            return self._warmup_traced(lbs, bbs, key, count)
+            return self._warmup_traced(lbs, bbs)
 
-    def _warmup_traced(self, lbs: list[int], bbs: list[int], key, count: int) -> int:
+    def _warmup_traced(self, lbs: list[int], bbs: list[int]) -> int:
+        count = 0
+        w = self.pages_per_slot if self.kv_layout == "paged" else 1
+        oob = self.total_pages if self.kv_layout == "paged" else self.num_slots
         for lb in lbs:
             for nb in bbs:
-                tokens = jnp.zeros((nb, lb), jnp.int32)
-                lengths = jnp.ones((nb,), jnp.int32)
-                temps = jnp.zeros((nb,), jnp.float32)
-                if self.kv_layout == "paged":
-                    rows = jnp.full((nb, self.pages_per_slot), self.total_pages, jnp.int32)
-                else:
-                    rows = jnp.full((nb,), self.num_slots, jnp.int32)
+                packed = np.zeros((nb, lb + w + 3), np.int32)
+                packed[:, lb] = 1  # lengths
+                packed[:, lb + 1:lb + 1 + w] = oob  # all-OOB rows: writes dropped
                 toks, self.cache = self._prefill_sample(
-                    self.params, tokens, lengths, self.cache, rows, key, temps
+                    self.params, self._base_key, self.cache, jnp.asarray(packed)
                 )
                 jax.block_until_ready(toks)
                 self._compiled.add(("prefill", lb, nb))
@@ -643,31 +684,42 @@ class GenerateEngine(_EngineBase):
         if self.kv_layout == "paged":
             # chunked-prefill programs (batch 1, one per len bucket)
             for lb in lbs:
-                rows = jnp.full((1, self.pages_per_slot), self.total_pages, jnp.int32)
+                packed = np.zeros((1, lb + w + 4), np.int32)
+                packed[0, lb] = 1
+                packed[0, lb + 1:lb + 1 + w] = oob
                 toks, self.cache = self._chunk_prefill(
-                    self.params, jnp.zeros((1, lb), jnp.int32), jnp.ones((1,), jnp.int32),
-                    self.cache, rows, jnp.zeros((1,), jnp.int32), key,
-                    jnp.zeros((1,), jnp.float32),
+                    self.params, self._base_key, self.cache, jnp.asarray(packed)
                 )
                 jax.block_until_ready(toks)
                 self._compiled.add(("prefill_chunk", lb, 1))
                 count += 1
         n, k = self.num_slots, self.decode_chunk
-        tokens = jnp.zeros((n,), jnp.int32)
-        positions = jnp.zeros((n,), jnp.int32)
-        temps0 = jnp.zeros((n,), jnp.float32)
+        wt = self.pages_per_slot if self.kv_layout == "paged" else 0
+        packed = np.zeros((4 + wt, n), np.int32)
         if self.kv_layout == "paged":
-            out, self.cache = self._decode_chunk(
-                self.params, tokens, positions, self.cache, key, temps0, k,
-                jnp.asarray(self._table),
-            )
-        else:
-            out, self.cache = self._decode_chunk(
-                self.params, tokens, positions, self.cache, key, temps0, k
-            )
+            packed[4:] = self.total_pages  # OOB table: writes dropped
+        out, self.cache = self._decode_chunk(
+            self.params, self._base_key, self.cache, k, jnp.asarray(packed)
+        )
         jax.block_until_ready(out)
         self._compiled.add(("decode", n, k))
         return count + 1
+
+    def submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        timeout: float | None = None,
+        **kw: Any,
+    ) -> Request:
+        """Non-blocking enqueue: returns the Request future (``.result()``
+        blocks; ``.cancel()`` frees the slot). One caller thread can keep
+        hundreds of generations in flight — the shape async transports use."""
+        return self._submit(
+            prompt, timeout,
+            max_new_tokens=max_new_tokens, temperature=temperature, **kw,
+        )
 
     def generate(
         self,
@@ -935,21 +987,21 @@ class GenerateEngine(_EngineBase):
             if self.slots[idx] is None:  # preemption pressure evicted US
                 return True
             last = s.written + chunk == s.prompt_len
-            tokens = np.zeros((1, lb), np.int32)
-            tokens[0, :chunk] = s.prompt_tokens[s.written:s.written + chunk]
-            lengths = np.array([chunk], np.int32)
-            offsets = np.array([s.written], np.int32)
-            temps = np.array([float(s.request.kw.get("temperature", 0.0))], np.float32)
-            pages_row = self._table[idx][None]
+            w = self.pages_per_slot
+            packed = np.zeros((1, lb + w + 4), np.int32)
+            packed[0, :chunk] = s.prompt_tokens[s.written:s.written + chunk]
+            packed[0, lb] = chunk
+            packed[0, lb + 1:lb + 1 + w] = self._table[idx]
+            packed[0, lb + 1 + w] = s.written  # chunk offset
+            packed[0, lb + 2 + w] = np.float32(
+                s.request.kw.get("temperature", 0.0)).view(np.int32)
             self._step_count += 1
-            key = jax.random.fold_in(self._base_key, self._step_count)
+            packed[0, lb + 3 + w] = self._step_count
             self._inflight = [s.request]
             t0 = time.monotonic()
 
         first_dev, self.cache = self._chunk_prefill(
-            self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-            self.cache, jnp.asarray(pages_row), jnp.asarray(offsets),
-            key, jnp.asarray(temps),
+            self.params, self._base_key, self.cache, jnp.asarray(packed)
         )
         first = np.asarray(first_dev)
 
@@ -1023,39 +1075,42 @@ class GenerateEngine(_EngineBase):
             if not ready:
                 return False
 
-            # one prefill call, padded to (len_bucket, batch_bucket). Padding
-            # rows point at slot index == num_slots, which is out of bounds for
-            # the cache's slot dimension — XLA scatter DROPS out-of-bounds
-            # updates, so they write nowhere (verified in tests). Paged rows use
-            # the same trick through all-OOB block-table rows (ops.paged).
+            # one prefill call, padded to (len_bucket, batch_bucket), shipped
+            # as ONE packed array (layout documented at the jit definitions).
+            # Padding rows point at slot index == num_slots, which is out of
+            # bounds for the cache's slot dimension — XLA scatter DROPS
+            # out-of-bounds updates, so they write nowhere (verified in
+            # tests). Paged rows use the same trick through all-OOB
+            # block-table rows (ops.paged).
             n = len(ready)
             nb = plan.batch_bucket
             lb = plan.len_bucket
-            tokens = np.zeros((nb, lb), np.int32)
-            lengths = np.ones((nb,), np.int32)
-            slot_ids = np.full((nb,), self.num_slots, np.int32)
+            w = self.pages_per_slot if self.kv_layout == "paged" else 1
+            packed = np.zeros((nb, lb + w + 3), np.int32)
+            packed[:, lb] = 1  # padding rows: length 1
             temps = np.zeros((nb,), np.float32)
-            for i, (req, toks) in enumerate(ready):
-                tokens[i, : toks.shape[0]] = toks
-                lengths[i] = toks.shape[0]
-                slot_ids[i] = free[i]
-                temps[i] = float(req.kw.get("temperature", 0.0))
             if self.kv_layout == "paged":
-                pages_rows = np.full((nb, self.pages_per_slot), self.total_pages, np.int32)
-                for i in range(n):
-                    pages_rows[i] = self._table[free[i]]
-                device_rows = jnp.asarray(pages_rows)
+                packed[:, lb + 1:lb + 1 + w] = self.total_pages
             else:
-                device_rows = jnp.asarray(slot_ids)
+                packed[:, lb + 1] = self.num_slots
+            for i, (req, toks) in enumerate(ready):
+                packed[i, : toks.shape[0]] = toks
+                packed[i, lb] = toks.shape[0]
+                if self.kv_layout == "paged":
+                    packed[i, lb + 1:lb + 1 + w] = self._table[free[i]]
+                else:
+                    packed[i, lb + 1] = free[i]
+                temps[i] = float(req.kw.get("temperature", 0.0))
+            packed[:, lb + 1 + w] = temps.view(np.int32)
+            self._step_count += 1
+            packed[0, lb + 2 + w] = self._step_count
+            lengths = packed[:, lb].copy()
 
             t0 = time.monotonic()
-            self._step_count += 1
-            key = jax.random.fold_in(self._base_key, self._step_count)
             self._inflight = [req for req, _ in ready]
 
         first_dev, self.cache = self._prefill_sample(
-            self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-            self.cache, device_rows, key, jnp.asarray(temps),
+            self.params, self._base_key, self.cache, jnp.asarray(packed)
         )
         first = np.asarray(first_dev)  # [nb] int32 — tokens, never logits
 
@@ -1119,41 +1174,37 @@ class GenerateEngine(_EngineBase):
                 if not active:
                     return False
 
-            tokens = np.zeros((n,), np.int32)
-            positions = np.zeros((n,), np.int32)
-            temps = np.zeros((n,), np.float32)
             # always the FULL chunk — one compiled decode program for the whole
             # serving lifetime. A slot that hits its budget/EOS mid-chunk simply
             # has its surplus tokens discarded (the cache carries decode_chunk
             # slack past max_len, so overshoot writes stay in bounds; paged
-            # slots' tables carry the same slack via pages_per_slot).
+            # slots' tables carry the same slack via pages_per_slot). All host
+            # inputs ride ONE packed array (layout at the jit definitions).
+            wt = self.pages_per_slot if self.kv_layout == "paged" else 0
+            packed = np.zeros((4 + wt, n), np.int32)
+            temps = np.zeros((n,), np.float32)
             for i in active:
                 s = self.slots[i]
-                tokens[i] = s.last_token
-                positions[i] = s.pos
+                packed[0, i] = s.last_token
+                packed[1, i] = s.pos
                 temps[i] = float(s.request.kw.get("temperature", 0.0))
+            packed[2] = temps.view(np.int32)
+            self._step_count += 1
+            packed[3, 0] = self._step_count
             if self.kv_layout == "paged":
-                # snapshot with NON-decoding rows masked out: a chunk-prefilling
-                # slot owns real pages, and the decode scatter (which writes all
-                # rows uniformly) would corrupt its position 0 otherwise; empty
-                # slots are already all-OOB via _free_slot
+                # table snapshot with NON-decoding rows masked out: a chunk-
+                # prefilling slot owns real pages, and the decode write (which
+                # covers all rows uniformly) would corrupt its position 0
+                # otherwise; empty slots are already all-OOB via _free_slot
                 table_snapshot = self._table.copy()
                 for i in self._prefilling():
                     table_snapshot[i, :] = self.total_pages
+                packed[4:] = table_snapshot.T
 
         t0 = time.monotonic()
-        self._step_count += 1
-        key = jax.random.fold_in(self._base_key, self._step_count)
-        if self.kv_layout == "paged":
-            chunk_dev, self.cache = self._decode_chunk(
-                self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                self.cache, key, jnp.asarray(temps), k, jnp.asarray(table_snapshot),
-            )
-        else:
-            chunk_dev, self.cache = self._decode_chunk(
-                self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                self.cache, key, jnp.asarray(temps), k,
-            )
+        chunk_dev, self.cache = self._decode_chunk(
+            self.params, self._base_key, self.cache, k, jnp.asarray(packed)
+        )
         chunk = np.asarray(chunk_dev)  # [slots, k] int32 — tokens, never logits
         if self._poisoned:
             # stop() declared this thread wedged and already failed/cleared

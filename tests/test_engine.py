@@ -357,8 +357,9 @@ class TestEngineSupervision:
             if boom["left"] > 0:
                 boom["left"] -= 1
                 # simulate a fault AFTER buffer donation: the cache the
-                # engine holds is dead, recovery must rebuild it
-                jax.tree.map(lambda x: x.delete(), a[3])
+                # engine holds (arg 2: params, base_key, cache, ...) is
+                # dead, recovery must rebuild it
+                jax.tree.map(lambda x: x.delete(), a[2])
                 raise RuntimeError("injected device fault")
             return real(*a, **kw)
 
