@@ -25,6 +25,8 @@ Env knobs:
     GOFR_BENCH_NEW            generated tokens per request (default 64)
     GOFR_BENCH_PLATFORM       force 'cpu' or 'tpu' (skips the probe)
     GOFR_BENCH_PROBE_S        TPU init probe timeout seconds (default 240)
+    GOFR_BENCH_KV             'slot' (default) | 'paged' engine KV layout
+    GOFR_BENCH_LATENCY        1 = also measure sequential single-request latency
     GOFR_BENCH_SWEEP          1 = sweep slots x decode_chunk, keep best
     GOFR_BENCH_PALLAS_AB      1 = record kernel-on/off engine A/B
     GOFR_BENCH_DEBUG          1 = per-phase device-call accounting in extra
@@ -247,10 +249,18 @@ def main() -> None:
     rng = np.random.RandomState(0)
     prompts = [rng.randint(1, cfg.vocab_size, size=prompt_len).tolist() for _ in range(n_requests)]
 
+    kv_layout = os.environ.get("GOFR_BENCH_KV", "slot")
+    if kv_layout not in ("slot", "paged"):
+        # a typo'd layout must not silently bench slot while REPORTING the typo
+        raise SystemExit(f"GOFR_BENCH_KV={kv_layout!r}: use 'slot' or 'paged'")
+
     def engine_kw(s: int, k: int) -> dict:
-        return dict(slots=s, max_len=prompt_len + max_new + 8,
-                    max_prefill_batch=prefill_batch, decode_chunk=k,
-                    prefill_buckets=[prompt_len])
+        kw = dict(slots=s, max_len=prompt_len + max_new + 8,
+                  max_prefill_batch=prefill_batch, decode_chunk=k,
+                  prefill_buckets=[prompt_len])
+        if kv_layout == "paged":
+            kw.update(kv_layout="paged", page_size=128)
+        return kw
 
     best = (slots, decode_chunk)
     sweep_log = []
@@ -323,9 +333,33 @@ def main() -> None:
         "ttft_p50_s": round(_percentile(m["ttfts"], 50), 4),
         "ttft_p99_s": round(_percentile(m["ttfts"], 99), 4),
     }
+    if kv_layout != "slot":
+        extra["kv_layout"] = kv_layout
     if "phases" in m:
         extra["phases"] = m["phases"]
         extra["device_seconds"] = m["device_seconds"]
+
+    # latency mode: STRICTLY sequential single requests — the occupancy-1
+    # counterpoint to the throughput headline (the full-slots decode program
+    # runs for one lane, so this bounds per-request interactive latency)
+    if os.environ.get("GOFR_BENCH_LATENCY") == "1":
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        eng = GenerateEngine(llama, cfg, params, container, **engine_kw(*best))
+        try:
+            eng.warmup()
+            eng.start()
+            eng.generate(prompts[0], max_new_tokens=2, timeout=timeout)
+            t0 = time.monotonic()
+            for i in range(4):
+                eng.generate(prompts[i % len(prompts)], max_new_tokens=max_new, timeout=timeout)
+            per_req = (time.monotonic() - t0) / 4
+        finally:
+            eng.stop()
+        extra["single_request_s"] = round(per_req, 3)
+        # end-to-end rate (prefill included) — NOT comparable to the
+        # decode-only headline rate
+        extra["single_request_tok_s"] = round(max_new / per_req, 1)
     if sweep_log:
         extra["sweep"] = sweep_log
 

@@ -313,6 +313,36 @@ class BatchEngine(_EngineBase):
         req = self._submit(inputs, timeout, **kw)
         return req.result(timeout if timeout is not None else self.default_timeout)
 
+    def warmup(self, example: Any, len_buckets: list[int] | None = None,
+               batch_buckets: list[int] | None = None) -> int:
+        """Pre-compile the (len bucket × batch bucket) apply signatures so no
+        XLA compile lands in the serving window (GenerateEngine.warmup
+        parity). ``example`` is one representative request input — token
+        sequences warm every (len, batch) pair, fixed-shape inputs (images)
+        warm batch buckets only. Call before serving traffic."""
+        from gofr_tpu.ops.pallas import platform_hint
+
+        arr = np.asarray(self.encode_fn(example))
+        bbs = sorted(batch_buckets) if batch_buckets else self.batch_buckets
+        count = 0
+        with platform_hint(getattr(self.tpu, "platform", None)):
+            if arr.ndim == 1:
+                lbs = sorted(len_buckets) if len_buckets else self.len_buckets
+                for lb in lbs:
+                    for nb in bbs:
+                        tokens = jnp.zeros((nb, lb), arr.dtype)
+                        lens = jnp.ones((nb,), jnp.int32)
+                        jax.block_until_ready(self.apply_fn(tokens, lens))
+                        self._compiled.add(("batch", lb, nb))
+                        count += 1
+            else:
+                for nb in bbs:
+                    stacked = jnp.zeros((nb, *arr.shape), arr.dtype)
+                    jax.block_until_ready(self.apply_fn(stacked))
+                    self._compiled.add(("batch", arr.shape, nb))
+                    count += 1
+        return count
+
     def _drain(self) -> list[Request]:
         """Block for one request, then grab whatever arrives within
         max_wait (micro-batch accumulation), up to max_batch."""

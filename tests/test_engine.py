@@ -271,6 +271,35 @@ class TestBatchEngine:
         finally:
             eng.stop()
 
+    def test_batch_engine_warmup_precompiles(self):
+        """BatchEngine.warmup compiles every signature up front; the serving
+        step then hits only the compile cache."""
+        cfg = BertConfig.tiny()
+        from gofr_tpu.models import bert
+
+        params = bert.init(cfg, jax.random.key(0))
+        container = make_container()
+        traces = {"n": 0}
+
+        @jax.jit
+        def apply(tokens, lengths):
+            traces["n"] += 1  # runs at TRACE time only: one per signature
+            return bert.embed_pooled(cfg, params, tokens, lengths)
+
+        eng = BatchEngine(apply, container, max_batch=4, len_buckets=[16, 32])
+        try:
+            n = eng.warmup([1, 2, 3])
+            assert n == 2 * 3  # 2 len buckets x batch buckets {1,2,4}
+            traces_after_warmup = traces["n"]
+            assert traces_after_warmup == n
+            out = eng.infer([5, 3, 9], timeout=120)
+            assert np.asarray(out).ndim >= 1
+            assert traces["n"] == traces_after_warmup, (
+                "serving step traced a program warmup should have covered"
+            )
+        finally:
+            eng.stop()
+
     def test_classify_images(self):
         from gofr_tpu.models import vit
 
