@@ -5,7 +5,7 @@ capability the TPU build adds: decoder LMs for /generate, encoders for
 embedding and classification endpoints, all shardable via logical axes.
 """
 
-from gofr_tpu.models import bert, llama, mixtral, vit
+from gofr_tpu.models import bert, gpt2, llama, mixtral, vit
 from gofr_tpu.models.base import (
     ModelSpec,
     cast_floats,
@@ -14,11 +14,13 @@ from gofr_tpu.models.base import (
     param_count,
     register_family,
 )
+from gofr_tpu.models.gpt2 import GPT2Config
 from gofr_tpu.models.llama import LlamaConfig
 from gofr_tpu.models.mixtral import MixtralConfig
 from gofr_tpu.models.bert import BertConfig
 from gofr_tpu.models.vit import ViTConfig
 
+register_family("gpt2", gpt2)
 register_family("llama", llama)
 register_family("mixtral", mixtral)
 register_family("bert", bert)
@@ -30,6 +32,8 @@ __all__ = [
     "MixtralConfig",
     "BertConfig",
     "ViTConfig",
+    "gpt2",
+    "GPT2Config",
     "llama",
     "mixtral",
     "bert",

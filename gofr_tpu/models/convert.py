@@ -211,3 +211,67 @@ def vit_from_hf(model_or_path: Any, dtype=jnp.float32):
         params["head_w"] = jnp.asarray(_np(sd["classifier.weight"]).T, dtype)
         params["head_b"] = jnp.asarray(_np(sd["classifier.bias"]), dtype)
     return cfg, params
+
+
+# -- GPT-2 ---------------------------------------------------------------------
+
+
+def gpt2_from_hf(model_or_path: Any, dtype=jnp.float32):
+    """→ (GPT2Config, params) from an HF ``GPT2LMHeadModel`` (or path).
+
+    HF GPT-2 uses Conv1D modules whose weights are stored [in, out] — the
+    same convention as this package's matmuls, so no transposes; the fused
+    c_attn [E, 3E] splits into wq/wk/wv columns.
+    """
+    from gofr_tpu.models.gpt2 import GPT2Config
+
+    hf = _load_hf(model_or_path, "AutoModelForCausalLM")
+    hc = hf.config
+    if getattr(hc, "activation_function", "gelu_new") not in ("gelu_new",):
+        raise ValueError(
+            f"gpt2_from_hf supports activation_function='gelu_new' only, "
+            f"got {hc.activation_function!r} (forward uses approximate gelu)"
+        )
+    if getattr(hc, "n_inner", None) not in (None, 4 * hc.n_embd):
+        raise ValueError(
+            f"gpt2_from_hf supports n_inner == 4*n_embd only, got {hc.n_inner}"
+        )
+    cfg = GPT2Config(
+        vocab_size=hc.vocab_size,
+        hidden_size=hc.n_embd,
+        num_layers=hc.n_layer,
+        num_heads=hc.n_head,
+        max_seq_len=hc.n_positions,
+        norm_eps=hc.layer_norm_epsilon,
+        dtype=dtype,
+    )
+    sd = hf.state_dict()
+    p = "transformer.h.{i}."
+    nl, e = hc.n_layer, hc.n_embd
+    cattn = _stack(sd, p + "attn.c_attn.weight", nl)   # [L, E, 3E]
+    cattn_b = _stack(sd, p + "attn.c_attn.bias", nl)   # [L, 3E]
+    params = {
+        "wte": jnp.asarray(_np(sd["transformer.wte.weight"]), dtype),
+        "wpe": jnp.asarray(_np(sd["transformer.wpe.weight"]), dtype),
+        "blocks": {
+            "ln1_g": jnp.asarray(_stack(sd, p + "ln_1.weight", nl), dtype),
+            "ln1_b": jnp.asarray(_stack(sd, p + "ln_1.bias", nl), dtype),
+            "wq": jnp.asarray(cattn[:, :, :e], dtype),
+            "bq": jnp.asarray(cattn_b[:, :e], dtype),
+            "wk": jnp.asarray(cattn[:, :, e:2 * e], dtype),
+            "bk": jnp.asarray(cattn_b[:, e:2 * e], dtype),
+            "wv": jnp.asarray(cattn[:, :, 2 * e:], dtype),
+            "bv": jnp.asarray(cattn_b[:, 2 * e:], dtype),
+            "wo": jnp.asarray(_stack(sd, p + "attn.c_proj.weight", nl), dtype),
+            "bo": jnp.asarray(_stack(sd, p + "attn.c_proj.bias", nl), dtype),
+            "ln2_g": jnp.asarray(_stack(sd, p + "ln_2.weight", nl), dtype),
+            "ln2_b": jnp.asarray(_stack(sd, p + "ln_2.bias", nl), dtype),
+            "w_fc": jnp.asarray(_stack(sd, p + "mlp.c_fc.weight", nl), dtype),
+            "b_fc": jnp.asarray(_stack(sd, p + "mlp.c_fc.bias", nl), dtype),
+            "w_proj": jnp.asarray(_stack(sd, p + "mlp.c_proj.weight", nl), dtype),
+            "b_proj": jnp.asarray(_stack(sd, p + "mlp.c_proj.bias", nl), dtype),
+        },
+        "lnf_g": jnp.asarray(_np(sd["transformer.ln_f.weight"]), dtype),
+        "lnf_b": jnp.asarray(_np(sd["transformer.ln_f.bias"]), dtype),
+    }
+    return cfg, params

@@ -1,0 +1,201 @@
+"""GPT-2 decoder family (learned position embeddings, pre-LN, gelu MLP,
+tied lm head) — the classic HF checkpoint format, servable through the
+same engine contract as llama: ``init / forward / prefill / decode_step /
+make_cache / param_axes`` (HF oracle in tests/test_models.py; converter in
+models/convert.py). Linear sites route through ops.quant.qdot, so int8
+weight-only serving works here too.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gofr_tpu.models.base import truncated_normal
+from gofr_tpu.ops import layer_norm, mha_attention
+from gofr_tpu.ops.attention import decode_attention
+from gofr_tpu.ops.kvcache import SlotKVCache, append_tokens, write_prompts
+from gofr_tpu.ops.quant import qdot
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @property
+    def head_size(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def intermediate_size(self) -> int:
+        return 4 * self.hidden_size
+
+    @classmethod
+    def small(cls, **kw) -> "GPT2Config":
+        return cls(**kw)  # gpt2 (124M) defaults
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2Config":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 32)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("max_seq_len", 128)
+        return cls(**kw)
+
+
+# every linear site routes through ops.quant.qdot, so QTensor params serve
+QUANTIZABLE = True
+
+
+def init(cfg: GPT2Config, key: jax.Array) -> dict:
+    e, L = cfg.hidden_size, cfg.num_layers
+    keys = jax.random.split(key, 8)
+    dt = cfg.dtype
+
+    def mat(k, shape, std=0.02):
+        return truncated_normal(k, shape, std, dt)
+
+    return {
+        "wte": mat(keys[0], (cfg.vocab_size, e)),
+        "wpe": mat(keys[1], (cfg.max_seq_len, e)),
+        "blocks": {
+            "ln1_g": jnp.ones((L, e), dt), "ln1_b": jnp.zeros((L, e), dt),
+            "wq": mat(keys[2], (L, e, e)), "bq": jnp.zeros((L, e), dt),
+            "wk": mat(keys[3], (L, e, e)), "bk": jnp.zeros((L, e), dt),
+            "wv": mat(keys[4], (L, e, e)), "bv": jnp.zeros((L, e), dt),
+            "wo": mat(keys[5], (L, e, e)), "bo": jnp.zeros((L, e), dt),
+            "ln2_g": jnp.ones((L, e), dt), "ln2_b": jnp.zeros((L, e), dt),
+            "w_fc": mat(keys[6], (L, e, cfg.intermediate_size)),
+            "b_fc": jnp.zeros((L, cfg.intermediate_size), dt),
+            "w_proj": mat(keys[7], (L, cfg.intermediate_size, e)),
+            "b_proj": jnp.zeros((L, e), dt),
+        },
+        "lnf_g": jnp.ones((e,), dt), "lnf_b": jnp.zeros((e,), dt),
+    }
+
+
+def param_axes(cfg: GPT2Config) -> dict:
+    """Logical sharding axes (tp shards heads/mlp; embed replicated on tp)."""
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": {
+            "ln1_g": (None, None), "ln1_b": (None, None),
+            "wq": (None, "embed", "heads"), "bq": (None, "heads"),
+            "wk": (None, "embed", "heads"), "bk": (None, "heads"),
+            "wv": (None, "embed", "heads"), "bv": (None, "heads"),
+            "wo": (None, "heads", "embed"), "bo": (None, None),
+            "ln2_g": (None, None), "ln2_b": (None, None),
+            "w_fc": (None, "embed", "mlp"), "b_fc": (None, "mlp"),
+            "w_proj": (None, "mlp", "embed"), "b_proj": (None, None),
+        },
+        "lnf_g": (None,), "lnf_b": (None,),
+    }
+
+
+def _attn_qkv(cfg: GPT2Config, lp: dict, x: jnp.ndarray):
+    """x [B,S,E] (post-ln1) → q/k/v [B,S,H,D]."""
+    b, s, _ = x.shape
+    q = (qdot(x, lp["wq"]) + lp["bq"]).reshape(b, s, cfg.num_heads, cfg.head_size)
+    k = (qdot(x, lp["wk"]) + lp["bk"]).reshape(b, s, cfg.num_heads, cfg.head_size)
+    v = (qdot(x, lp["wv"]) + lp["bv"]).reshape(b, s, cfg.num_heads, cfg.head_size)
+    return q, k, v
+
+
+def _mlp(cfg: GPT2Config, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+    h = layer_norm(x, lp["ln2_g"], lp["ln2_b"], cfg.norm_eps)
+    return qdot(jax.nn.gelu(qdot(h, lp["w_fc"]) + lp["b_fc"], approximate=True),
+                lp["w_proj"]) + lp["b_proj"]
+
+
+@partial(jax.jit, static_argnums=0)
+def forward(cfg: GPT2Config, params: dict, tokens: jnp.ndarray,
+            lengths: jnp.ndarray | None = None) -> jnp.ndarray:
+    """tokens [B,S] → logits [B,S,V] f32 (dense, no cache)."""
+    b, s = tokens.shape
+    pos = jnp.arange(s)
+    x = (params["wte"][tokens] + params["wpe"][pos][None]).astype(cfg.dtype)
+
+    def body(x, lp):
+        h = layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
+        q, k, v = _attn_qkv(cfg, lp, h)
+        a = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
+        x = x + qdot(a.reshape(b, s, -1), lp["wo"]) + lp["bo"]
+        x = x + _mlp(cfg, lp, x)
+        return x, None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.norm_eps)
+    return qdot(x, params["wte"].T).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=4)
+def prefill(cfg: GPT2Config, params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray,
+            cache: SlotKVCache, slots: jnp.ndarray) -> tuple[jnp.ndarray, SlotKVCache]:
+    """Engine contract — see llama.prefill."""
+    b, s = tokens.shape
+    pos = jnp.arange(s)
+    x = (params["wte"][tokens] + params["wpe"][pos][None]).astype(cfg.dtype)
+    row = jnp.arange(b)
+
+    def body(x, xs):
+        lp, k_layer, v_layer = xs
+        h = layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
+        q, k, v = _attn_qkv(cfg, lp, h)
+        k_layer, v_layer = write_prompts(k_layer, v_layer, slots, k, v)
+        a = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
+        x = x + qdot(a.reshape(b, s, -1), lp["wo"]) + lp["bo"]
+        x = x + _mlp(cfg, lp, x)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.norm_eps)
+    last = x[row, lengths - 1]
+    logits = qdot(last, params["wte"].T).astype(jnp.float32)
+    return logits, SlotKVCache(k=new_k, v=new_v)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=4)
+def decode_step(cfg: GPT2Config, params: dict, tokens: jnp.ndarray, positions: jnp.ndarray,
+                cache: SlotKVCache) -> tuple[jnp.ndarray, SlotKVCache]:
+    """Engine contract — see llama.decode_step."""
+    n = tokens.shape[0]
+    # learned positional embedding at each slot's own position (clamped so
+    # garbage positions on idle slots stay in bounds)
+    pe = params["wpe"][jnp.minimum(positions, cfg.max_seq_len - 1)]
+    x = (params["wte"][tokens] + pe).astype(cfg.dtype)
+
+    def body(x, xs):
+        lp, k_layer, v_layer = xs
+        h = layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
+        q, k, v = _attn_qkv(cfg, lp, h[:, None])
+        q, k, v = q[:, 0], k[:, 0], v[:, 0]
+        k_layer, v_layer = append_tokens(k_layer, v_layer, positions, k, v)
+        a = decode_attention(q, k_layer, v_layer, positions + 1)
+        x = x + qdot(a.reshape(n, -1), lp["wo"]) + lp["bo"]
+        x = x + _mlp(cfg, lp, x)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.norm_eps)
+    logits = qdot(x, params["wte"].T).astype(jnp.float32)
+    return logits, SlotKVCache(k=new_k, v=new_v)
+
+
+def make_cache(cfg: GPT2Config, slots: int, max_len: int | None = None) -> SlotKVCache:
+    return SlotKVCache.create(
+        cfg.num_layers, slots, max_len or cfg.max_seq_len,
+        cfg.num_heads, cfg.head_size, dtype=cfg.dtype,
+    )

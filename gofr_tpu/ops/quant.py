@@ -62,7 +62,7 @@ def qdot(x: jnp.ndarray, w) -> jnp.ndarray:
 
 
 _DEFAULT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
-                 "w_router", "w1", "w2", "w3")
+                 "w_router", "w1", "w2", "w3", "w_fc", "w_proj")
 
 
 def quantize_tree(params, keys: tuple[str, ...] = _DEFAULT_KEYS):

@@ -330,14 +330,16 @@ class BatchEngine(_EngineBase):
                 lbs = sorted(len_buckets) if len_buckets else self.len_buckets
                 for lb in lbs:
                     for nb in bbs:
-                        tokens = jnp.zeros((nb, lb), arr.dtype)
-                        lens = jnp.ones((nb,), jnp.int32)
+                        # via numpy so dtype canonicalization matches _step
+                        # (a direct jnp.zeros(int64) would warn per bucket)
+                        tokens = jnp.asarray(np.zeros((nb, lb), arr.dtype))
+                        lens = jnp.asarray(np.ones((nb,), np.int32))
                         jax.block_until_ready(self.apply_fn(tokens, lens))
                         self._compiled.add(("batch", lb, nb))
                         count += 1
             else:
                 for nb in bbs:
-                    stacked = jnp.zeros((nb, *arr.shape), arr.dtype)
+                    stacked = jnp.asarray(np.zeros((nb, *arr.shape), arr.dtype))
                     jax.block_until_ready(self.apply_fn(stacked))
                     self._compiled.add(("batch", arr.shape, nb))
                     count += 1
@@ -1302,9 +1304,9 @@ class GenerateEngine(_EngineBase):
 def _resolve_config(family_name: str, config: Any):
     if config is not None and not isinstance(config, dict):
         return config
-    from gofr_tpu.models import BertConfig, LlamaConfig, ViTConfig
+    from gofr_tpu.models import BertConfig, GPT2Config, LlamaConfig, ViTConfig
 
-    defaults = {"llama": LlamaConfig, "bert": BertConfig, "vit": ViTConfig}
+    defaults = {"llama": LlamaConfig, "gpt2": GPT2Config, "bert": BertConfig, "vit": ViTConfig}
     cls = defaults.get(family_name)
     if cls is None:
         raise ValueError(f"no default config for family {family_name!r}; pass spec.config")
