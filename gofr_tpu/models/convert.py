@@ -236,6 +236,14 @@ def gpt2_from_hf(model_or_path: Any, dtype=jnp.float32):
         raise ValueError(
             f"gpt2_from_hf supports n_inner == 4*n_embd only, got {hc.n_inner}"
         )
+    if (not getattr(hc, "scale_attn_weights", True)
+            or getattr(hc, "scale_attn_by_inverse_layer_idx", False)
+            or getattr(hc, "reorder_and_upcast_attn", False)):
+        raise ValueError(
+            "gpt2_from_hf supports standard 1/sqrt(d) attention scaling only "
+            "(scale_attn_weights=True, no inverse-layer-idx scaling or "
+            "reorder_and_upcast_attn) — this checkpoint would silently diverge"
+        )
     cfg = GPT2Config(
         vocab_size=hc.vocab_size,
         hidden_size=hc.n_embd,

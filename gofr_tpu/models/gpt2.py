@@ -57,6 +57,8 @@ class GPT2Config:
 
 # every linear site routes through ops.quant.qdot, so QTensor params serve
 QUANTIZABLE = True
+# prefill() accepts chunk offsets (slot-layout chunked prefill)
+SLOT_CHUNKED_PREFILL = True
 
 
 def init(cfg: GPT2Config, key: jax.Array) -> dict:
@@ -143,19 +145,31 @@ def forward(cfg: GPT2Config, params: dict, tokens: jnp.ndarray,
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
 def prefill(cfg: GPT2Config, params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray,
-            cache: SlotKVCache, slots: jnp.ndarray) -> tuple[jnp.ndarray, SlotKVCache]:
-    """Engine contract — see llama.prefill."""
+            cache: SlotKVCache, slots: jnp.ndarray,
+            offsets: jnp.ndarray | None = None) -> tuple[jnp.ndarray, SlotKVCache]:
+    """Engine contract — see llama.prefill (offsets = chunked prefill)."""
     b, s = tokens.shape
-    pos = jnp.arange(s)
-    x = (params["wte"][tokens] + params["wpe"][pos][None]).astype(cfg.dtype)
+    chunked = offsets is not None
+    positions = (offsets[:, None] if chunked else 0) + jnp.arange(s)[None]  # [B,S] or [1,S]
+    pe = params["wpe"][jnp.minimum(positions, cfg.max_seq_len - 1)]
+    x = (params["wte"][tokens] + pe).astype(cfg.dtype)
     row = jnp.arange(b)
+    total = (offsets + lengths) if chunked else lengths
 
     def body(x, xs):
         lp, k_layer, v_layer = xs
         h = layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
         q, k, v = _attn_qkv(cfg, lp, h)
-        k_layer, v_layer = write_prompts(k_layer, v_layer, slots, k, v)
-        a = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
+        k_layer, v_layer = write_prompts(k_layer, v_layer, slots, k, v, offsets)
+        if chunked:
+            k_view = jnp.take(k_layer, slots, axis=0)
+            v_view = jnp.take(v_layer, slots, axis=0)
+            a = mha_attention(
+                q, k_view.swapaxes(1, 2), v_view.swapaxes(1, 2),
+                causal=True, q_offset=offsets, kv_lengths=total,
+            )
+        else:
+            a = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
         x = x + qdot(a.reshape(b, s, -1), lp["wo"]) + lp["bo"]
         x = x + _mlp(cfg, lp, x)
         return x, (k_layer, v_layer)

@@ -119,6 +119,9 @@ def init(cfg: LlamaConfig, key: jax.Array) -> dict:
 
 # every linear site routes through ops.quant.qdot, so QTensor params serve
 QUANTIZABLE = True
+# prefill() accepts chunk offsets, so the slot-layout engine can stream
+# long prompts in chunks too (the paged layout has prefill_paged for this)
+SLOT_CHUNKED_PREFILL = True
 
 
 def param_axes(cfg: LlamaConfig) -> dict:
@@ -265,26 +268,40 @@ def forward_pipelined(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
 def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray,
-            cache: SlotKVCache, slots: jnp.ndarray) -> tuple[jnp.ndarray, SlotKVCache]:
-    """Prefill prompts into cache slots.
+            cache: SlotKVCache, slots: jnp.ndarray,
+            offsets: jnp.ndarray | None = None) -> tuple[jnp.ndarray, SlotKVCache]:
+    """Prefill prompts (or prompt CHUNKS) into cache slots.
 
-    tokens [B,S] (padded), lengths [B], slots [B] → (last-token logits
-    [B,V] f32, updated cache). Each row b is written into cache slot
-    ``slots[b]`` at offsets 0..S.
+    tokens [B,S] (padded), lengths [B] = live tokens in this call, slots
+    [B] → (last-token logits [B,V] f32, updated cache). ``offsets`` [B]
+    places the chunk at logical positions offsets..offsets+S (None = 0,
+    whole-prompt prefill). Chunked rows attend to everything already in
+    their slot through a gathered cache view; whole-prompt rows attend
+    prompt-locally.
     """
     cos, sin = _rope(cfg)
     x = params["embed"][tokens].astype(cfg.dtype)
     b, s = tokens.shape
-    positions = jnp.arange(s)[None]
+    chunked = offsets is not None
+    positions = (offsets[:, None] if chunked else 0) + jnp.arange(s)[None]
     row = jnp.arange(b)
+    total = (offsets + lengths) if chunked else lengths
 
     def body(x, xs):
         lp, k_layer, v_layer = xs
         q, k, v = _qkv(cfg, lp, x)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
-        k_layer, v_layer = write_prompts(k_layer, v_layer, slots, k, v)
-        attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
+        k_layer, v_layer = write_prompts(k_layer, v_layer, slots, k, v, offsets)
+        if chunked:
+            k_view = jnp.take(k_layer, slots, axis=0)  # [B, Hkv, Smax, D]
+            v_view = jnp.take(v_layer, slots, axis=0)
+            attn = mha_attention(
+                q, k_view.swapaxes(1, 2), v_view.swapaxes(1, 2),
+                causal=True, q_offset=offsets, kv_lengths=total,
+            )
+        else:
+            attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
         x = x + qdot(attn.reshape(b, s, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
         return x, (k_layer, v_layer)

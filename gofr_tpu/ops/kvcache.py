@@ -63,14 +63,18 @@ def write_prompts(
     slots: jnp.ndarray,
     k_new: jnp.ndarray,
     v_new: jnp.ndarray,
+    offsets: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Write prefilled prompts [B, S, Hkv, D] (activation layout) into rows
-    ``slots`` [B] at offsets 0..S. ``k_layer``/``v_layer`` are per-layer
-    views [Slots, Hkv, Smax, D]."""
+    ``slots`` [B] at positions ``offsets``..``offsets``+S (0..S when
+    offsets is None — whole-prompt prefill; nonzero for chunked prefill).
+    ``k_layer``/``v_layer`` are per-layer views [Slots, Hkv, Smax, D]."""
     b, s, hkv, _ = k_new.shape
     rows = slots[:, None, None]
     heads = jnp.arange(hkv)[None, :, None]
     pos = jnp.arange(s)[None, None, :]
+    if offsets is not None:
+        pos = pos + offsets[:, None, None]
     k_layer = k_layer.at[rows, heads, pos].set(k_new.swapaxes(1, 2).astype(k_layer.dtype))
     v_layer = v_layer.at[rows, heads, pos].set(v_new.swapaxes(1, 2).astype(v_layer.dtype))
     return k_layer, v_layer

@@ -560,7 +560,6 @@ class GenerateEngine(_EngineBase):
             self._slot_pages: list[list[int]] = [[] for _ in range(slots)]
             # OOB convention: unallocated entries point one past the pool
             self._table = np.full((slots, self.pages_per_slot), self.total_pages, np.int32)
-            self._admit_seq = 0  # preemption order: newest admitted goes first
         else:
             # cache headroom so a chunk never writes past Smax; round to a
             # kernel-friendly multiple of 128 when the model allows it
@@ -570,8 +569,13 @@ class GenerateEngine(_EngineBase):
         self.slots: list[_Slot | None] = [None] * slots
         self._pending: list[tuple[Request, np.ndarray]] = []
         # prompts longer than the largest prefill bucket: admitted one at a
-        # time and streamed into the cache chunk-by-chunk (paged layout only)
+        # time and streamed into the cache chunk-by-chunk. Paged always
+        # supports this (prefill_paged offsets); slot layouts need the
+        # family's prefill to accept offsets (SLOT_CHUNKED_PREFILL flag).
         self._pending_long: list[tuple[Request, np.ndarray]] = []
+        self._chunked_ok = (kv_layout == "paged"
+                            or getattr(family, "SLOT_CHUNKED_PREFILL", False))
+        self._admit_seq = 0  # admission order (preemption picks newest)
         self._base_key = jax.random.key(seed)
         self._step_count = 0
 
@@ -654,6 +658,20 @@ class GenerateEngine(_EngineBase):
                 toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
                 return toks, cache
 
+            if getattr(family, "SLOT_CHUNKED_PREFILL", False):
+                @partial(jax.jit, donate_argnums=(2,))
+                def _chunk_prefill(params, base_key, cache, packed):
+                    tokens, lengths, rows, offsets, temps, step = _unpack_prefill(
+                        packed, W, chunked=True)
+                    key = jax.random.fold_in(base_key, step)
+                    logits, cache = family.prefill(
+                        cfg, params, tokens, lengths, cache, rows[:, 0], offsets
+                    )
+                    toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
+                    return toks, cache
+
+                self._chunk_prefill = _chunk_prefill
+
             @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
             def _decode_chunk(params, base_key, cache, steps, packed):
                 tokens, positions = packed[0], packed[1]
@@ -713,8 +731,10 @@ class GenerateEngine(_EngineBase):
                 jax.block_until_ready(toks)
                 self._compiled.add(("prefill", lb, nb))
                 count += 1
-        if self.kv_layout == "paged":
-            # chunked-prefill programs (batch 1, one per len bucket)
+        if self._chunked_ok:
+            # chunked-prefill programs (batch 1, one per len bucket). OOB
+            # rows — block-table entries (paged) or the slot id (slot) —
+            # drop their writes, so a warmup never touches live cache state.
             for lb in lbs:
                 packed = np.zeros((1, lb + w + 4), np.int32)
                 packed[0, lb] = 1
@@ -954,11 +974,11 @@ class GenerateEngine(_EngineBase):
                 if toks.shape[0] >= self.max_len:
                     raise ValueError(f"prompt length {toks.shape[0]} ≥ engine max_len {self.max_len}")
                 if toks.shape[0] > self.prefill_buckets[-1]:
-                    if self.kv_layout != "paged":
+                    if not self._chunked_ok:
                         raise ValueError(
                             f"prompt length {toks.shape[0]} exceeds the largest prefill "
                             f"bucket {self.prefill_buckets[-1]} (chunked prefill needs "
-                            f"kv_layout='paged')"
+                            f"the paged layout or a family with SLOT_CHUNKED_PREFILL)"
                         )
                     self._pending_long.append((req, toks))
                 else:
@@ -995,7 +1015,7 @@ class GenerateEngine(_EngineBase):
         final chunk samples the request's first token and flips the slot to
         the decode stage. One chunk per loop iteration keeps decode stepping
         between chunks. Returns True when device work happened."""
-        if self.kv_layout != "paged":
+        if not self._chunked_ok:
             return False
         with self._state_lock:
             pre = self._prefilling()
@@ -1009,21 +1029,25 @@ class GenerateEngine(_EngineBase):
                 return True  # state changed; re-loop without idling
             chunk = min(s.prompt_len - s.written, self.prefill_buckets[-1])
             lb = next_bucket(chunk, self.prefill_buckets)
-            # pages must cover this chunk's writes before the table snapshot
-            while not self._ensure_pages(idx, s.written + chunk - 1):
-                if not self._preempt_newest(except_slot=idx):
-                    self._free_slot(idx)
-                    s.request.complete(error=RuntimeError(
-                        "KV page pool exhausted for a single request"))
-                    return True  # state changed; re-loop without idling
-            if self.slots[idx] is None:  # preemption pressure evicted US
-                return True
+            if self.kv_layout == "paged":
+                # pages must cover this chunk's writes before the table snapshot
+                while not self._ensure_pages(idx, s.written + chunk - 1):
+                    if not self._preempt_newest(except_slot=idx):
+                        self._free_slot(idx)
+                        s.request.complete(error=RuntimeError(
+                            "KV page pool exhausted for a single request"))
+                        return True  # state changed; re-loop without idling
+                if self.slots[idx] is None:  # preemption pressure evicted US
+                    return True
             last = s.written + chunk == s.prompt_len
-            w = self.pages_per_slot
+            w = self.pages_per_slot if self.kv_layout == "paged" else 1
             packed = np.zeros((1, lb + w + 4), np.int32)
             packed[0, :chunk] = s.prompt_tokens[s.written:s.written + chunk]
             packed[0, lb] = chunk
-            packed[0, lb + 1:lb + 1 + w] = self._table[idx]
+            if self.kv_layout == "paged":
+                packed[0, lb + 1:lb + 1 + w] = self._table[idx]
+            else:
+                packed[0, lb + 1] = idx
             packed[0, lb + 1 + w] = s.written  # chunk offset
             packed[0, lb + 2 + w] = np.float32(
                 s.request.kw.get("temperature", 0.0)).view(np.int32)
@@ -1150,7 +1174,12 @@ class GenerateEngine(_EngineBase):
             self._inflight = []
             if self._stop.is_set():
                 # stop() raced a wedged/slow prefill and already failed this batch
-                # (via _inflight); don't resurrect it into slots.
+                # (via _inflight); don't resurrect it into slots — and return the
+                # pages reserved for them at admission, or they'd be stranded on
+                # never-occupied slots (found by the stop-mid-traffic stress test)
+                if self.kv_layout == "paged":
+                    for i in range(len(ready)):
+                        self._free_slot(free[i])
                 for req, _ in ready:
                     req.complete(error=EngineClosed("engine stopped"))
                 return True
@@ -1166,11 +1195,10 @@ class GenerateEngine(_EngineBase):
                     max_total=min(int(lengths[i]) + int(req.kw.get("max_new_tokens", 64)), self.max_len),
                     eos=req.kw.get("eos_token_id", self.eos_token_id),
                     first_token=tok,
-                    admit_seq=getattr(self, "_admit_seq", 0),
+                    admit_seq=self._admit_seq,
                     prompt_tokens=toks,
                 )
-                if self.kv_layout == "paged":
-                    self._admit_seq += 1
+                self._admit_seq += 1
                 self.slots[free[i]] = slot
                 self._emit(slot, tok)
                 self._maybe_finish(free[i])
@@ -1215,6 +1243,12 @@ class GenerateEngine(_EngineBase):
             wt = self.pages_per_slot if self.kv_layout == "paged" else 0
             packed = np.zeros((4 + wt, n), np.int32)
             temps = np.zeros((n,), np.float32)
+            if self.kv_layout != "paged":
+                # non-active rows (empty OR chunk-prefilling) write at an
+                # out-of-bounds position so the masked-select append drops
+                # them — a position-0 write would corrupt a prefilling
+                # slot's first token (paged masks via OOB table rows instead)
+                packed[1, :] = self._cache_len
             for i in active:
                 s = self.slots[i]
                 packed[0, i] = s.last_token

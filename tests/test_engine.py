@@ -432,6 +432,64 @@ class TestEngineSupervision:
             eng.stop()
 
 
+class TestSlotChunkedPrefill:
+    """Chunked prefill on the SLOT layout: families whose prefill accepts
+    offsets (SLOT_CHUNKED_PREFILL) stream long prompts in chunks without the
+    paged cache."""
+
+    def test_long_prompt_matches_reference_slot_layout(self, gen_setup):
+        cfg, params, ref = gen_setup
+        eng = make_gen_engine(cfg, params, make_container(), prefill_buckets=[8])
+        assert eng.kv_layout == "slot" and eng._chunked_ok
+        long_prompt = [(7 * i) % 190 + 1 for i in range(21)]
+        short = [[i + 1, (2 * i) % 99 + 1] for i in range(2)]
+        want_long = ref(long_prompt, 6)
+        want_short = [ref(p, 4) for p in short]
+        results = {"long": None, "short": [None, None]}
+
+        def run_long():
+            results["long"] = eng.generate(long_prompt, max_new_tokens=6, timeout=300)
+
+        def run_short(i):
+            results["short"][i] = eng.generate(short[i], max_new_tokens=4, timeout=300)
+
+        try:
+            threads = [threading.Thread(target=run_long)] + [
+                threading.Thread(target=run_short, args=(i,)) for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert results["long"] is not None
+            assert results["long"]["tokens"] == want_long, "slot chunked prefill diverged"
+            assert [r["tokens"] for r in results["short"]] == want_short
+        finally:
+            eng.stop()
+
+    def test_gpt2_long_prompt_slot_chunked(self):
+        from gofr_tpu.models import GPT2Config, gpt2
+
+        cfg = GPT2Config.tiny()
+        params = gpt2.init(cfg, jax.random.key(5))
+
+        def ref(prompt, n):
+            seq = list(prompt)
+            for _ in range(n):
+                logits = gpt2.forward(cfg, params, jnp.asarray([seq], jnp.int32))
+                seq.append(int(jnp.argmax(logits[0, -1])))
+            return seq[len(prompt):]
+
+        eng = GenerateEngine(gpt2, cfg, params, make_container(), slots=2,
+                             max_len=64, max_prefill_batch=2, prefill_buckets=[8])
+        long_prompt = [(3 * i) % 200 + 1 for i in range(19)]
+        try:
+            out = eng.generate(long_prompt, max_new_tokens=5, timeout=300)
+            assert out["tokens"] == ref(long_prompt, 5), "gpt2 chunked diverged"
+        finally:
+            eng.stop()
+
+
 class TestPagedGenerateEngine:
     """GenerateEngine on the paged KV cache (ops.paged): identical results
     to the sequential reference, page accounting, preemption-by-recompute."""

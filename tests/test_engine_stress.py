@@ -1,0 +1,102 @@
+"""Concurrency stress on the serving engine — the `-race`-style tier the
+reference never had (SURVEY §5.2: its CI doesn't even run -race). Storm the
+engine with concurrent submits, cancellations, timeouts, and a mid-traffic
+stop; the invariants are: no deadlock, every request completes exactly once
+(result or error), and non-cancelled greedy results stay token-exact."""
+
+import random
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import LlamaConfig, llama
+from gofr_tpu.tpu.engine import EngineClosed, GenerateEngine
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(7))
+
+    def ref(prompt, n):
+        seq = list(prompt)
+        for _ in range(n):
+            logits = llama.forward(cfg, params, jnp.asarray([seq], jnp.int32))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        return seq[len(prompt):]
+
+    return cfg, params, ref
+
+
+@pytest.mark.parametrize("kv_layout", ["slot", "paged"])
+def test_submit_cancel_storm(setup, kv_layout):
+    cfg, params, ref = setup
+    kw = dict(slots=4, max_len=64, max_prefill_batch=2)
+    if kv_layout == "paged":
+        kw.update(kv_layout="paged", page_size=8, total_pages=20)
+    eng = GenerateEngine(llama, cfg, params, new_mock_container(), **kw)
+    rng = random.Random(0)
+    n_req = 24
+    prompts = [[rng.randrange(1, 200) for _ in range(rng.randrange(2, 6))]
+               for _ in range(n_req)]
+    want = {i: ref(p, 6) for i, p in enumerate(prompts)}
+    outcomes: dict[int, object] = {}
+    lock = threading.Lock()
+
+    def client(i):
+        req = eng.submit(prompts[i], max_new_tokens=6, timeout=120)
+        if i % 5 == 0:
+            time.sleep(rng.random() * 0.02)
+            req.cancel()
+        try:
+            res = req.result(120)
+        except Exception as e:  # noqa: BLE001
+            res = e
+        with lock:
+            assert i not in outcomes, f"request {i} completed twice"
+            outcomes[i] = res
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(n_req)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert len(outcomes) == n_req, "a request never completed (deadlock?)"
+        for i, res in outcomes.items():
+            if isinstance(res, dict):
+                assert res["tokens"] == want[i], f"request {i} diverged under storm"
+            else:
+                assert i % 5 == 0, f"non-cancelled request {i} failed: {res}"
+        if kv_layout == "paged":
+            assert sorted(eng._free_pages) == list(range(eng.total_pages)), "page leak"
+    finally:
+        eng.stop()
+
+
+def test_stop_mid_traffic_fails_everything_and_frees_state(setup):
+    cfg, params, _ = setup
+    eng = GenerateEngine(llama, cfg, params, new_mock_container(),
+                         slots=2, max_len=64, max_prefill_batch=2,
+                         kv_layout="paged", page_size=8)
+    reqs = [eng.submit([i + 1, i + 2], max_new_tokens=40, timeout=120)
+            for i in range(12)]
+    time.sleep(0.3)  # let some admit / decode
+    eng.stop()
+    finished = errored = 0
+    for r in reqs:
+        try:
+            r.result(10)
+            finished += 1
+        except EngineClosed:
+            errored += 1
+        except Exception:  # noqa: BLE001 - timeout path also acceptable
+            errored += 1
+    assert finished + errored == 12, "a request hung across stop()"
+    assert errored > 0, "stop() during load completed everything — premise broken"
+    assert sorted(eng._free_pages) == list(range(eng.total_pages))
+    assert all(s is None for s in eng.slots)
