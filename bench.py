@@ -130,6 +130,13 @@ def _peak_bw(device) -> float:
     return 819e9  # assume v5e-class when unknown
 
 
+def _pallas_active() -> bool:
+    """The single source of truth for whether 'auto' resolves to kernels."""
+    from gofr_tpu.ops.pallas import flash_attention_available
+
+    return flash_attention_available()
+
+
 def _percentile(xs: list[float], p: float) -> float:
     ys = sorted(xs)
     idx = min(len(ys) - 1, max(0, int(round(p / 100.0 * (len(ys) - 1)))))
@@ -328,6 +335,11 @@ def main() -> None:
         "n_params": n_params,
         "quantize": quantize or "bf16",
         "param_bytes": int(param_bytes),
+        # kernels are opt-in after the round-3 A/B: XLA beat the Pallas
+        # kernels on v5e on both prefill and decode (BASELINE.md, round-3
+        # hardware validation notes); re-check with GOFR_BENCH_PALLAS_AB=1
+        "pallas": "on" if _pallas_active()
+                  else "off by default (XLA faster on v5e; see BASELINE.md)",
         "mfu": round(mfu, 4) if mfu is not None else None,
         "mbu_decode_lb": round(mbu, 4) if mbu is not None else None,
         "ttft_p50_s": round(_percentile(m["ttfts"], 50), 4),

@@ -152,6 +152,28 @@ class TestGenerateEngine:
         finally:
             eng.stop()
 
+    def test_stream_iterator_cancel_frees_slot(self, gen_setup):
+        """Transports call stream.cancel() on client disconnect; the request
+        must complete (as timeout) and the slot must come free without the
+        engine decoding to max_new_tokens for a ghost client."""
+        cfg, params, ref = gen_setup
+        eng = make_gen_engine(cfg, params, make_container(), decode_chunk=1)
+        try:
+            it = eng.generate(list(range(1, 6)), max_new_tokens=400,
+                              timeout=120, stream=True)
+            first = next(it)
+            assert isinstance(first, int)
+            it.cancel()
+            with pytest.raises(Exception):
+                for _ in it:  # drains until the engine reports the timeout
+                    pass
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and any(s is not None for s in eng.slots):
+                time.sleep(0.05)
+            assert all(s is None for s in eng.slots), "cancel left a ghost slot"
+        finally:
+            eng.stop()
+
     def test_timeout_frees_slot(self, gen_setup):
         """A timed-out request raises AND its slot is reclaimed."""
         cfg, params, ref = gen_setup

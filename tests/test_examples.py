@@ -77,6 +77,29 @@ def test_serving_llm_sse_streaming():
         assert tokens == want_tokens, f"streamed {tokens} != unary {want_tokens}"
 
 
+def test_serving_llm_sse_disconnect_frees_slot():
+    """After a client drops the SSE connection mid-stream, the engine's
+    slot must come free (via cancellation or completion — no ghost slot)."""
+    app = load_example("serving-llm").build_app()
+    with AppHarness(app) as h, httpx.Client(base_url=h.base, timeout=300) as c:
+        engine = app.container.engines["lm"]
+        with c.stream("POST", "/generate/stream",
+                      json={"prompt": [1, 2, 3], "max_new_tokens": 50,
+                            "timeout": 300}) as r:
+            assert r.status_code == 200
+            for line in r.iter_lines():
+                if line.startswith("data: "):
+                    break  # first token arrived; drop the connection
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(s is None for s in engine.slots) and not engine._pending:
+                break
+            time.sleep(0.1)
+        assert all(s is None for s in engine.slots), (
+            "slot still occupied long after the client disconnected"
+        )
+
+
 def test_serving_llm_websocket_streaming():
     """One websocket message per token (reference websocket.go:37-53 parity,
     but token-granular), terminated by a done frame."""
