@@ -484,13 +484,20 @@ class App:
     # -- subscription manager (gofr subscriber.go) -----------------------------
 
     def _start_subscribers(self) -> None:
+        # SUBSCRIBER_WORKERS > 1 runs N consumer threads per topic — the
+        # consumer-group-partition parallelism analog (subscriber.go spawns
+        # one goroutine per topic). With a model engine in the handler, the
+        # concurrent handlers are what lets the engine micro-batch: N
+        # in-flight messages fill one device batch instead of serializing.
+        workers = max(1, self.config.get_int("SUBSCRIBER_WORKERS", 1))
         for topic, handler in self._subscriptions.items():
-            t = threading.Thread(
-                target=self._subscribe_loop, args=(topic, handler),
-                name=f"gofr-sub-{topic}", daemon=True,
-            )
-            t.start()
-            self._sub_threads.append(t)
+            for w in range(workers):
+                t = threading.Thread(
+                    target=self._subscribe_loop, args=(topic, handler),
+                    name=f"gofr-sub-{topic}-{w}", daemon=True,
+                )
+                t.start()
+                self._sub_threads.append(t)
 
     def _subscribe_loop(self, topic: str, handler: Handler) -> None:
         container = self.container

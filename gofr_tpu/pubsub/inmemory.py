@@ -20,6 +20,9 @@ class InMemoryBroker:
         self._logs: dict[str, list[bytes]] = {}
         self._offsets: dict[tuple[str, str], int] = {}  # committed offset
         self._cursor: dict[tuple[str, str], int] = {}  # next delivery position
+        # out-of-order commits (concurrent consumer workers): positions
+        # committed ahead of the contiguous prefix wait here
+        self._done: dict[tuple[str, str], set[int]] = {}
         self._cond = threading.Condition()
         self._closed = False
 
@@ -46,15 +49,26 @@ class InMemoryBroker:
                         topic,
                         value,
                         metadata={"offset": pos, "group": group},
-                        committer=lambda p=pos: self._commit(key, p + 1),
+                        committer=lambda p=pos: self._commit(key, p),
                     )
                 if not self._cond.wait(timeout=timeout):
                     return None
 
-    def _commit(self, key: tuple[str, str], offset: int) -> None:
+    def _commit(self, key: tuple[str, str], pos: int) -> None:
+        """Advance the committed offset only across a CONTIGUOUS prefix of
+        committed positions. With concurrent workers (SUBSCRIBER_WORKERS),
+        a fast worker's higher commit must not acknowledge a slower
+        worker's still-uncommitted (possibly failed) message — the group
+        offset stays at the first gap, so a crash/rewind redelivers it
+        (at-least-once; matches per-partition Kafka semantics)."""
         with self._cond:
-            if offset > self._offsets.get(key, 0):
-                self._offsets[key] = offset
+            done = self._done.setdefault(key, set())
+            done.add(pos)
+            offset = self._offsets.get(key, 0)
+            while offset in done:
+                done.discard(offset)
+                offset += 1
+            self._offsets[key] = offset
 
     def rewind_uncommitted(self, topic: str, group: str = "default") -> None:
         """Redeliver messages consumed but never committed (crash simulation)."""

@@ -34,7 +34,13 @@ class KafkaBroker:
         self._producer.send(topic, encode_payload(payload)).get(timeout=30)
 
     def _consumer(self, topic: str, group: str):
-        key = (topic, group)
+        # Keyed by calling THREAD as well: KafkaConsumer is not thread-safe,
+        # and concurrent subscriber workers (SUBSCRIBER_WORKERS > 1) must
+        # each join the group as their own member — the group coordinator
+        # then assigns them disjoint partitions, which is exactly how Kafka
+        # scales a consumer group (and why per-worker commits stay safe:
+        # commits are per-partition and each partition has one owner).
+        key = (topic, group, threading.get_ident())
         with self._lock:
             if key not in self._consumers:
                 self._consumers[key] = self._KafkaConsumer(
@@ -51,6 +57,8 @@ class KafkaBroker:
         records = consumer.poll(timeout_ms=timeout_ms, max_records=1)
         for batch in records.values():
             for record in batch:
+                # max_records=1 ⇒ this consumer's position only covers the
+                # one in-flight record, so commit() acknowledges exactly it
                 return Message(
                     topic,
                     record.value,

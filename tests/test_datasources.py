@@ -165,3 +165,32 @@ def test_container_config_gating():
     assert c.file is not None  # always wired (container.go:123)
     c2 = Container.create(DictConfig({"DB_DIALECT": "sqlite"}))
     assert c2.sql is not None
+
+
+def test_inmemory_broker_concurrent_commit_keeps_at_least_once():
+    """With concurrent consumer workers, a fast worker's higher-offset commit
+    must NOT acknowledge a slower worker's uncommitted message: the group
+    offset advances only across the contiguous committed prefix, so a rewind
+    (crash/restart) redelivers the gap."""
+    from gofr_tpu.pubsub.inmemory import InMemoryBroker
+
+    b = InMemoryBroker()
+    for i in range(3):
+        b.publish("t", {"n": i})
+    m0 = b.subscribe("t", group="g", timeout=1)   # worker A takes offset 0
+    m1 = b.subscribe("t", group="g", timeout=1)   # worker B takes offset 1
+    m2 = b.subscribe("t", group="g", timeout=1)
+    assert [m.bind()["n"] for m in (m0, m1, m2)] == [0, 1, 2]
+    m1.commit()   # B succeeds first (out of order)
+    m2.commit()
+    # A's handler failed: never commits. Offset must still sit at 0.
+    b.rewind_uncommitted("t", group="g")
+    redelivered = b.subscribe("t", group="g", timeout=1)
+    assert redelivered is not None and redelivered.bind()["n"] == 0, (
+        "failed message was lost — at-least-once violated"
+    )
+    redelivered.commit()
+    # prefix now complete: 0,1,2 all committed — nothing left to redeliver
+    b.rewind_uncommitted("t", group="g")
+    assert b.subscribe("t", group="g", timeout=0.1) is None
+    b.close()

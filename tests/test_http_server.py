@@ -302,3 +302,27 @@ def test_profile_route_gated_on_debug_env():
             assert c.get("/debug/profile", params={"seconds": "nan3"}).status_code == 400
     finally:
         shutil.rmtree(out_dir, ignore_errors=True)
+
+
+def test_subscriber_workers_parallel_consumption():
+    """SUBSCRIBER_WORKERS=N runs N consumer threads per topic (consumer-group
+    partition parallelism analog); every message is processed exactly once."""
+    app = make_app({"SUBSCRIBER_WORKERS": "4"})
+    seen, lock = [], threading.Lock()
+
+    def handler(ctx):
+        body = ctx.bind(dict)
+        time.sleep(0.05)  # hold the worker so parallelism matters
+        with lock:
+            seen.append(body["n"])
+
+    app.subscribe("jobs", handler)
+    with AppHarness(app):
+        names = [t.name for t in app._sub_threads]
+        assert len([n for n in names if n.startswith("gofr-sub-jobs")]) == 4
+        for i in range(12):
+            app.container.pubsub.publish("jobs", {"n": i})
+        deadline = time.time() + 15
+        while time.time() < deadline and len(seen) < 12:
+            time.sleep(0.02)
+    assert sorted(seen) == list(range(12)), seen
