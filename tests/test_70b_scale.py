@@ -57,6 +57,9 @@ _WORKER = textwrap.dedent("""
     assert "mhlo.sharding" in text or "sdy.sharding" in text, (
         "no sharding annotations in the lowered 70B prefill")
     print("PREFILL_LOWERED bytes:", len(text))
+    hlo_p = lowered.compile().as_text()
+    assert "all-reduce" in hlo_p, "compiled 70B prefill has no tp all-reduce"
+    print("PREFILL_COMPILED collectives:", hlo_p.count("all-reduce"))
 
     def decode(params, tokens, positions, cache):
         return llama.decode_step(cfg, params, tokens, positions, cache)
@@ -84,7 +87,12 @@ _WORKER = textwrap.dedent("""
         jax.ShapeDtypeStruct((8,), jnp.int32),
     )
     print("TRAIN_LOWERED bytes:", len(lowered_t.as_text()))
-    n_params = sum(int(jnp.prod(jnp.array(s.shape))) for s in jax.tree.leaves(shapes))
+    hlo_t = lowered_t.compile().as_text()
+    assert "all-reduce" in hlo_t, "compiled 70B train step has no collectives"
+    print("TRAIN_COMPILED collectives:", hlo_t.count("all-reduce"))
+    import math
+    n_params = sum(math.prod(s.shape) for s in jax.tree.leaves(shapes))
+    assert 6.5e10 < n_params < 7.5e10, f"not 70B-scale: {n_params}"
     print(f"SCALE_OK params={n_params/1e9:.1f}B mesh=dp:2,fsdp:4,tp:8 devices=64")
 """)
 
