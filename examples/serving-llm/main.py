@@ -25,9 +25,11 @@ def build_app(config=None, *, preset: str = "tiny") -> App:
     spec = ModelSpec("llama", cfg, task="generate", dtype=dtype)
     app.serve_model("lm", spec, slots=4, max_len=64)
 
-    def generate(ctx):
+    async def generate(ctx):
+        # async handler + agenerate: awaits the engine future on the event
+        # loop instead of parking a handler thread per in-flight request
         body = ctx.bind(dict)
-        return ctx.generate(
+        return await ctx.agenerate(
             "lm", body["prompt"],
             max_new_tokens=int(body.get("max_new_tokens", 8)),
             temperature=float(body.get("temperature", 0.0)),

@@ -119,6 +119,49 @@ class Context:
     def generate(self, model: str, prompt: Any, max_new_tokens: int = 64, **kw: Any):
         return self.container.generate(model, prompt, max_new_tokens=max_new_tokens, **kw)
 
+    async def agenerate(self, model: str, prompt: Any, max_new_tokens: int = 64, **kw: Any):
+        """Async-native generate for ``async def`` handlers: awaits the
+        engine future via a completion callback — no thread parks per
+        in-flight request, so one event loop sustains hundreds of
+        concurrent generations."""
+        import asyncio
+
+        engine = self.container.engine(model)
+        timeout = kw.get("timeout", None)
+        if timeout is None:
+            timeout = getattr(engine, "default_timeout", None)
+        req = engine.submit(prompt, max_new_tokens=max_new_tokens, **kw)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def on_done(r) -> None:
+            def resolve() -> None:
+                if fut.cancelled():
+                    return
+                result, error = r.outcome()
+                if error is not None:
+                    fut.set_exception(error)
+                else:
+                    fut.set_result(result)
+            loop.call_soon_threadsafe(resolve)
+
+        req.add_done_callback(on_done)
+        try:
+            # the client-side backstop Request.result() has: a wedged device
+            # thread never calls complete(), so the await must time out on
+            # its own rather than hang the handler forever
+            if timeout:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        except asyncio.TimeoutError:
+            req.cancel()
+            from gofr_tpu.http.errors import RequestTimeout
+
+            raise RequestTimeout() from None
+        except asyncio.CancelledError:
+            req.cancel()  # free the slot when the client went away
+            raise
+
     # -- tracing & scratch values ---------------------------------------------
 
     def trace(self, name: str) -> Span:

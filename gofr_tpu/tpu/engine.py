@@ -67,7 +67,8 @@ class Request:
     _ids = itertools.count()
 
     __slots__ = ("id", "inputs", "kw", "enqueued_at", "deadline", "stream_q",
-                 "_done", "_result", "_error", "cancelled", "_complete_lock")
+                 "_done", "_result", "_error", "cancelled", "_complete_lock",
+                 "_callbacks")
 
     def __init__(self, inputs: Any, kw: dict[str, Any], timeout: float | None, stream: bool = False):
         self.id = next(Request._ids)
@@ -80,6 +81,7 @@ class Request:
         self._complete_lock = threading.Lock()
         self._result: Any = None
         self._error: Exception | None = None
+        self._callbacks: list = []
         self.cancelled = False
 
     def complete(self, result: Any = None, error: Exception | None = None) -> None:
@@ -93,6 +95,32 @@ class Request:
             if self.stream_q is not None:
                 self.stream_q.put(None)  # sentinel
             self._done.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:  # outside the lock: callbacks may be arbitrary
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 - a bad callback must not kill the engine
+                import traceback
+
+                traceback.print_exc()  # surfaced, not swallowed: a dropped
+                # callback means some awaiter never resolves
+
+    def add_done_callback(self, fn) -> None:
+        """Invoke ``fn(request)`` on completion (immediately if already
+        done). This is how asyncio transports await an engine future without
+        parking a thread per in-flight request."""
+        with self._complete_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def outcome(self) -> tuple[Any, Exception | None]:
+        """(result, error) once complete — the non-blocking accessor done
+        callbacks use, so outcome extraction lives in one place."""
+        if not self._done.is_set():
+            raise RuntimeError("request is not complete")
+        return self._result, self._error
 
     def cancel(self) -> None:
         self.cancelled = True

@@ -741,3 +741,84 @@ class TestPagedGenerateEngine:
             assert [r["tokens"] for r in results] == want
         finally:
             eng.stop()
+
+
+class TestAsyncAwaitPath:
+    """Request.add_done_callback + ctx.agenerate: the asyncio-native await
+    path transports use (no thread parked per in-flight request)."""
+
+    def test_done_callback_before_and_after_completion(self):
+        calls = []
+        req = Request([1], {}, timeout=None)
+        req.add_done_callback(lambda r: calls.append(("pre", r.outcome())))
+        req.complete(result={"ok": 1})
+        assert calls == [("pre", ({"ok": 1}, None))]
+        # already-done: fires immediately
+        req.add_done_callback(lambda r: calls.append(("post", r.outcome())))
+        assert calls[-1] == ("post", ({"ok": 1}, None))
+        # idempotent complete must not re-fire callbacks
+        req.complete(result={"ok": 2})
+        assert len(calls) == 2
+
+    def test_outcome_before_completion_raises(self):
+        req = Request([1], {}, timeout=None)
+        with pytest.raises(RuntimeError, match="not complete"):
+            req.outcome()
+
+    def test_callback_exception_does_not_break_completion(self, capsys):
+        req = Request([1], {}, timeout=None)
+        req.add_done_callback(lambda r: 1 / 0)
+        seen = []
+        req.add_done_callback(lambda r: seen.append(True))
+        req.complete(result="x")
+        assert seen == [True]  # later callbacks still ran
+        assert "ZeroDivisionError" in capsys.readouterr().err
+
+    def test_agenerate_roundtrip_and_error(self, gen_setup):
+        import asyncio
+
+        from gofr_tpu.context import Context
+
+        cfg, params, ref = gen_setup
+        container = make_container()
+        eng = make_gen_engine(cfg, params, container)
+        container.register_engine("lm", eng)
+        ctx = Context(None, container)
+        try:
+            out = asyncio.run(ctx.agenerate("lm", [5, 3, 9], max_new_tokens=6,
+                                            timeout=120))
+            assert out["tokens"] == ref([5, 3, 9], 6)
+            # errors propagate through the future
+            with pytest.raises(ValueError, match="max_len"):
+                asyncio.run(ctx.agenerate("lm", list(range(100)),
+                                          max_new_tokens=2, timeout=60))
+        finally:
+            eng.stop()
+
+    def test_agenerate_timeout_backstop_on_wedged_engine(self, gen_setup):
+        """A wedged device thread never calls complete(); the async client
+        must still time out instead of hanging the handler forever."""
+        import asyncio
+
+        from gofr_tpu.context import Context
+        from gofr_tpu.http.errors import RequestTimeout
+
+        cfg, params, _ = gen_setup
+        container = make_container()
+        eng = make_gen_engine(cfg, params, container)
+
+        def wedge(*a, **kw):
+            time.sleep(60)
+
+        eng._prefill_sample = wedge
+        container.register_engine("lm", eng)
+        ctx = Context(None, container)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(RequestTimeout):
+                asyncio.run(ctx.agenerate("lm", [5, 3], max_new_tokens=2,
+                                          timeout=1.5))
+            assert time.monotonic() - t0 < 10
+        finally:
+            eng._poisoned = True  # don't wait for the wedge in stop()
+            eng._stop.set()
