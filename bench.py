@@ -26,6 +26,7 @@ Env knobs:
     GOFR_BENCH_PLATFORM       force 'cpu' or 'tpu' (skips the probe)
     GOFR_BENCH_PROBE_S        TPU init probe timeout seconds (default 240)
     GOFR_BENCH_KV             'slot' (default) | 'paged' engine KV layout
+    GOFR_BENCH_PIPELINE       decode dispatch pipelining depth (default 2; 1 = sync)
     GOFR_BENCH_LATENCY        1 = also measure sequential single-request latency
     GOFR_BENCH_SWEEP          1 = sweep slots x decode_chunk, keep best
     GOFR_BENCH_PALLAS_AB      1 = record kernel-on/off engine A/B
@@ -261,10 +262,19 @@ def main() -> None:
         # a typo'd layout must not silently bench slot while REPORTING the typo
         raise SystemExit(f"GOFR_BENCH_KV={kv_layout!r}: use 'slot' or 'paged'")
 
+    # dispatch pipelining (engine default 2): chunk t+1 is dispatched before
+    # chunk t is read back, hiding the per-step readback RTT. 1 = synchronous.
+    # Validate here: the engine clamps silently, and the report must never
+    # state a depth that was not actually benched (same rule as GOFR_BENCH_KV).
+    pipeline_env = os.environ.get("GOFR_BENCH_PIPELINE", "2")
+    if pipeline_env not in ("1", "2"):
+        raise SystemExit(f"GOFR_BENCH_PIPELINE={pipeline_env!r}: use 1 (sync) or 2 (pipelined)")
+    pipeline = int(pipeline_env)
+
     def engine_kw(s: int, k: int) -> dict:
         kw = dict(slots=s, max_len=prompt_len + max_new + 8,
                   max_prefill_batch=prefill_batch, decode_chunk=k,
-                  prefill_buckets=[prompt_len])
+                  prefill_buckets=[prompt_len], decode_pipeline=pipeline)
         if kv_layout == "paged":
             kw.update(kv_layout="paged", page_size=128)
         return kw
@@ -328,6 +338,7 @@ def main() -> None:
         "max_new_tokens": max_new,
         "slots": best[0],
         "decode_chunk": best[1],
+        "decode_pipeline": pipeline,
         "platform": device.platform,
         "device_kind": getattr(device, "device_kind", "?"),
         "backend": backend_diag,

@@ -24,6 +24,7 @@ steady-state serving is 100% cache hits (tracked in app_tpu_* metrics).
 
 from __future__ import annotations
 
+import collections
 import itertools
 import queue
 from functools import partial
@@ -463,7 +464,7 @@ class _Slot:
 
     __slots__ = ("request", "prompt_len", "pos", "generated", "max_total", "eos",
                  "last_token", "first_token_at", "admit_seq", "prompt_tokens",
-                 "written")
+                 "written", "inflight")
 
     def __init__(self, request: Request, prompt_len: int, max_total: int, eos: int | None,
                  first_token: int | None, admit_seq: int = 0, prompt_tokens: Any = None):
@@ -478,6 +479,7 @@ class _Slot:
         self.admit_seq = admit_seq       # preemption order (paged layout)
         self.prompt_tokens = prompt_tokens  # kept for preemption re-prefill
         self.written = prompt_len if first_token is not None else 0
+        self.inflight = 0  # decode chunks dispatched but not yet processed
 
     @property
     def prefilling(self) -> bool:
@@ -529,6 +531,7 @@ class GenerateEngine(_EngineBase):
         page_size: int = 128,
         total_pages: int | None = None,
         max_restarts: int = 3,
+        decode_pipeline: int = 2,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -606,6 +609,17 @@ class GenerateEngine(_EngineBase):
         self._admit_seq = 0  # admission order (preemption picks newest)
         self._base_key = jax.random.key(seed)
         self._step_count = 0
+        # Pipelined decode (depth 2 = one chunk in flight): chunk t+1 is
+        # dispatched BEFORE chunk t's tokens are read back, so the ~RTT of
+        # device→host readback + host bookkeeping overlaps the next chunk's
+        # compute. The data dependency (t+1's input token = t's last output)
+        # stays ON DEVICE via the `prev_last` carry; the host only overrides
+        # it (use_host flag) for lanes it has exact state for. Depth 1 is the
+        # fully synchronous path. Over the round-3 tunnel (~100ms/sync) this
+        # is the difference between RTT-bound and compute-bound decode.
+        self.decode_pipeline = max(1, min(2, int(decode_pipeline)))
+        self._dq: collections.deque = collections.deque()  # dispatched, unprocessed
+        self._prev_last = None  # device-resident [slots] last-sampled-token carry
 
         ts = (top_k, top_p)
         W = self.pages_per_slot if kv_layout == "paged" else 1
@@ -621,9 +635,15 @@ class GenerateEngine(_EngineBase):
         #   [:, :lb] tokens | [:, lb] lengths | [:, lb+1:lb+1+W] rows
         #   | [:, lb+1+W] temps (f32 bitcast) | [0, lb+2+W] rng step
         # Chunked-prefill adds an offsets column before temps.
-        # Decode packed layout [3 + W_t, n] (W_t = pages_per_slot table rows
+        # Decode packed layout [5 + W_t, n] (W_t = pages_per_slot table rows
         # for paged, 0 for slot):
-        #   [0] tokens | [1] positions | [2] temps | [3 0] rng step | [4:] table.T
+        #   [0] tokens | [1] positions | [2] temps | [3 0] rng step
+        #   | [4] use_host flags | [5:] table.T
+        # Row 4 arbitrates the input token per lane: 1 = take the host's
+        # packed token (lane just (re)joined decode — prefill sampled its
+        # first token, or its previous chunk was already processed); 0 = take
+        # the on-device `prev_last` carry from the previous dispatched chunk
+        # (lane has a chunk in flight the host hasn't read back yet).
 
         def _unpack_prefill(packed, w, chunked=False):
             extra = 1 if chunked else 0
@@ -660,11 +680,12 @@ class GenerateEngine(_EngineBase):
             self._chunk_prefill = _chunk_prefill
 
             @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
-            def _decode_chunk(params, base_key, cache, steps, packed):
-                tokens, positions = packed[0], packed[1]
+            def _decode_chunk(params, base_key, cache, steps, packed, prev_last):
+                tokens = jnp.where(packed[4] != 0, packed[0], prev_last)
+                positions = packed[1]
                 temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
                 key = jax.random.fold_in(base_key, packed[3, 0])
-                table = packed[4:].T
+                table = packed[5:].T
 
                 def body(carry, _):
                     toks, pos, cache, key = carry
@@ -676,7 +697,7 @@ class GenerateEngine(_EngineBase):
                 (toks, pos, cache, key), out = jax.lax.scan(
                     body, (tokens, positions, cache, key), None, length=steps
                 )
-                return out.T, cache  # [slots, K]
+                return out.T, toks, cache  # [slots, K], [slots] carry
         else:
             @partial(jax.jit, donate_argnums=(2,))
             def _prefill_sample(params, base_key, cache, packed):
@@ -701,8 +722,9 @@ class GenerateEngine(_EngineBase):
                 self._chunk_prefill = _chunk_prefill
 
             @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
-            def _decode_chunk(params, base_key, cache, steps, packed):
-                tokens, positions = packed[0], packed[1]
+            def _decode_chunk(params, base_key, cache, steps, packed, prev_last):
+                tokens = jnp.where(packed[4] != 0, packed[0], prev_last)
+                positions = packed[1]
                 temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
                 key = jax.random.fold_in(base_key, packed[3, 0])
 
@@ -716,7 +738,7 @@ class GenerateEngine(_EngineBase):
                 (toks, pos, cache, key), out = jax.lax.scan(
                     body, (tokens, positions, cache, key), None, length=steps
                 )
-                return out.T, cache  # [slots, K]
+                return out.T, toks, cache  # [slots, K], [slots] carry
 
         self._prefill_sample = _prefill_sample
         self._decode_chunk = _decode_chunk
@@ -775,11 +797,14 @@ class GenerateEngine(_EngineBase):
                 count += 1
         n, k = self.num_slots, self.decode_chunk
         wt = self.pages_per_slot if self.kv_layout == "paged" else 0
-        packed = np.zeros((4 + wt, n), np.int32)
+        packed = np.zeros((5 + wt, n), np.int32)
         if self.kv_layout == "paged":
-            packed[4:] = self.total_pages  # OOB table: writes dropped
-        out, self.cache = self._decode_chunk(
-            self.params, self._base_key, self.cache, k, jnp.asarray(packed)
+            packed[5:] = self.total_pages  # OOB table: writes dropped
+        else:
+            packed[1, :] = self._cache_len  # OOB positions: writes dropped
+        out, _, self.cache = self._decode_chunk(
+            self.params, self._base_key, self.cache, k, jnp.asarray(packed),
+            jnp.zeros((n,), jnp.int32),
         )
         jax.block_until_ready(out)
         self._compiled.add(("decode", n, k))
@@ -971,13 +996,20 @@ class GenerateEngine(_EngineBase):
         return [i for i, s in enumerate(self.slots) if s is not None and s.prefilling]
 
     def _loop(self) -> None:
+        self._dq.clear()  # a restarted loop must not read a dead life's futures
+        self._prev_last = None
         while not self._stop.is_set() and not self._poisoned:
             admitted = self._admit()
             # one chunk of ONE long prompt per iteration, so decode of the
             # other slots keeps stepping between chunks (TTFT fairness)
             chunked = self._advance_chunked()
-            stepped = self._decode() if self._active() else False
-            if not admitted and not chunked and not stepped:
+            # pipelined decode: dispatch chunk t, then block on chunk t-1 —
+            # its readback + host bookkeeping overlap chunk t's compute
+            dispatched = self._dispatch_decode()
+            processed = False
+            while len(self._dq) > (self.decode_pipeline - 1 if dispatched else 0):
+                processed = self._process_decode() or processed
+            if not admitted and not chunked and not dispatched and not processed:
                 # idle: block briefly for work
                 try:
                     req = self._queue.get(timeout=0.2)
@@ -1234,23 +1266,40 @@ class GenerateEngine(_EngineBase):
 
     # -- decode ----------------------------------------------------------------
 
-    def _decode(self) -> bool:
+    def _dispatch_decode(self) -> bool:
+        """Assemble and asynchronously dispatch one decode chunk. Positions
+        are SPECULATIVE: a lane with a chunk already in flight decodes from
+        ``pos + k*inflight`` and takes its input token from the on-device
+        ``prev_last`` carry rather than the host (which hasn't read that
+        chunk back yet). Lanes guaranteed dead once their in-flight chunk is
+        processed (speculative pos >= max_total) are masked out, so writes
+        never exceed the existing decode_chunk cache slack. Returns True when
+        a chunk was dispatched."""
         with self._state_lock:
-            active = self._active()
-            if not active:
-                return False
             n = self.num_slots
             k = self.decode_chunk
 
+            # (slot index, slot, speculative position) for lanes that decode
+            lanes: list[tuple[int, _Slot, int]] = []
+            for i in self._active():
+                s = self.slots[i]
+                p = s.pos + k * s.inflight
+                if p >= s.max_total:
+                    continue  # will be freed when its in-flight chunk processes
+                lanes.append((i, s, p))
+            if not lanes:
+                return False
+
             if self.kv_layout == "paged":
-                # every active slot must own pages covering this chunk's writes
-                # (pos .. pos+k-1) BEFORE the table snapshot; pool exhaustion
-                # preempts the newest-admitted slot (LIFO, recompute on return)
-                for i in list(active):
-                    s = self.slots[i]
-                    if s is None:
-                        continue  # preempted by an earlier iteration's pressure
-                    while not self._ensure_pages(i, s.pos + k - 1):
+                # every decoding lane must own pages covering this chunk's
+                # writes (p .. p+k-1) BEFORE the table snapshot; pool
+                # exhaustion preempts the newest-admitted slot (LIFO,
+                # recompute on return) — possibly one of `lanes`, hence the
+                # identity re-checks after the loop
+                for i, s, p in list(lanes):
+                    if self.slots[i] is not s:
+                        continue  # evicted by an earlier lane's pool pressure
+                    while not self._ensure_pages(i, p + k - 1):
                         if not self._preempt_newest(except_slot=i):
                             # alone and still short — can't happen when
                             # total_pages >= pages_per_slot (ctor guard)
@@ -1258,8 +1307,8 @@ class GenerateEngine(_EngineBase):
                             s.request.complete(error=RuntimeError(
                                 "KV page pool exhausted for a single request"))
                             break
-                active = self._active()
-                if not active:
+                lanes = [(i, s, p) for i, s, p in lanes if self.slots[i] is s]
+                if not lanes:
                     return False
 
             # always the FULL chunk — one compiled decode program for the whole
@@ -1269,18 +1318,23 @@ class GenerateEngine(_EngineBase):
             # slots' tables carry the same slack via pages_per_slot). All host
             # inputs ride ONE packed array (layout at the jit definitions).
             wt = self.pages_per_slot if self.kv_layout == "paged" else 0
-            packed = np.zeros((4 + wt, n), np.int32)
+            packed = np.zeros((5 + wt, n), np.int32)
             temps = np.zeros((n,), np.float32)
             if self.kv_layout != "paged":
-                # non-active rows (empty OR chunk-prefilling) write at an
-                # out-of-bounds position so the masked-select append drops
-                # them — a position-0 write would corrupt a prefilling
-                # slot's first token (paged masks via OOB table rows instead)
+                # non-decoding rows (empty, chunk-prefilling, or dead-lane-
+                # masked) write at an out-of-bounds position so the masked-
+                # select append drops them — a position-0 write would corrupt
+                # a prefilling slot's first token (paged masks via OOB table
+                # rows instead)
                 packed[1, :] = self._cache_len
-            for i in active:
-                s = self.slots[i]
-                packed[0, i] = s.last_token
-                packed[1, i] = s.pos
+            for i, s, p in lanes:
+                if s.inflight == 0:
+                    # host knows this lane's exact last token (from prefill or
+                    # its last processed chunk); otherwise the device carry
+                    # from the in-flight chunk supplies it (use_host stays 0)
+                    packed[0, i] = s.last_token
+                    packed[4, i] = 1
+                packed[1, i] = p
                 temps[i] = float(s.request.kw.get("temperature", 0.0))
             packed[2] = temps.view(np.int32)
             self._step_count += 1
@@ -1291,28 +1345,50 @@ class GenerateEngine(_EngineBase):
                 # covers all rows uniformly) would corrupt its position 0
                 # otherwise; empty slots are already all-OOB via _free_slot
                 table_snapshot = self._table.copy()
-                for i in self._prefilling():
-                    table_snapshot[i, :] = self.total_pages
-                packed[4:] = table_snapshot.T
+                live = {i for i, _, _ in lanes}
+                for i in range(n):
+                    if i not in live:
+                        table_snapshot[i, :] = self.total_pages
+                packed[5:] = table_snapshot.T
 
-        t0 = time.monotonic()
-        chunk_dev, self.cache = self._decode_chunk(
-            self.params, self._base_key, self.cache, k, jnp.asarray(packed)
+            for _, s, _ in lanes:
+                s.inflight += 1
+            occupancy = len(lanes) / n
+            t0 = time.monotonic()
+
+        prev = self._prev_last
+        if prev is None:
+            prev = jnp.zeros((n,), jnp.int32)
+        chunk_dev, last_dev, self.cache = self._decode_chunk(
+            self.params, self._base_key, self.cache, k, jnp.asarray(packed), prev
         )
+        self._prev_last = last_dev
+        self._dq.append((chunk_dev, [(i, s) for i, s, _ in lanes], t0, occupancy, (n, k)))
+        return True
+
+    def _process_decode(self) -> bool:
+        """Block on the OLDEST dispatched chunk's tokens (overlapping any
+        younger chunk's compute) and fold them into slot state. Lanes whose
+        slot object changed since dispatch (freed, preempted, reassigned)
+        have their results discarded — the identity check is what makes
+        speculative dispatch safe."""
+        if not self._dq:
+            return False
+        chunk_dev, meta, t0, occupancy, (n, k) = self._dq.popleft()
         chunk = np.asarray(chunk_dev)  # [slots, k] int32 — tokens, never logits
         if self._poisoned:
             # stop() declared this thread wedged and already failed/cleared
             # everything; the slot/page state now belongs to the caller.
             return False
         with self._state_lock:
-            self._record_step("decode", time.monotonic() - t0, len(active) / n, ("decode", n, k))
+            self._record_step("decode", time.monotonic() - t0, occupancy, ("decode", n, k))
 
             now = time.monotonic()
             accepted = 0
-            for i in active:
-                s = self.slots[i]
-                if s is None:
-                    continue  # cleared by _fail_all while the step was in flight
+            for i, s in meta:
+                if self.slots[i] is not s:
+                    continue  # freed/preempted/reassigned while in flight
+                s.inflight -= 1
                 if s.request.cancelled or s.request.expired(now):
                     # slot invalidation: free the lane; in-flight work is discarded
                     self._free_slot(i)
@@ -1326,7 +1402,7 @@ class GenerateEngine(_EngineBase):
                     accepted += 1
                     self._emit(s, tok)
                     self._maybe_finish(i)
-                    if self.slots[i] is None:  # EOS/length mid-chunk: rest discarded
+                    if self.slots[i] is not s:  # EOS/length mid-chunk: rest discarded
                         break
             self.metrics.increment_counter("app_tpu_tokens_total", accepted)
             return True
@@ -1460,6 +1536,7 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             kv_layout=str(kw.pop("kv_layout", conf.get_or_default("ENGINE_KV_LAYOUT", default_layout))),
             page_size=int(kw.pop("page_size", conf.get_int("ENGINE_PAGE_SIZE", 128))),
             total_pages=int(kw.pop("total_pages", conf.get_int("ENGINE_TOTAL_PAGES", 0))) or None,
+            decode_pipeline=int(kw.pop("decode_pipeline", conf.get_int("ENGINE_DECODE_PIPELINE", 2))),
             eos_token_id=eos,
             tokenizer=tokenizer,
             default_timeout=default_timeout,

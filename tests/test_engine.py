@@ -743,6 +743,77 @@ class TestPagedGenerateEngine:
             eng.stop()
 
 
+class TestPipelinedDecode:
+    """Dispatch-pipelined decode (decode_pipeline=2, the default): chunk t+1
+    is dispatched before chunk t is read back, with the input token carried
+    on device. The load-bearing property: tokens are IDENTICAL to the fully
+    synchronous depth-1 path (greedy), on both KV layouts, including under
+    EOS, cancellation, and paged preemption pressure — the rest of the suite
+    already runs depth 2 everywhere since it is the default."""
+
+    @pytest.mark.parametrize("kv_layout", ["slot", "paged"])
+    def test_depth1_and_depth2_match_reference(self, gen_setup, kv_layout):
+        cfg, params, ref = gen_setup
+        prompts = [[i + 2, (3 * i) % 190 + 1, (11 * i) % 140 + 1] for i in range(6)]
+        want = [ref(p, 7) for p in prompts]
+        for depth in (1, 2):
+            kw = dict(slots=3, max_len=64, max_prefill_batch=2,
+                      decode_pipeline=depth, kv_layout=kv_layout)
+            if kv_layout == "paged":
+                kw["page_size"] = 8
+            eng = GenerateEngine(llama, cfg, params, new_mock_container(), **kw)
+            results = [None] * len(prompts)
+
+            def worker(i):
+                results[i] = eng.generate(prompts[i], max_new_tokens=7, timeout=300)
+
+            try:
+                threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300)
+                for i, r in enumerate(results):
+                    assert r is not None, f"depth={depth} request {i} did not complete"
+                    assert r["tokens"] == want[i], f"depth={depth} request {i} diverged"
+            finally:
+                eng.stop()
+
+    def test_inflight_bookkeeping_drains(self, gen_setup):
+        """After traffic fully drains, no slot is occupied and no dispatched
+        chunk is left unprocessed — the speculative counters returned to
+        rest state."""
+        cfg, params, _ = gen_setup
+        eng = make_gen_engine(cfg, params, make_container(), decode_pipeline=2)
+        try:
+            outs = [eng.generate([3, 1, 4], max_new_tokens=9, timeout=120) for _ in range(3)]
+            assert all(len(o["tokens"]) == 9 for o in outs)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and eng._dq:
+                time.sleep(0.05)
+            assert not eng._dq, "dispatched chunk never processed"
+            assert all(s is None for s in eng.slots)
+        finally:
+            eng.stop()
+
+    def test_pipelined_eos_discards_overshoot(self, gen_setup):
+        """A lane that hits EOS while its successor chunk is already in
+        flight must not leak the successor's tokens into the result."""
+        cfg, params, ref = gen_setup
+        want = ref([5, 3, 9], 24)
+        # pick the token the reference emits mid-way and use it as EOS
+        eos = want[10]
+        eng = make_gen_engine(cfg, params, make_container(),
+                              decode_pipeline=2, decode_chunk=4)
+        try:
+            out = eng.generate([5, 3, 9], max_new_tokens=24, timeout=120,
+                               eos_token_id=eos)
+            assert out["finish_reason"] == "stop"
+            assert out["tokens"] == want[:10]
+        finally:
+            eng.stop()
+
+
 class TestAsyncAwaitPath:
     """Request.add_done_callback + ctx.agenerate: the asyncio-native await
     path transports use (no thread parked per in-flight request)."""
