@@ -92,6 +92,8 @@ class Container:
         m.new_gauge("app_tpu_kv_pages_free", "free pages in the paged KV pool")
         m.new_counter("app_tpu_preemptions", "slots preempted under KV pool pressure")
         m.new_counter("app_tpu_engine_restarts", "engine device-thread restarts")
+        m.new_counter("app_tpu_prefix_hit_tokens", "prompt tokens served from the prefix cache")
+        m.new_gauge("app_tpu_prefix_cached_pages", "KV pages held by the prefix cache")
 
     def _sample_tpu_metrics(self, _registry=None) -> None:
         """Collect hook: live HBM gauges on every /metrics scrape (the

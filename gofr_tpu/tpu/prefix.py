@@ -1,0 +1,141 @@
+"""Automatic prefix caching for the paged KV layout.
+
+Full KV pages of completed prompt prefixes are retained in a token-addressed
+chain (one pool reference per cached page) and reused by later prompts that
+share the prefix: the slot starts with the cached pages in its block table
+and prefill runs only on the remainder through the chunked-prefill offset
+path. This is the TPU-serving analog of the reference's response-side reuse
+patterns (it has none — SURVEY §5.7 notes the model layer is new capability);
+the design matches the public automatic-prefix-caching idea from paged
+serving systems, re-built here over ``ops.paged`` block tables.
+
+Correctness invariants:
+- Only FULL pages are cached, and a hit is capped at ``prompt_len - 1``
+  tokens, so the final prompt token's logits are always recomputed — the
+  request's first sampled token is identical with or without a hit.
+- Cached pages are immutable: decode/prefill writes land at positions at or
+  beyond the hit length, which live in pages the slot allocated itself.
+- Pages carry pool refcounts (engine ``_page_refs``): a page returns to the
+  free pool only when no slot uses it AND the cache no longer holds it.
+  Pool pressure evicts least-recently-used cache leaves before the engine
+  resorts to preemption.
+
+KV content equality: a page holding positions [i*P, (i+1)*P) of a given
+token prefix has deterministically identical K/V regardless of which request
+computed it, so chains may interleave pages registered by different requests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("parent_key", "tokens", "page_id", "children", "last_used")
+
+    def __init__(self, parent_key: int, tokens: tuple, page_id: int, last_used: int):
+        self.parent_key = parent_key
+        self.tokens = tokens
+        self.page_id = page_id
+        self.children = 0
+        self.last_used = last_used
+
+
+_ROOT = 0
+
+
+class PrefixCache:
+    """Token-addressed chain of cached full KV pages.
+
+    The cache stores bookkeeping only — page contents stay in the engine's
+    paged pool; the engine owns refcounts and calls back into the cache for
+    lookup/insert/evict under its state lock (single-threaded access)."""
+
+    def __init__(self, page_size: int):
+        self.page_size = page_size
+        self._nodes: dict[int, _Node] = {}
+        self._clock = 0
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    @staticmethod
+    def _child_key(parent_key: int, tokens: tuple) -> int:
+        return hash((parent_key, tokens))
+
+    def _walk(self, toks: np.ndarray):
+        """Yield (key, node-or-None, page_tokens) down the chain of full
+        pages of ``toks``; stops at the first miss or token mismatch."""
+        key = _ROOT
+        p = self.page_size
+        for i in range(int(len(toks)) // p):
+            page_toks = tuple(int(t) for t in toks[i * p:(i + 1) * p])
+            key = self._child_key(key, page_toks)
+            node = self._nodes.get(key)
+            if node is not None and node.tokens != page_toks:
+                node = None  # dict-slot collision: treat as a miss, stop
+            yield key, node, page_toks
+            if node is None:
+                return
+
+    def lookup(self, toks: np.ndarray) -> list[int]:
+        """Page ids of the longest cached full-page prefix of ``toks``.
+        Touches LRU clocks; takes NO references — the caller acquires refs
+        for the pages it actually uses (and must cap the hit below
+        ``len(toks)`` so the last token is recomputed)."""
+        pages: list[int] = []
+        for _, node, _ in self._walk(toks):
+            if node is None:
+                break
+            node.last_used = self._tick()
+            pages.append(node.page_id)
+        return pages
+
+    def insert(self, toks: np.ndarray, pages: list[int]) -> list[int]:
+        """Register ``pages`` (the slot's own, in chain order) as the full
+        pages of ``toks``. Returns the page ids NEWLY retained — the caller
+        must take one pool reference per returned id (the cache's share).
+        Pages whose chain position is already cached are skipped: the
+        existing page holds identical K/V for the same tokens."""
+        new: list[int] = []
+        prev_key = _ROOT
+        for i, (key, node, page_toks) in enumerate(self._walk(toks)):
+            if i >= len(pages):
+                break
+            if node is None:
+                if key in self._nodes:
+                    break  # collision with a different chain: stop extending
+                node = _Node(prev_key, page_toks, pages[i], self._tick())
+                self._nodes[key] = node
+                parent = self._nodes.get(prev_key)
+                if parent is not None:
+                    parent.children += 1
+                new.append(pages[i])
+            prev_key = key
+        return new
+
+    def evict_lru(self) -> int | None:
+        """Remove the least-recently-used LEAF node (children == 0 — interior
+        nodes must outlive their descendants or chained pages leak) and
+        return its page id for the caller to release. None when empty."""
+        victim_key, victim = None, None
+        for key, node in self._nodes.items():
+            if node.children == 0 and (victim is None or node.last_used < victim.last_used):
+                victim_key, victim = key, node
+        if victim is None:
+            return None
+        del self._nodes[victim_key]
+        parent = self._nodes.get(victim.parent_key)
+        if parent is not None:
+            parent.children -= 1
+        return victim.page_id
+
+    def clear(self) -> list[int]:
+        """Drop everything; returns the page ids that were held."""
+        pages = [n.page_id for n in self._nodes.values()]
+        self._nodes.clear()
+        return pages
