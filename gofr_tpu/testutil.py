@@ -44,6 +44,30 @@ def greedy_reference(cfg, params) -> Callable[[list[int], int], list[int]]:
     return ref
 
 
+def assert_paged_pool_consistent(engine, slots_empty: bool = False) -> None:
+    """Paged-pool accounting invariant: every page is free XOR held, and
+    ``_page_refs`` equals the true holder count (slot block tables + prefix
+    cache). With ``slots_empty`` (end-of-test quiescence) additionally
+    require that only the prefix cache still holds pages — the old
+    "everything is free" assertion generalized for prefix retention."""
+    import numpy as np
+
+    refs = np.zeros(engine.total_pages, np.int64)
+    for pages in engine._slot_pages:
+        for p in pages:
+            refs[p] += 1
+    if slots_empty:
+        assert not refs.any(), "a vacated slot still holds pages"
+    if engine._prefix is not None:
+        for node in engine._prefix._nodes.values():
+            refs[node.page_id] += 1
+    assert (refs == engine._page_refs).all(), "refcounts diverge from holders"
+    free = set(engine._free_pages)
+    assert len(free) == len(engine._free_pages), "free list holds duplicates"
+    for p in range(engine.total_pages):
+        assert (p in free) == (refs[p] == 0), f"page {p}: free/held mismatch"
+
+
 def check_mesh_serving(config: dict[str, str], *, n_requests: int = 6,
                        max_new: int = 5, timeout: float = 600.0,
                        **engine_kw) -> None:

@@ -532,6 +532,7 @@ class GenerateEngine(_EngineBase):
         total_pages: int | None = None,
         max_restarts: int = 3,
         decode_pipeline: int = 2,
+        prefix_cache: bool = True,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -591,12 +592,21 @@ class GenerateEngine(_EngineBase):
             self._slot_pages: list[list[int]] = [[] for _ in range(slots)]
             # OOB convention: unallocated entries point one past the pool
             self._table = np.full((slots, self.pages_per_slot), self.total_pages, np.int32)
+            # Pages are refcounted: slots AND the prefix cache hold shares,
+            # and a page returns to the free pool only at refcount zero —
+            # a prefix hit splices cached pages into several slots' tables
+            # at once (tpu/prefix.py invariants).
+            self._page_refs = np.zeros(self.total_pages, np.int64)
+            from gofr_tpu.tpu.prefix import PrefixCache
+
+            self._prefix = PrefixCache(page_size) if prefix_cache else None
         else:
             # cache headroom so a chunk never writes past Smax; round to a
             # kernel-friendly multiple of 128 when the model allows it
             cache_len = min(-(-(self.max_len + self.decode_chunk) // 128) * 128, cfg.max_seq_len)
             self._cache_len = cache_len
             self.cache = family.make_cache(cfg, slots, cache_len)
+            self._prefix = None  # prefix caching needs the paged layout
         self.slots: list[_Slot | None] = [None] * slots
         self._pending: list[tuple[Request, np.ndarray]] = []
         # prompts longer than the largest prefill bucket: admitted one at a
@@ -912,6 +922,11 @@ class GenerateEngine(_EngineBase):
                 self._table = np.full(
                     (self.num_slots, self.pages_per_slot), self.total_pages, np.int32
                 )
+                self._page_refs[:] = 0
+                if self._prefix is not None:
+                    # cached pages rode the same suspect device state
+                    self._prefix.clear()
+                    self.metrics.set_gauge("app_tpu_prefix_cached_pages", 0)
             else:
                 self.cache = self.family.make_cache(
                     self.cfg, self.num_slots, self._cache_len
@@ -919,16 +934,44 @@ class GenerateEngine(_EngineBase):
 
     # -- slot/page bookkeeping -------------------------------------------------
 
+    def _ref_page(self, p: int) -> None:
+        self._page_refs[p] += 1
+
+    def _unref_page(self, p: int) -> None:
+        self._page_refs[p] -= 1
+        if self._page_refs[p] == 0:
+            self._free_pages.append(p)
+
     def _free_slot(self, idx: int) -> None:
-        """Vacate a slot; in the paged layout its pages return to the pool."""
+        """Vacate a slot; in the paged layout its share of each page is
+        released (pages also held by the prefix cache or other slots stay
+        allocated — refcount zero is what returns a page to the pool)."""
         self.slots[idx] = None
         if self.kv_layout == "paged":
             pages = self._slot_pages[idx]
             if pages:
-                self._free_pages.extend(pages)
                 self._slot_pages[idx] = []
                 self._table[idx, :] = self.total_pages
+                for p in pages:
+                    self._unref_page(p)
             self.metrics.set_gauge("app_tpu_kv_pages_free", len(self._free_pages))
+
+    def _evict_prefix_page(self) -> bool:
+        """Release LRU prefix-cache leaves until a page actually lands in
+        the free pool (an evicted page still shared with a live slot frees
+        nothing — keep going). False when the cache has nothing left."""
+        if self._prefix is None:
+            return False
+        freed = False
+        while not self._free_pages:
+            p = self._prefix.evict_lru()
+            if p is None:
+                break
+            self._unref_page(p)
+            freed = True
+        if freed:
+            self.metrics.set_gauge("app_tpu_prefix_cached_pages", len(self._prefix))
+        return bool(self._free_pages)
 
     def _ensure_pages(self, slot_idx: int, upto_pos: int) -> bool:
         """Grow slot_idx's block table until it covers logical position
@@ -940,17 +983,65 @@ class GenerateEngine(_EngineBase):
         cur = self._slot_pages[slot_idx]
         added = 0
         while len(cur) < need:
-            if not self._free_pages:
+            if not self._free_pages and not self._evict_prefix_page():
                 for _ in range(added):
                     p = cur.pop()
                     self._table[slot_idx, len(cur)] = self.total_pages
-                    self._free_pages.append(p)
+                    self._unref_page(p)
                 return False
             p = self._free_pages.pop()
+            self._page_refs[p] = 1
             self._table[slot_idx, len(cur)] = p
             cur.append(p)
             added += 1
         return True
+
+    def _usable_hit(self, toks: np.ndarray) -> list[int]:
+        """Cached pages covering a prefix of ``toks``, capped below the
+        prompt length so the final prompt token's logits — and therefore
+        the first sampled token — are always recomputed (tpu/prefix.py
+        invariants). The single source of truth for both admission routing
+        and slot claim. Touches cache LRU clocks; takes no references."""
+        if self._prefix is None:
+            return []
+        hit = self._prefix.lookup(toks)
+        n_hit = min(len(hit), (int(toks.shape[0]) - 1) // self.page_size)
+        return hit[:n_hit]
+
+    def _prefix_hit(self, idx: int, slot: _Slot, toks: np.ndarray) -> None:
+        """Splice the longest cached full-page prefix of ``toks`` into a
+        freshly claimed slot's block table (caller holds the state lock;
+        the slot owns no pages yet); chunked prefill then starts at
+        ``slot.written``."""
+        pages = self._usable_hit(toks)
+        if not pages:
+            return
+        for p in pages:
+            self._ref_page(p)
+        self._slot_pages[idx] = list(pages)
+        self._table[idx, :len(pages)] = pages
+        slot.written = len(pages) * self.page_size
+        self.metrics.increment_counter("app_tpu_prefix_hit_tokens", slot.written)
+
+    def _prefix_insert(self, idx: int) -> None:
+        """Retain the full prompt pages of a slot whose prefill just
+        completed (caller holds the state lock). The cache takes one pool
+        reference per newly registered page; pages already cached at their
+        chain position are skipped — identical tokens produce identical
+        K/V, so the existing page serves both chains."""
+        s = self.slots[idx]
+        if self._prefix is None or s is None:
+            return
+        n_full = s.prompt_len // self.page_size
+        if n_full == 0:
+            return
+        new = self._prefix.insert(
+            np.asarray(s.prompt_tokens), self._slot_pages[idx][:n_full]
+        )
+        for p in new:
+            self._ref_page(p)
+        if new:
+            self.metrics.set_gauge("app_tpu_prefix_cached_pages", len(self._prefix))
 
     def _preempt_newest(self, except_slot: int | None = None) -> bool:
         """Pool pressure valve: evict the MOST RECENTLY admitted active slot
@@ -1069,6 +1160,7 @@ class GenerateEngine(_EngineBase):
             )
             self._admit_seq += 1
             self.slots[idx] = slot
+            self._prefix_hit(idx, slot, toks)
 
     def _advance_chunked(self) -> bool:
         """Write the next chunk of the OLDEST-admitted prefilling slot; the
@@ -1130,6 +1222,7 @@ class GenerateEngine(_EngineBase):
             self.metrics.increment_counter("app_tpu_tokens_total", chunk)
             s.written += chunk
             if last:
+                self._prefix_insert(idx)
                 tok = int(first[0])
                 s.request.kw.setdefault("_first_token_at", time.monotonic())
                 s.generated = [tok]
@@ -1175,6 +1268,43 @@ class GenerateEngine(_EngineBase):
             taken = set(plan.chosen) | set(plan.expired)
             self._pending = [p for i, p in enumerate(self._pending) if i not in taken]
 
+            chunk_claimed = False
+            if self.kv_layout == "paged" and self._prefix is not None:
+                # EDF-chosen prompts whose cached prefix covers ≥ HALF their
+                # tokens claim a slot on the CHUNKED path: its offset prefill
+                # computes only the uncached remainder (the batched prefill
+                # program has no offset support). Below the threshold the
+                # recompute is cheap relative to losing prefill batching, so
+                # the request stays on the EDF batch. Routing happens here —
+                # for requests the plan already chose — so the lookup cost is
+                # bounded by free slots per admission, not backlog size per
+                # loop iteration, and EDF ordering is preserved.
+                still = []
+                for req, toks in ready:
+                    pages = self._usable_hit(toks)
+                    if 2 * len(pages) * self.page_size >= int(toks.shape[0]):
+                        idx = self._free_slots()[0]
+                        slot = _Slot(
+                            req,
+                            prompt_len=int(toks.shape[0]),
+                            max_total=min(
+                                int(toks.shape[0]) + int(req.kw.get("max_new_tokens", 64)),
+                                self.max_len,
+                            ),
+                            eos=req.kw.get("eos_token_id", self.eos_token_id),
+                            first_token=None,
+                            admit_seq=self._admit_seq,
+                            prompt_tokens=toks,
+                        )
+                        self._admit_seq += 1
+                        self.slots[idx] = slot
+                        self._prefix_hit(idx, slot, toks)
+                        chunk_claimed = True
+                    else:
+                        still.append((req, toks))
+                ready = still
+                free = self._free_slots()
+
             if self.kv_layout == "paged":
                 # admission gate: each admitted prompt needs pages covering its
                 # prefill writes NOW. On pool exhaustion the leader (most urgent)
@@ -1189,7 +1319,7 @@ class GenerateEngine(_EngineBase):
                         self._pending.append((req, toks))
                 ready = admitted
             if not ready:
-                return False
+                return chunk_claimed
 
             # one prefill call, padded to (len_bucket, batch_bucket), shipped
             # as ONE packed array (layout documented at the jit definitions).
@@ -1260,6 +1390,7 @@ class GenerateEngine(_EngineBase):
                 )
                 self._admit_seq += 1
                 self.slots[free[i]] = slot
+                self._prefix_insert(free[i])
                 self._emit(slot, tok)
                 self._maybe_finish(free[i])
             return True
@@ -1536,6 +1667,7 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             kv_layout=str(kw.pop("kv_layout", conf.get_or_default("ENGINE_KV_LAYOUT", default_layout))),
             page_size=int(kw.pop("page_size", conf.get_int("ENGINE_PAGE_SIZE", 128))),
             total_pages=int(kw.pop("total_pages", conf.get_int("ENGINE_TOTAL_PAGES", 0))) or None,
+            prefix_cache=bool(kw.pop("prefix_cache", conf.get_bool("ENGINE_PREFIX_CACHE", True))),
             decode_pipeline=int(kw.pop("decode_pipeline", conf.get_int("ENGINE_DECODE_PIPELINE", 2))),
             eos_token_id=eos,
             tokenizer=tokenizer,

@@ -27,6 +27,8 @@ computed it, so chains may interleave pages registered by different requests.
 
 from __future__ import annotations
 
+import heapq
+
 import numpy as np
 
 
@@ -49,12 +51,20 @@ class PrefixCache:
 
     The cache stores bookkeeping only — page contents stay in the engine's
     paged pool; the engine owns refcounts and calls back into the cache for
-    lookup/insert/evict under its state lock (single-threaded access)."""
+    lookup/insert/evict under its state lock (single-threaded access).
+
+    Eviction is a lazy min-heap of ``(last_used, key)`` candidates: every
+    touch/creation of a LEAF pushes an entry; ``evict_lru`` pops until it
+    finds a live one (node still present, still a leaf, timestamp current).
+    Stale entries cost O(log n) each to skip, so eviction under pool
+    pressure is amortized O(log n) instead of the O(n)-scan-per-page the
+    first cut shipped with (ADVICE round 3)."""
 
     def __init__(self, page_size: int):
         self.page_size = page_size
         self._nodes: dict[int, _Node] = {}
         self._clock = 0
+        self._heap: list[tuple[int, int]] = []  # lazy (last_used, key) min-heap
 
     def __len__(self) -> int:
         return len(self._nodes)
@@ -67,20 +77,26 @@ class PrefixCache:
     def _child_key(parent_key: int, tokens: tuple) -> int:
         return hash((parent_key, tokens))
 
-    def _walk(self, toks: np.ndarray):
-        """Yield (key, node-or-None, page_tokens) down the chain of full
-        pages of ``toks``; stops at the first miss or token mismatch."""
-        key = _ROOT
-        p = self.page_size
-        for i in range(int(len(toks)) // p):
-            page_toks = tuple(int(t) for t in toks[i * p:(i + 1) * p])
-            key = self._child_key(key, page_toks)
-            node = self._nodes.get(key)
-            if node is not None and node.tokens != page_toks:
-                node = None  # dict-slot collision: treat as a miss, stop
-            yield key, node, page_toks
-            if node is None:
-                return
+    def _push(self, key: int, node: _Node) -> None:
+        heapq.heappush(self._heap, (node.last_used, key))
+        # Lazy deletion leaves one stale entry per touch; without a bound
+        # the heap grows with lifetime lookup count. Compact when stale
+        # entries dominate — amortized O(1) per push.
+        if len(self._heap) > 4 * len(self._nodes) + 16:
+            self._heap = [
+                (n.last_used, k) for k, n in self._nodes.items() if n.children == 0
+            ]
+            heapq.heapify(self._heap)
+
+    def _get(self, parent_key: int, key: int, page_toks: tuple) -> _Node | None:
+        """Node for ``key``, or None on a miss OR a dict-slot collision.
+        Both tokens and ancestry must match: two chains whose colliding
+        pages hold identical tokens but different parents are distinct
+        prefixes and must not alias (ADVICE round 3)."""
+        node = self._nodes.get(key)
+        if node is not None and (node.tokens != page_toks or node.parent_key != parent_key):
+            return None
+        return node
 
     def lookup(self, toks: np.ndarray) -> list[int]:
         """Page ids of the longest cached full-page prefix of ``toks``.
@@ -88,10 +104,17 @@ class PrefixCache:
         for the pages it actually uses (and must cap the hit below
         ``len(toks)`` so the last token is recomputed)."""
         pages: list[int] = []
-        for _, node, _ in self._walk(toks):
+        key = _ROOT
+        p = self.page_size
+        for i in range(int(len(toks)) // p):
+            page_toks = tuple(int(t) for t in toks[i * p:(i + 1) * p])
+            parent, key = key, self._child_key(key, page_toks)
+            node = self._get(parent, key, page_toks)
             if node is None:
                 break
             node.last_used = self._tick()
+            if node.children == 0:
+                self._push(key, node)
             pages.append(node.page_id)
         return pages
 
@@ -102,40 +125,45 @@ class PrefixCache:
         Pages whose chain position is already cached are skipped: the
         existing page holds identical K/V for the same tokens."""
         new: list[int] = []
-        prev_key = _ROOT
-        for i, (key, node, page_toks) in enumerate(self._walk(toks)):
-            if i >= len(pages):
-                break
+        key = _ROOT
+        p = self.page_size
+        for i in range(min(int(len(toks)) // p, len(pages))):
+            page_toks = tuple(int(t) for t in toks[i * p:(i + 1) * p])
+            parent, key = key, self._child_key(key, page_toks)
+            node = self._get(parent, key, page_toks)
             if node is None:
                 if key in self._nodes:
                     break  # collision with a different chain: stop extending
-                node = _Node(prev_key, page_toks, pages[i], self._tick())
+                node = _Node(parent, page_toks, pages[i], self._tick())
                 self._nodes[key] = node
-                parent = self._nodes.get(prev_key)
-                if parent is not None:
-                    parent.children += 1
+                pnode = self._nodes.get(parent)
+                if pnode is not None:
+                    pnode.children += 1
+                self._push(key, node)
                 new.append(pages[i])
-            prev_key = key
         return new
 
     def evict_lru(self) -> int | None:
         """Remove the least-recently-used LEAF node (children == 0 — interior
         nodes must outlive their descendants or chained pages leak) and
         return its page id for the caller to release. None when empty."""
-        victim_key, victim = None, None
-        for key, node in self._nodes.items():
-            if node.children == 0 and (victim is None or node.last_used < victim.last_used):
-                victim_key, victim = key, node
-        if victim is None:
-            return None
-        del self._nodes[victim_key]
-        parent = self._nodes.get(victim.parent_key)
-        if parent is not None:
-            parent.children -= 1
-        return victim.page_id
+        while self._heap:
+            last_used, key = heapq.heappop(self._heap)
+            node = self._nodes.get(key)
+            if node is None or node.children != 0 or node.last_used != last_used:
+                continue  # stale: evicted, grew children, or touched since
+            del self._nodes[key]
+            parent = self._nodes.get(node.parent_key)
+            if parent is not None:
+                parent.children -= 1
+                if parent.children == 0:
+                    self._push(node.parent_key, parent)
+            return node.page_id
+        return None
 
     def clear(self) -> list[int]:
         """Drop everything; returns the page ids that were held."""
         pages = [n.page_id for n in self._nodes.values()]
         self._nodes.clear()
+        self._heap.clear()
         return pages

@@ -18,6 +18,7 @@ from gofr_tpu.config import DictConfig
 from gofr_tpu.container import Container, new_mock_container
 from gofr_tpu.http.errors import RequestTimeout
 from gofr_tpu.models import LlamaConfig, BertConfig, ViTConfig, ModelSpec, llama
+from gofr_tpu.testutil import assert_paged_pool_consistent
 from gofr_tpu.tpu.engine import (
     BatchEngine,
     GenerateEngine,
@@ -593,7 +594,7 @@ class TestPagedGenerateEngine:
             assert preempts is not None and sum(preempts._values.values()) >= 1, (
                 "pool pressure never forced a preemption — test premise broken"
             )
-            assert sorted(eng._free_pages) == list(range(eng.total_pages))
+            assert_paged_pool_consistent(eng, slots_empty=True)
         finally:
             eng.stop()
 
@@ -712,7 +713,7 @@ class TestPagedGenerateEngine:
             assert all(r is not None for r in res)
             assert res[0]["tokens"] == want
             assert [r["tokens"] for r in res[1:]] == want_others
-            assert sorted(eng._free_pages) == list(range(eng.total_pages))
+            assert_paged_pool_consistent(eng, slots_empty=True)
         finally:
             eng.stop()
 

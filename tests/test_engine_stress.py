@@ -14,6 +14,7 @@ import pytest
 
 from gofr_tpu.container import new_mock_container
 from gofr_tpu.models import LlamaConfig, llama
+from gofr_tpu.testutil import assert_paged_pool_consistent
 from gofr_tpu.tpu.engine import GenerateEngine
 
 
@@ -73,7 +74,7 @@ def test_submit_cancel_storm(setup, kv_layout):
             else:
                 assert i % 5 == 0, f"non-cancelled request {i} failed: {res}"
         if kv_layout == "paged":
-            assert sorted(eng._free_pages) == list(range(eng.total_pages)), "page leak"
+            assert_paged_pool_consistent(eng, slots_empty=True)
     finally:
         eng.stop()
 
@@ -106,5 +107,5 @@ def test_stop_mid_traffic_fails_everything_and_frees_state(setup):
                 hung += 1
     assert hung == 0, f"{hung} request(s) hung across stop()"
     assert errored > 0, "stop() during load completed everything — premise broken"
-    assert sorted(eng._free_pages) == list(range(eng.total_pages))
+    assert_paged_pool_consistent(eng, slots_empty=True)
     assert all(s is None for s in eng.slots)
