@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from typing import Any, Callable
 
 FIELD_RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))

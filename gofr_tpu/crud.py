@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Any
 
 from gofr_tpu.datasource import sql as sqlb
 from gofr_tpu.http.errors import EntityNotFound

@@ -1,15 +1,32 @@
 """Versioned migrations (gofr `pkg/gofr/migration/`).
 
 User supplies ``{version:int -> Migration(up=fn)}``; the runner sorts versions,
-skips those at or below the last applied, wraps each in a per-datasource
-transaction, records completions in ``gofr_migrations`` (`sql.go:12-18`
-semantics), and rolls back on failure (`migration.go:28-91`). The datasource
-handle passed to ``up`` exposes sql/redis/kv/pubsub so migrations can touch any
-wired store (chain-of-responsibility per `interface.go:44-51`).
+skips those at or below the last applied, wraps each in per-datasource
+transactions, records completions per datasource, and rolls back on failure
+(`migration.go:28-91`). The datasource handle passed to ``up`` exposes
+sql/redis/kv/pubsub so migrations can touch any wired store
+(chain-of-responsibility per `interface.go:44-51`):
+
+- **SQL**: statements run inside a real transaction; the completion row in
+  ``gofr_migrations`` commits with the migration's own writes (`sql.go:12-18`).
+- **Redis**: the handle is a BUFFERING transaction view (``RedisTx``) — the
+  reference swaps ``ds.Redis`` for a ``TxPipeline`` the same way
+  (`migration.go:69-71`, `redis.go:78-127`). Writes queue locally and are
+  shipped as one MULTI/EXEC at commit together with the completion record in
+  the ``gofr_migrations`` hash; a failing migration discards the buffer, so
+  no partial Redis state survives. Reads pass through to the live client and
+  see pre-transaction state (MULTI semantics: queued writes are not readable
+  before EXEC).
+- **Pub/Sub**: ``d.pubsub.create_topic``/``delete_topic`` for topic
+  migrations (`interface.go:28-31`); brokers offer no transactions, so these
+  apply immediately — order topic creates FIRST in a migration.
+- Completion bookkeeping lives in EVERY wired transactional datasource; the
+  skip point is the max across them (`redis.go:34-76` getLastMigration).
 """
 
 from __future__ import annotations
 
+import json
 import time
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -20,13 +37,77 @@ class Migration:
     up: Callable[["MigrationDatasource"], Any]
 
 
+class RedisTx:
+    """Buffered Redis view handed to migrations: write commands queue and
+    execute atomically (MULTI/EXEC in one pipeline) at commit; reads pass
+    through to the live client."""
+
+    def __init__(self, redis):
+        self._redis = redis
+        self._buffer: list[tuple[Any, ...]] = []
+
+    # -- buffered writes -------------------------------------------------------
+
+    def command(self, *args: Any) -> "RedisTx":
+        self._buffer.append(args)
+        return self
+
+    def set(self, key: str, value: Any, ex: int | None = None) -> "RedisTx":
+        return self.command(*(("SET", key, value) + (("EX", ex) if ex is not None else ())))
+
+    def delete(self, *keys: str) -> "RedisTx":
+        return self.command("DEL", *keys)
+
+    def hset(self, key: str, field: str, value: Any) -> "RedisTx":
+        return self.command("HSET", key, field, value)
+
+    def lpush(self, key: str, *values: Any) -> "RedisTx":
+        return self.command("LPUSH", key, *values)
+
+    def incr(self, key: str) -> "RedisTx":
+        return self.command("INCR", key)
+
+    def expire(self, key: str, seconds: int) -> "RedisTx":
+        return self.command("EXPIRE", key, seconds)
+
+    # -- passthrough reads (pre-transaction state) -----------------------------
+
+    def get(self, key: str):
+        return self._redis.get(key)
+
+    def hget(self, key: str, field: str):
+        return self._redis.hget(key, field)
+
+    def hgetall(self, key: str):
+        return self._redis.hgetall(key)
+
+    def keys(self, pattern: str = "*"):
+        return self._redis.keys(pattern)
+
+    # -- lifecycle (runner-only) -----------------------------------------------
+
+    def _commit(self) -> None:
+        if not self._buffer:
+            return
+        pipe = self._redis.pipeline()
+        pipe.command("MULTI")
+        for parts in self._buffer:
+            pipe.command(*parts)
+        pipe.command("EXEC")
+        pipe.execute()
+        self._buffer = []
+
+    def _discard(self) -> None:
+        self._buffer = []
+
+
 class MigrationDatasource:
     """Narrow view of the container handed to each migration."""
 
-    def __init__(self, container, tx=None):
+    def __init__(self, container, tx=None, redis=None):
         self._container = container
         self.sql = tx if tx is not None else container.sql
-        self.redis = container.redis
+        self.redis = redis if redis is not None else container.redis
         self.kv = container.kv
         self.pubsub = container.pubsub
         self.logger = container.logger
@@ -36,6 +117,23 @@ MIGRATION_TABLE_DDL = (
     "CREATE TABLE IF NOT EXISTS gofr_migrations ("
     "version INTEGER PRIMARY KEY, method TEXT, start_time TEXT, duration_ms INTEGER)"
 )
+REDIS_MIGRATION_KEY = "gofr_migrations"
+
+
+def _last_applied(db, redis) -> int:
+    last = 0
+    if db is not None:
+        row = db.query_row("SELECT MAX(version) AS v FROM gofr_migrations")
+        if row and row["v"] is not None:
+            last = int(row["v"])
+    if redis is not None:
+        for key in redis.hgetall(REDIS_MIGRATION_KEY):
+            k = key.decode() if isinstance(key, bytes) else str(key)
+            try:
+                last = max(last, int(k))
+            except ValueError:
+                continue
+    return last
 
 
 def run_migrations(migrations: dict[int, Migration | Any], container) -> list[int]:
@@ -44,12 +142,15 @@ def run_migrations(migrations: dict[int, Migration | Any], container) -> list[in
     if not migrations:
         return []
     db = container.sql
-    if db is None:
-        raise RuntimeError("migrations require a SQL datasource (set DB_DIALECT)")
+    redis = container.redis
+    if db is None and redis is None:
+        raise RuntimeError(
+            "migrations require a transactional datasource (set DB_DIALECT or REDIS_HOST)"
+        )
 
-    db.execute(MIGRATION_TABLE_DDL)
-    row = db.query_row("SELECT MAX(version) AS v FROM gofr_migrations")
-    last = row["v"] if row and row["v"] is not None else 0
+    if db is not None:
+        db.execute(MIGRATION_TABLE_DDL)
+    last = _last_applied(db, redis)
 
     applied: list[int] = []
     for version in sorted(migrations):
@@ -58,19 +159,51 @@ def run_migrations(migrations: dict[int, Migration | Any], container) -> list[in
         migration = migrations[version]
         up = migration.up if isinstance(migration, Migration) else migration
         start = time.time()
-        with db.begin() as tx:
-            try:
-                up(MigrationDatasource(container, tx=tx))
-                duration_ms = int((time.time() - start) * 1000)
+        tx = db.begin().__enter__() if db is not None else None
+        redis_tx = RedisTx(redis) if redis is not None else None
+        try:
+            up(MigrationDatasource(container, tx=tx, redis=redis_tx))
+            duration_ms = int((time.time() - start) * 1000)
+            stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(start))
+            # Commit order: SQL FIRST, then the Redis EXEC. The skip point
+            # is the max across datasources, so whichever commits last must
+            # be the one that can't fail for data-dependent reasons — SQL
+            # (DDL conflicts, constraints) fails far more often than an
+            # EXEC of already-validated commands. An SQL failure here rolls
+            # everything back cleanly; a Redis failure after the SQL commit
+            # leaves SQL recorded and is surfaced loudly below.
+            if tx is not None:
                 tx.execute(
                     "INSERT INTO gofr_migrations (version, method, start_time, duration_ms) VALUES (?, ?, ?, ?)",
-                    (version, "UP", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(start)), duration_ms),
+                    (version, "UP", stamp, duration_ms),
                 )
                 tx.commit()
-            except Exception as e:
+            if redis_tx is not None:
+                # completion record rides the same MULTI/EXEC as the
+                # migration's own writes (redis.go:90-119)
+                redis_tx.hset(REDIS_MIGRATION_KEY, str(version), json.dumps(
+                    {"method": "UP", "startTime": stamp, "duration": duration_ms}))
+                try:
+                    redis_tx._commit()
+                except Exception:
+                    if tx is not None:
+                        logger.errorf(
+                            "migration %d: SQL committed but the Redis EXEC failed — "
+                            "Redis writes for this version were NOT applied and must "
+                            "be replayed manually (the version is recorded as applied)",
+                            version,
+                        )
+                    raise
+        except Exception as e:  # noqa: BLE001
+            if redis_tx is not None:
+                redis_tx._discard()
+            if tx is not None:
                 tx.rollback()
-                logger.errorf("migration %d failed, rolled back: %r", version, e)
-                raise
+            logger.errorf("migration %d failed, rolled back: %r", version, e)
+            raise
+        finally:
+            if tx is not None:
+                tx.__exit__(None, None, None)
         logger.infof("migration %d applied in %dms", version, int((time.time() - start) * 1000))
         applied.append(version)
     return applied

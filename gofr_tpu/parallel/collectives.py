@@ -9,13 +9,11 @@ axis vocabulary and the ring-permutation used by ring attention.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import jax
-import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 
 def psum(x: Any, axis: str):

@@ -18,7 +18,7 @@ replica traffic can cross DCN.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import numpy as np

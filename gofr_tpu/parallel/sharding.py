@@ -10,7 +10,7 @@ than code (`container/container.go:66-124`).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass
 from typing import Any
 
 import jax

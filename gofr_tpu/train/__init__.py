@@ -12,8 +12,7 @@ fsdp), tp shards heads/mlp/vocab — XLA inserts the ICI collectives
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
-from typing import Any, Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp

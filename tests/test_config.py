@@ -1,4 +1,3 @@
-import os
 
 from gofr_tpu.config import DictConfig, EnvConfig, parse_dotenv
 

@@ -4,7 +4,6 @@ parity with the full forward, expert-parallel training on the CPU mesh."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from gofr_tpu.models import MixtralConfig, mixtral
 from gofr_tpu.ops.moe import default_capacity, moe_ffn, route_topk

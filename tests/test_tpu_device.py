@@ -1,6 +1,5 @@
 """TPU device datasource tests (container.tpu) on the virtual CPU mesh."""
 
-import jax
 
 from gofr_tpu.config import DictConfig
 from gofr_tpu.container import Container, new_mock_container
