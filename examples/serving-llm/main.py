@@ -20,10 +20,17 @@ def build_app(config=None, *, preset: str = "tiny") -> App:
     folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
     app = App(config=config or EnvConfig(folder=folder))
 
-    cfg = LlamaConfig.tiny() if preset == "tiny" else LlamaConfig.one_b()
+    from gofr_tpu.utils import ByteTokenizer
+
+    # vocab must cover the byte tokenizer's 259 ids; prompts can be raw
+    # token-id lists OR strings (encoded through the tokenizer), and results
+    # carry decoded text alongside ids. EOS is disabled here because random
+    # weights emit any token — a real checkpoint would keep the tokenizer's
+    # eos_token_id (build_engine wires it automatically).
+    cfg = LlamaConfig.tiny(vocab_size=300) if preset == "tiny" else LlamaConfig.one_b()
     dtype = jnp.float32 if preset == "tiny" else jnp.bfloat16
-    spec = ModelSpec("llama", cfg, task="generate", dtype=dtype)
-    app.serve_model("lm", spec, slots=4, max_len=64)
+    spec = ModelSpec("llama", cfg, task="generate", dtype=dtype, tokenizer=ByteTokenizer())
+    app.serve_model("lm", spec, slots=4, max_len=64, eos_token_id=-1)
 
     async def generate(ctx):
         # async handler + agenerate: awaits the engine future on the event

@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import math
 from dataclasses import dataclass
-from typing import Any, Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -60,7 +60,8 @@ class ModelSpec:
     task: "generate" | "embed" | "classify" — selects the engine path
     weights: None (random init), a checkpoint path (orbax), or an HF model
              id/path to convert (gofr_tpu.models.convert)
-    tokenizer: HF tokenizer id/path for text models (optional — the engine
+    tokenizer: HF tokenizer id/path OR an object with encode/decode (e.g.
+             utils.ByteTokenizer) for text models (optional — the engine
              also accepts pre-tokenized int arrays)
     """
 
@@ -68,7 +69,7 @@ class ModelSpec:
     config: Any = None
     task: str = "generate"
     weights: str | None = None
-    tokenizer: str | None = None
+    tokenizer: Any = None
     dtype: Any = jnp.bfloat16
     extra: dict[str, Any] = dataclasses.field(default_factory=dict)
 

@@ -1541,9 +1541,26 @@ class GenerateEngine(_EngineBase):
     # -- completion ------------------------------------------------------------
 
     def _emit(self, slot: _Slot, tok: int) -> None:
-        if slot.request.stream_q is not None and tok != slot.eos:
-            piece = self.tokenizer.decode([tok]) if self.tokenizer is not None else tok
-            slot.request.stream_q.put(piece)
+        if slot.request.stream_q is None or tok == slot.eos:
+            return
+        if self.tokenizer is None:
+            slot.request.stream_q.put(tok)
+            return
+        # Incremental detokenization: unflushed token ids accumulate in a
+        # TAIL; a tail decoding to text with a trailing U+FFFD holds a
+        # character some token hasn't completed yet (byte-level tokenizers
+        # split UTF-8 sequences across tokens), so flushing waits for the
+        # next token. Per-flush cost is O(held tail), not O(output so far).
+        # The tail lives on the REQUEST so it survives preemption-by-
+        # recompute (slot objects are rebuilt; kw rides along); any
+        # incomplete remainder is flushed by _maybe_finish so the joined
+        # stream always equals the final result text.
+        tail = slot.request.kw.setdefault("_stream_tail", [])
+        tail.append(tok)
+        text = self.tokenizer.decode(tail)
+        if text and not text.endswith("�"):
+            slot.request.stream_q.put(text)
+            tail.clear()
 
     def _maybe_finish(self, slot_idx: int) -> None:
         s = self.slots[slot_idx]
@@ -1556,6 +1573,16 @@ class GenerateEngine(_EngineBase):
         # tokens generated before any preemption round-trips lead the result
         prior = list(s.request.kw.get("_prior_tokens", []))
         tokens = prior + (s.generated[:-1] if finish == "stop" else list(s.generated))
+        tail = s.request.kw.get("_stream_tail")
+        if tail and s.request.stream_q is not None and self.tokenizer is not None:
+            # flush any held (possibly incomplete) trailing characters so
+            # the joined stream equals the result text exactly — without
+            # this, a generation cut mid-character would silently drop its
+            # tail from the stream
+            text = self.tokenizer.decode(tail)
+            if text:
+                s.request.stream_q.put(text)
+            tail.clear()
         result = {
             "tokens": tokens,
             "text": self.tokenizer.decode(tokens) if self.tokenizer is not None else None,
@@ -1582,9 +1609,12 @@ def _resolve_config(family_name: str, config: Any):
     return cls(**config) if isinstance(config, dict) else cls()
 
 
-def _load_tokenizer(path_or_id: str | None):
+def _load_tokenizer(path_or_id):
     if not path_or_id:
         return None
+    if hasattr(path_or_id, "encode") and hasattr(path_or_id, "decode") \
+            and not isinstance(path_or_id, str):
+        return path_or_id  # already a tokenizer object (e.g. utils.ByteTokenizer)
     from transformers import AutoTokenizer
 
     return AutoTokenizer.from_pretrained(path_or_id)

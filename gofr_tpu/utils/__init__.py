@@ -1,0 +1,3 @@
+from gofr_tpu.utils.tokenizer import ByteTokenizer
+
+__all__ = ["ByteTokenizer"]

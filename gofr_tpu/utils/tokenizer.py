@@ -1,0 +1,44 @@
+"""In-tree tokenizer for the text serving path.
+
+The engine accepts any object with ``encode(str) -> list[int]`` /
+``decode(list[int]) -> str`` (HF tokenizers qualify; ``build_engine`` loads
+one from ``ModelSpec.tokenizer`` when it's a model id/path). This module
+provides a dependency-free fallback so string-in/text-out serving — the
+reference's bind-to-any ergonomics (`pkg/gofr/datasource/pubsub/message.go:
+13-103`) applied to prompts — works with zero external downloads: a
+reversible byte-level tokenizer (UTF-8 bytes shifted past the special ids).
+
+Byte-level means multi-byte characters span several tokens; the engine's
+incremental stream detokenizer (engine._emit) holds partial characters until
+they complete, so streamed text is always valid UTF-8.
+"""
+
+from __future__ import annotations
+
+PAD_ID, BOS_ID, EOS_ID = 0, 1, 2
+_OFFSET = 3
+
+
+class ByteTokenizer:
+    """Reversible UTF-8 byte tokenizer: id = byte + 3 (0/1/2 = pad/bos/eos).
+
+    Works with any model whose vocab_size >= 259; intended for examples,
+    tests, and air-gapped deployments without a trained tokenizer."""
+
+    vocab_size = 256 + _OFFSET
+    pad_token_id = PAD_ID
+    bos_token_id = BOS_ID
+    eos_token_id = EOS_ID
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = [b + _OFFSET for b in text.encode("utf-8")]
+        return [BOS_ID] + ids if add_bos else ids
+
+    def decode(self, ids) -> str:
+        # ids outside [3, 259) are specials or out-of-vocab (a model may
+        # have a larger vocab than the tokenizer) — skipped, never a crash
+        data = bytes(int(i) - _OFFSET for i in ids
+                     if _OFFSET <= int(i) < 256 + _OFFSET)
+        # errors='replace' keeps partial trailing characters visible as
+        # U+FFFD — the stream detokenizer uses that as its hold signal
+        return data.decode("utf-8", errors="replace")
