@@ -40,4 +40,9 @@ class StreamingResponse:
         return f"event: error\ndata: {json.dumps({'message': message})}\n\n".encode()
 
     def encode_ws(self, item: Any) -> str:
-        return item if isinstance(item, str) else json.dumps(item)
+        """Every frame is JSON: data items encode to JSON values (a text
+        piece arrives as a JSON string, a token id as a number), and the
+        terminal control frame is the object ``{"done": true}`` — a
+        streamed piece whose TEXT is '{"done": true}' encodes to a JSON
+        string and stays unambiguously data."""
+        return json.dumps(item)

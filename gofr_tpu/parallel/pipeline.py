@@ -72,6 +72,62 @@ def spmd_pipeline(
     )
 
 
+def spmd_pipeline_stateful(
+    stage_fn: Callable[[Any, Any, Any], tuple[Any, Any]],
+    stage_params: Any,
+    state: Any,
+    inputs: Any,
+    *,
+    axis: str = "pp",
+    microbatches: int,
+    init_act: Any,
+):
+    """``spmd_pipeline`` with per-stage local STATE threaded through every
+    tick — the serving shape, where each stage owns the KV-cache layers of
+    its slab and updates them as microbatches of slots stream past.
+
+    ``stage_fn(stage_params, state, act) -> (state, act)``. Bubble ticks
+    still run stage_fn, on ``init_act``-shaped garbage — which is why
+    ``init_act`` is REQUIRED: the caller must bake out-of-bounds positions /
+    slot ids into it so bubble-tick state writes are dropped (the engine's
+    padding-row convention, engine._admit docstring); a zeros default would
+    write bubble garbage into real index-0 state. Stage 0 re-feeds the last
+    microbatch during drain ticks; its state writes recompute identical
+    values, so they are harmless by construction. Returns ``(outs, state)``
+    with outs replicated over the axis."""
+    p = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    m = microbatches
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    act0 = init_act
+    outs0 = jax.tree.map(jnp.zeros_like, inputs)
+
+    def tick(carry, t):
+        outs, act, st = carry
+        feed_idx = jnp.minimum(t, m - 1)
+        feed = jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(x, feed_idx, 0, keepdims=False), inputs)
+        cur = jax.tree.map(lambda f, a: jnp.where(stage == 0, f, a), feed, act)
+        st, out = stage_fn(stage_params, st, cur)
+        out_idx = jnp.clip(t - (p - 1), 0, m - 1)
+        write = jnp.logical_and(stage == p - 1, t >= p - 1)
+        outs = jax.tree.map(
+            lambda o_all, o: jnp.where(
+                write, lax.dynamic_update_index_in_dim(o_all, o, out_idx, 0), o_all
+            ),
+            outs, out,
+        )
+        act = jax.tree.map(lambda o: lax.ppermute(o, axis, perm), out)
+        return (outs, act, st), None
+
+    (outs, _, state), _ = lax.scan(tick, (outs0, act0, state), jnp.arange(m + p - 1))
+    outs = jax.tree.map(
+        lambda o: lax.psum(jnp.where(stage == p - 1, o, jnp.zeros_like(o)), axis), outs
+    )
+    return outs, state
+
+
 def make_pipeline_forward(
     mesh: Mesh,
     *,

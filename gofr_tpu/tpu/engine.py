@@ -1540,6 +1540,19 @@ class GenerateEngine(_EngineBase):
 
     # -- completion ------------------------------------------------------------
 
+    # stream detokenizer bounds: ctx anchors in-context decoding (a few
+    # tokens suffice for space-marker/merge effects); tail max bounds
+    # worst-case hold latency and per-token re-decode cost
+    STREAM_CTX_TOKENS = 8
+    STREAM_TAIL_MAX = 32
+
+    def _stream_diff(self, kw: dict, tail: list) -> str:
+        """decode(ctx + tail) minus decode(ctx) — the next stream piece."""
+        ctx = kw.get("_stream_ctx", [])
+        if not ctx:
+            return self.tokenizer.decode(tail)
+        return self.tokenizer.decode(ctx + tail)[len(self.tokenizer.decode(ctx)):]
+
     def _emit(self, slot: _Slot, tok: int) -> None:
         if slot.request.stream_q is None or tok == slot.eos:
             return
@@ -1547,19 +1560,25 @@ class GenerateEngine(_EngineBase):
             slot.request.stream_q.put(tok)
             return
         # Incremental detokenization: unflushed token ids accumulate in a
-        # TAIL; a tail decoding to text with a trailing U+FFFD holds a
-        # character some token hasn't completed yet (byte-level tokenizers
-        # split UTF-8 sequences across tokens), so flushing waits for the
-        # next token. Per-flush cost is O(held tail), not O(output so far).
-        # The tail lives on the REQUEST so it survives preemption-by-
-        # recompute (slot objects are rebuilt; kw rides along); any
-        # incomplete remainder is flushed by _maybe_finish so the joined
-        # stream always equals the final result text.
+        # TAIL and are emitted as the decode DIFF against a short context
+        # of already-flushed ids — piece = decode(ctx + tail) minus
+        # decode(ctx). The diff keeps tokenizers whose per-group decode
+        # differs from in-context decode exact (SentencePiece strips a
+        # leading space marker per decode call; the shared ctx prefix makes
+        # any such artifact identical in both decodes and cancel). A piece
+        # ending in U+FFFD holds a split multi-byte character until the
+        # next token completes it, but never past GOFR_STREAM_TAIL_MAX
+        # tokens — a model stuck on undecodable ids must not stall the
+        # stream or grow an O(n) re-decode. State lives on the REQUEST so
+        # it survives preemption-by-recompute; _maybe_finish flushes the
+        # remainder so the joined stream equals the final result text.
         tail = slot.request.kw.setdefault("_stream_tail", [])
         tail.append(tok)
-        text = self.tokenizer.decode(tail)
-        if text and not text.endswith("�"):
-            slot.request.stream_q.put(text)
+        piece = self._stream_diff(slot.request.kw, tail)
+        if piece and (not piece.endswith("�") or len(tail) > self.STREAM_TAIL_MAX):
+            slot.request.stream_q.put(piece)
+            slot.request.kw["_stream_ctx"] = (
+                slot.request.kw.get("_stream_ctx", []) + tail)[-self.STREAM_CTX_TOKENS:]
             tail.clear()
 
     def _maybe_finish(self, slot_idx: int) -> None:
@@ -1579,7 +1598,7 @@ class GenerateEngine(_EngineBase):
             # the joined stream equals the result text exactly — without
             # this, a generation cut mid-character would silently drop its
             # tail from the stream
-            text = self.tokenizer.decode(tail)
+            text = self._stream_diff(s.request.kw, tail)
             if text:
                 s.request.stream_q.put(text)
             tail.clear()
@@ -1630,6 +1649,27 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
     tpu = container.tpu
     conf = container.config
 
+    rules = tpu.rules
+    mesh = tpu.mesh
+    # popped unconditionally: the knob must be ignorable on non-pp meshes,
+    # not crash GenerateEngine with an unexpected-keyword TypeError
+    pp_microbatches = int(kw.pop("pp_microbatches",
+                                 conf.get_int("ENGINE_PP_MICROBATCHES", 0)))
+    if (spec.task == "generate" and mesh is not None
+            and "pp" in getattr(mesh, "axis_names", ()) and mesh.shape["pp"] > 1):
+        # pipeline-parallel serving: blocks + slot KV cache shard over pp on
+        # the layer dim; engine device calls run the GPipe schedule
+        # (models/llama_pp.py). The 70B-on-v5e-64 weight-fit path.
+        if spec.family != "llama":
+            raise ValueError(
+                f"pp-mesh serving is implemented for the llama family only "
+                f"(got {spec.family!r}); drop the pp axis or use llama"
+            )
+        from gofr_tpu.models.llama_pp import PPLlamaFamily
+
+        rules = rules.with_overrides(layers="pp")
+        family = PPLlamaFamily(mesh, microbatches=pp_microbatches or None, rules=rules)
+
     if spec.weights:
         from gofr_tpu.train.checkpoint import is_checkpoint_dir, load_params
 
@@ -1651,7 +1691,7 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
         container.logger.warn(
             f"model {spec.family}: no weights given — randomly initialized (dev/bench mode)"
         )
-    params = shard_pytree(params, family.param_axes(cfg), tpu.rules, tpu.mesh)
+    params = shard_pytree(params, family.param_axes(cfg), rules, mesh)
 
     quantize_kw = kw.pop("quantize", None)
     quantize = str(quantize_kw if quantize_kw is not None else conf.get_or_default("ENGINE_QUANTIZE", ""))

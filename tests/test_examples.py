@@ -135,15 +135,13 @@ def test_serving_llm_websocket_streaming():
                         msg = await asyncio.wait_for(ws.receive(), timeout=120)
                         if msg.type != aiohttp.WSMsgType.TEXT:
                             break
-                        # transport contract: text pieces are RAW string
-                        # frames; control frames (done) are JSON objects
-                        try:
-                            payload = json.loads(msg.data)
-                        except json.JSONDecodeError:
-                            payload = msg.data
+                        # transport contract: every frame is JSON — text
+                        # pieces are JSON strings, the terminal control
+                        # frame is the object {"done": true}
+                        payload = json.loads(msg.data)
                         if isinstance(payload, dict) and payload.get("done"):
                             return pieces
-                        pieces.append(msg.data)
+                        pieces.append(payload)
 
         pieces = asyncio.run(drive())
         assert pieces is not None and pieces, pieces
