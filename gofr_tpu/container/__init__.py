@@ -94,6 +94,8 @@ class Container:
         m.new_counter("app_tpu_engine_restarts", "engine device-thread restarts")
         m.new_counter("app_tpu_prefix_hit_tokens", "prompt tokens served from the prefix cache")
         m.new_gauge("app_tpu_prefix_cached_pages", "KV pages held by the prefix cache")
+        m.new_counter("app_tpu_spec_proposed", "draft tokens proposed by speculative decoding")
+        m.new_counter("app_tpu_spec_accepted", "draft tokens accepted by target verification")
 
     def _sample_tpu_metrics(self, _registry=None) -> None:
         """Collect hook: live HBM gauges on every /metrics scrape (the

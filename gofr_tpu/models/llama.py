@@ -315,6 +315,50 @@ def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.nd
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
+def verify_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
+                positions: jnp.ndarray, cache: SlotKVCache) -> tuple[jnp.ndarray, SlotKVCache]:
+    """Speculative-decoding verification (engine.spec_tokens): one forward
+    over ``tokens`` [N, T] per slot — the current input token plus T-1
+    draft tokens — written and attended at positions ``positions[n]`` ..
+    ``positions[n]+T-1`` of slot n's cache. Returns logits [N, T, V] (f32,
+    the target's next-token distribution AFTER each of the T tokens) and
+    the updated cache.
+
+    Draft K/V beyond the accepted prefix go stale in the cache but are
+    always overwritten before they can be attended: the next round's write
+    range starts at the new input position and covers every stale slot
+    before its per-layer attention runs (engine._spec_chunk invariants).
+    Out-of-bounds positions (inactive lanes) drop their writes — the same
+    convention as prefill padding rows."""
+    cos, sin = _rope(cfg)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    n, t = tokens.shape
+    pos2d = positions[:, None] + jnp.arange(t)[None]
+    total = positions + t
+    rows = jnp.arange(n)
+
+    def body(x, xs):
+        lp, k_layer, v_layer = xs
+        q, k, v = _qkv(cfg, lp, x)
+        q = apply_rope(q, pos2d, cos, sin)
+        k = apply_rope(k, pos2d, cos, sin)
+        k_layer, v_layer = write_prompts(k_layer, v_layer, rows, k, v, positions)
+        attn = mha_attention(
+            q, k_layer.swapaxes(1, 2), v_layer.swapaxes(1, 2),
+            causal=True, q_offset=positions, kv_lengths=total,
+        )
+        x = x + qdot(attn.reshape(n, t, -1), lp["wo"])
+        x = x + _mlp(cfg, lp, x)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = qdot(x, head).astype(jnp.float32)
+    return logits, SlotKVCache(k=new_k, v=new_v)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=4)
 def decode_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, positions: jnp.ndarray,
                 cache: SlotKVCache) -> tuple[jnp.ndarray, SlotKVCache]:
     """One decode step over every slot.

@@ -39,37 +39,19 @@ def spmd_pipeline(
     pytree's structure and shapes (pass-through leaves like per-microbatch
     lengths just return unchanged). Returns outputs shaped like ``inputs``,
     replicated over the axis (psum-broadcast from the last stage).
-    """
-    p = lax.axis_size(axis)
-    stage = lax.axis_index(axis)
-    m = microbatches
-    perm = [(i, (i + 1) % p) for i in range(p)]
 
-    act0 = jax.tree.map(lambda x: jnp.zeros_like(x[0]), inputs)
-    outs0 = jax.tree.map(jnp.zeros_like, inputs)
-
-    def tick(carry, t):
-        outs, act = carry
-        feed_idx = jnp.minimum(t, m - 1)
-        feed = jax.tree.map(lambda x: lax.dynamic_index_in_dim(x, feed_idx, 0, keepdims=False), inputs)
-        cur = jax.tree.map(lambda f, a: jnp.where(stage == 0, f, a), feed, act)
-        out = stage_fn(stage_params, cur)
-        out_idx = jnp.clip(t - (p - 1), 0, m - 1)
-        write = jnp.logical_and(stage == p - 1, t >= p - 1)
-        outs = jax.tree.map(
-            lambda o_all, o: jnp.where(
-                write, lax.dynamic_update_index_in_dim(o_all, o, out_idx, 0), o_all
-            ),
-            outs, out,
-        )
-        act = jax.tree.map(lambda o: lax.ppermute(o, axis, perm), out)
-        return (outs, act), None
-
-    (outs, _), _ = lax.scan(tick, (outs0, act0), jnp.arange(m + p - 1))
-    # broadcast finished microbatches from the last stage to everyone
-    return jax.tree.map(
-        lambda o: lax.psum(jnp.where(stage == p - 1, o, jnp.zeros_like(o)), axis), outs
+    Stateless: bubble ticks compute on zeros and their outputs are
+    discarded by the schedule, so no dropped-write convention is needed —
+    the one-line delegation to the stateful variant keeps the tick
+    schedule (feed/out index clipping, drain re-feed, psum broadcast) in
+    exactly one place."""
+    outs, _ = spmd_pipeline_stateful(
+        lambda params, st, act: (st, stage_fn(params, act)),
+        stage_params, None, inputs,
+        axis=axis, microbatches=microbatches,
+        init_act=jax.tree.map(lambda x: jnp.zeros_like(x[0]), inputs),
     )
+    return outs
 
 
 def spmd_pipeline_stateful(

@@ -533,6 +533,7 @@ class GenerateEngine(_EngineBase):
         max_restarts: int = 3,
         decode_pipeline: int = 2,
         prefix_cache: bool = True,
+        spec_tokens: int = 0,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -555,8 +556,32 @@ class GenerateEngine(_EngineBase):
         # reference's per-request goroutine equivalent) and a device-resident
         # loop; it also keeps serving fast over high-latency device links.
         self.decode_chunk = max(1, decode_chunk)
+
+        # Speculative decoding (VERDICT r3 #6): prompt-lookup drafting on
+        # device — each outer decode step proposes spec_tokens continuation
+        # tokens from the slot's own token history (the most recent earlier
+        # occurrence of the current token; "prompt lookup decoding"), then
+        # ONE target forward verifies all of them (family.verify_step).
+        # Greedy acceptance emits the longest agreeing prefix plus the
+        # target's correction token, so outputs are bit-identical to plain
+        # greedy decode — up to spec_tokens+1 tokens per target forward at
+        # the memory-bound occupancies where decode wastes bandwidth.
+        self.spec_tokens = max(0, int(spec_tokens))
+        if self.spec_tokens:
+            if kv_layout != "slot":
+                raise ValueError("spec_tokens requires the slot KV layout (v1)")
+            if not hasattr(family, "verify_step"):
+                raise ValueError(
+                    f"family {getattr(family, '__name__', family)!r} has no verify_step; "
+                    "speculative decoding needs it"
+                )
+        # cache slack one chunk can write past max_len: each spec round
+        # writes up to spec_tokens+1 positions plus spec_tokens draft slots
+        chunk_span = (self.decode_chunk * (self.spec_tokens + 1) + self.spec_tokens
+                      if self.spec_tokens else self.decode_chunk)
+        self._chunk_span = chunk_span
         requested_max_len = self.max_len
-        self.max_len = min(self.max_len, cfg.max_seq_len - self.decode_chunk)
+        self.max_len = min(self.max_len, cfg.max_seq_len - chunk_span)
         if self.max_len < requested_max_len:
             # Chunked decode needs decode_chunk of cache headroom past the
             # last admitted position; surface the shrink so operators see why
@@ -603,7 +628,7 @@ class GenerateEngine(_EngineBase):
         else:
             # cache headroom so a chunk never writes past Smax; round to a
             # kernel-friendly multiple of 128 when the model allows it
-            cache_len = min(-(-(self.max_len + self.decode_chunk) // 128) * 128, cfg.max_seq_len)
+            cache_len = min(-(-(self.max_len + self._chunk_span) // 128) * 128, cfg.max_seq_len)
             self._cache_len = cache_len
             self.cache = family.make_cache(cfg, slots, cache_len)
             self._prefix = None  # prefix caching needs the paged layout
@@ -750,6 +775,52 @@ class GenerateEngine(_EngineBase):
                 )
                 return out.T, toks, cache  # [slots, K], [slots] carry
 
+            if self.spec_tokens:
+                g = self.spec_tokens
+                H = cache_len
+
+                # Spec packed layout [2 + H, n]:
+                #   [0] input token | [1] history length (hlen; the input
+                #   token is hist[hlen-1], its KV goes to position hlen-1)
+                #   | [2:] token history hist.T (prompt + generated so far).
+                # Inactive lanes ship hlen = H + 1: every cache/history
+                # write lands out of bounds and is dropped.
+                @partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+                def _spec_chunk(params, cache, steps, packed):
+                    n_l = packed.shape[1]
+                    tok0 = packed[0]
+                    hlen0 = packed[1]
+                    hist0 = packed[2:].T  # [n, H]
+                    idx = jnp.arange(H)
+
+                    def outer(carry, _):
+                        tok, hlen, hist, cache = carry
+                        pos = hlen - 1
+                        # prompt-lookup draft: continuation after the most
+                        # recent EARLIER occurrence of the current token
+                        match = (hist == tok[:, None]) & (idx[None, :] < pos[:, None])
+                        j = jnp.where(match, idx[None, :], -1).max(axis=1)  # -1 = miss
+                        take = jnp.clip(j[:, None] + 1 + jnp.arange(g)[None, :], 0, H - 1)
+                        drafts = jnp.take_along_axis(hist, take, axis=1)  # [n, g]
+                        seq = jnp.concatenate([tok[:, None], drafts], axis=1)
+                        logits, cache = family.verify_step(cfg, params, seq, pos, cache)
+                        tgt = jnp.argmax(logits, -1).astype(jnp.int32)  # [n, g+1]
+                        ok = jnp.cumprod((drafts == tgt[:, :g]).astype(jnp.int32), axis=1)
+                        acc = ok.sum(axis=1)  # accepted drafts per lane, 0..g
+                        nxt = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
+                        emit = jnp.arange(g + 1)[None, :] <= acc[:, None]
+                        wpos = jnp.where(emit, hlen[:, None] + jnp.arange(g + 1)[None, :], H)
+                        hist = hist.at[jnp.arange(n_l)[:, None], wpos].set(
+                            tgt, mode="drop")
+                        return (nxt, hlen + acc + 1, hist, cache), (tgt, acc)
+
+                    (_, _, _, cache), (toks, accs) = jax.lax.scan(
+                        outer, (tok0, hlen0, hist0, cache), None, length=steps
+                    )
+                    return toks, accs, cache  # [K, n, g+1], [K, n]
+
+                self._spec_chunk_fn = _spec_chunk
+
         self._prefill_sample = _prefill_sample
         self._decode_chunk = _decode_chunk
 
@@ -812,13 +883,25 @@ class GenerateEngine(_EngineBase):
             packed[5:] = self.total_pages  # OOB table: writes dropped
         else:
             packed[1, :] = self._cache_len  # OOB positions: writes dropped
-        out, _, self.cache = self._decode_chunk(
-            self.params, self._base_key, self.cache, k, jnp.asarray(packed),
-            jnp.zeros((n,), jnp.int32),
-        )
-        jax.block_until_ready(out)
-        self._compiled.add(("decode", n, k))
-        return count + 1
+        if not self.spec_tokens:
+            # spec mode never calls _dispatch_decode — don't compile the
+            # (expensive) plain decode program it would throw away
+            out, _, self.cache = self._decode_chunk(
+                self.params, self._base_key, self.cache, k, jnp.asarray(packed),
+                jnp.zeros((n,), jnp.int32),
+            )
+            jax.block_until_ready(out)
+            self._compiled.add(("decode", n, k))
+            count += 1
+        if self.spec_tokens:
+            spec_packed = np.zeros((2 + self._cache_len, n), np.int32)
+            spec_packed[1, :] = self._cache_len + 1  # all lanes OOB
+            toks, _, self.cache = self._spec_chunk_fn(
+                self.params, self.cache, k, jnp.asarray(spec_packed))
+            jax.block_until_ready(toks)
+            self._compiled.add(("decode_spec", n, k, self.spec_tokens))
+            count += 1
+        return count
 
     def submit(
         self,
@@ -1095,8 +1178,12 @@ class GenerateEngine(_EngineBase):
             # other slots keeps stepping between chunks (TTFT fairness)
             chunked = self._advance_chunked()
             # pipelined decode: dispatch chunk t, then block on chunk t-1 —
-            # its readback + host bookkeeping overlap chunk t's compute
-            dispatched = self._dispatch_decode()
+            # its readback + host bookkeeping overlap chunk t's compute.
+            # Speculative rounds are synchronous instead: positions depend
+            # on data (acceptance counts), so no chunk can be dispatched
+            # before the previous one is read back.
+            dispatched = (self._decode_round_spec() if self.spec_tokens
+                          else self._dispatch_decode())
             processed = False
             while len(self._dq) > (self.decode_pipeline - 1 if dispatched else 0):
                 processed = self._process_decode() or processed
@@ -1124,6 +1211,10 @@ class GenerateEngine(_EngineBase):
                     raise ValueError(f"prompt must be a non-empty 1-D token sequence, got shape {toks.shape}")
                 if toks.shape[0] >= self.max_len:
                     raise ValueError(f"prompt length {toks.shape[0]} ≥ engine max_len {self.max_len}")
+                if self.spec_tokens and float(req.kw.get("temperature", 0.0)) != 0.0:
+                    raise ValueError(
+                        "speculative decoding is greedy-only (v1): temperature must be 0"
+                    )
                 if toks.shape[0] > self.prefill_buckets[-1]:
                     if not self._chunked_ok:
                         raise ValueError(
@@ -1397,6 +1488,76 @@ class GenerateEngine(_EngineBase):
 
     # -- decode ----------------------------------------------------------------
 
+    def _decode_round_spec(self) -> bool:
+        """One synchronous speculative round: ``decode_chunk`` outer steps,
+        each drafting ``spec_tokens`` continuation tokens by prompt lookup
+        and verifying them with ONE target forward (family.verify_step).
+        Greedy acceptance makes the emitted stream bit-identical to plain
+        greedy decode; each round trip yields up to
+        decode_chunk*(spec_tokens+1) tokens per slot."""
+        with self._state_lock:
+            lanes = [(i, self.slots[i]) for i in self._active()
+                     if self.slots[i].pos < self.slots[i].max_total]
+            if not lanes:
+                return False
+            n = self.num_slots
+            H = self._cache_len
+            k = self.decode_chunk
+            packed = np.zeros((2 + H, n), np.int32)
+            packed[1, :] = H + 1  # inactive lanes: every write lands OOB
+            for i, s in lanes:
+                hist = np.concatenate([
+                    np.asarray(s.prompt_tokens, np.int32),
+                    np.asarray(s.generated, np.int32),
+                ])
+                packed[0, i] = s.last_token
+                packed[1, i] = hist.shape[0]  # == s.pos + 1
+                packed[2:2 + hist.shape[0], i] = hist
+            occupancy = len(lanes) / n
+            self._inflight = [s.request for _, s in lanes]
+            t0 = time.monotonic()
+
+        toks_dev, accs_dev, self.cache = self._spec_chunk_fn(
+            self.params, self.cache, k, jnp.asarray(packed))
+        toks = np.asarray(toks_dev)  # [k, n, g+1] int32 — tokens, never logits
+        accs = np.asarray(accs_dev)  # [k, n]
+
+        with self._state_lock:
+            self._inflight = []
+            if self._poisoned or self._stop.is_set():
+                return True
+            self._record_step("decode_spec", time.monotonic() - t0, occupancy,
+                              ("decode_spec", n, k, self.spec_tokens))
+            now = time.monotonic()
+            emitted = accepted = 0
+            for i, s in lanes:
+                if self.slots[i] is not s:
+                    continue
+                if s.request.cancelled or s.request.expired(now):
+                    self._free_slot(i)
+                    s.request.complete(error=RequestTimeout())
+                    continue
+                for kk in range(k):
+                    a = int(accs[kk, i])
+                    accepted += a
+                    for j in range(a + 1):
+                        tok = int(toks[kk, i, j])
+                        s.pos += 1
+                        s.last_token = tok
+                        s.generated.append(tok)
+                        emitted += 1
+                        self._emit(s, tok)
+                        self._maybe_finish(i)
+                        if self.slots[i] is not s:  # EOS/budget: rest discarded
+                            break
+                    if self.slots[i] is not s:
+                        break
+            self.metrics.increment_counter("app_tpu_tokens_total", emitted)
+            self.metrics.increment_counter(
+                "app_tpu_spec_proposed", k * self.spec_tokens * len(lanes))
+            self.metrics.increment_counter("app_tpu_spec_accepted", accepted)
+            return True
+
     def _dispatch_decode(self) -> bool:
         """Assemble and asynchronously dispatch one decode chunk. Positions
         are SPECULATIVE: a lane with a chunk already in flight decodes from
@@ -1567,16 +1728,18 @@ class GenerateEngine(_EngineBase):
         # leading space marker per decode call; the shared ctx prefix makes
         # any such artifact identical in both decodes and cancel). A piece
         # ending in U+FFFD holds a split multi-byte character until the
-        # next token completes it, but never past GOFR_STREAM_TAIL_MAX
-        # tokens — a model stuck on undecodable ids must not stall the
-        # stream or grow an O(n) re-decode. State lives on the REQUEST so
-        # it survives preemption-by-recompute; _maybe_finish flushes the
-        # remainder so the joined stream equals the final result text.
+        # next token completes it, but the tail never grows past
+        # STREAM_TAIL_MAX tokens — a model stuck on undecodable or
+        # empty-decoding ids must not stall the stream or grow an O(n)
+        # re-decode. State lives on the REQUEST so it survives preemption-
+        # by-recompute; _maybe_finish flushes the remainder so the joined
+        # stream equals the final result text.
         tail = slot.request.kw.setdefault("_stream_tail", [])
         tail.append(tok)
         piece = self._stream_diff(slot.request.kw, tail)
-        if piece and (not piece.endswith("�") or len(tail) > self.STREAM_TAIL_MAX):
-            slot.request.stream_q.put(piece)
+        if (piece and not piece.endswith("�")) or len(tail) > self.STREAM_TAIL_MAX:
+            if piece:
+                slot.request.stream_q.put(piece)
             slot.request.kw["_stream_ctx"] = (
                 slot.request.kw.get("_stream_ctx", []) + tail)[-self.STREAM_CTX_TOKENS:]
             tail.clear()
@@ -1728,16 +1891,35 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
         if eos is None and tokenizer is not None:
             eos = tokenizer.eos_token_id
         default_layout = "paged" if hasattr(family, "make_paged_cache") else "slot"
+        kv_layout = str(kw.pop("kv_layout", conf.get_or_default("ENGINE_KV_LAYOUT", default_layout)))
+        # spec_tokens follows the quantize precedent (above): an explicit
+        # per-model request errors on an incompatible setup, while the
+        # process-wide ENGINE_SPEC_TOKENS config only warns — it may
+        # legitimately target a different engine in the same app.
+        spec_kw = kw.pop("spec_tokens", None)
+        spec_tokens = int(spec_kw if spec_kw is not None else conf.get_int("ENGINE_SPEC_TOKENS", 0))
+        if spec_tokens and (kv_layout != "slot" or not hasattr(family, "verify_step")):
+            if spec_kw is not None:
+                raise ValueError(
+                    f"spec_tokens needs the slot KV layout and a family with "
+                    f"verify_step (layout={kv_layout!r}, family={getattr(family, '__name__', family)!r})"
+                )
+            container.logger.warn(
+                f"ENGINE_SPEC_TOKENS ignored for family "
+                f"{getattr(family, '__name__', family)!r} (needs slot layout + verify_step)"
+            )
+            spec_tokens = 0
         return GenerateEngine(
             family, cfg, params, container,
             slots=int(kw.pop("slots", conf.get_int("ENGINE_SLOTS", 8))),
             max_len=int(kw.pop("max_len", conf.get_int("ENGINE_MAX_LEN", 2048))),
             decode_chunk=int(kw.pop("decode_chunk", conf.get_int("ENGINE_DECODE_CHUNK", 8))),
             max_prefill_batch=int(kw.pop("max_prefill_batch", conf.get_int("ENGINE_PREFILL_BATCH", 4))),
-            kv_layout=str(kw.pop("kv_layout", conf.get_or_default("ENGINE_KV_LAYOUT", default_layout))),
+            kv_layout=kv_layout,
             page_size=int(kw.pop("page_size", conf.get_int("ENGINE_PAGE_SIZE", 128))),
             total_pages=int(kw.pop("total_pages", conf.get_int("ENGINE_TOTAL_PAGES", 0))) or None,
             prefix_cache=bool(kw.pop("prefix_cache", conf.get_bool("ENGINE_PREFIX_CACHE", True))),
+            spec_tokens=spec_tokens,
             decode_pipeline=int(kw.pop("decode_pipeline", conf.get_int("ENGINE_DECODE_PIPELINE", 2))),
             eos_token_id=eos,
             tokenizer=tokenizer,

@@ -1,0 +1,155 @@
+"""Speculative decoding (VERDICT r3 #6): prompt-lookup drafts + one-forward
+verification must be BIT-IDENTICAL to plain greedy decode — acceptance rate
+only changes how many device rounds it takes, never the tokens."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import LlamaConfig, llama
+from gofr_tpu.tpu.engine import GenerateEngine
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(7))
+
+    def ref(prompt, n_new):
+        seq = list(prompt)
+        for _ in range(n_new):
+            logits = llama.forward(cfg, params, jnp.asarray([seq], jnp.int32))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        return seq[len(prompt):]
+
+    return cfg, params, ref
+
+
+def make_engine(cfg, params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("max_prefill_batch", 2)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("spec_tokens", 3)
+    return GenerateEngine(llama, cfg, params, new_mock_container(), **kw)
+
+
+def _counter(eng, name):
+    m = eng.metrics.get(name)
+    return sum(m._values.values()) if m is not None else 0
+
+
+def test_verify_step_matches_sequential_decode(setup):
+    """llama.verify_step over [input, d1, d2] must produce the same
+    next-token logits as running decode_step on each token sequentially,
+    and leave an equivalent cache behind."""
+    cfg, params, _ = setup
+    prompt = [5, 3, 9, 11]
+    seq_cache = llama.make_cache(cfg, 2, 32)
+    ver_cache = llama.make_cache(cfg, 2, 32)
+    logits, seq_cache = llama.prefill(
+        cfg, params, jnp.asarray([prompt, prompt], jnp.int32),
+        jnp.asarray([4, 4], jnp.int32), seq_cache, jnp.asarray([0, 1], jnp.int32))
+    _, ver_cache = llama.prefill(
+        cfg, params, jnp.asarray([prompt, prompt], jnp.int32),
+        jnp.asarray([4, 4], jnp.int32), ver_cache, jnp.asarray([0, 1], jnp.int32))
+    t0 = int(jnp.argmax(logits[0]))
+
+    # sequential: three decode steps
+    toks, seq_logits = [t0], []
+    pos = 4
+    for _ in range(3):
+        lg, seq_cache = llama.decode_step(
+            cfg, params, jnp.asarray([toks[-1]] * 2, jnp.int32),
+            jnp.asarray([pos, pos], jnp.int32), seq_cache)
+        seq_logits.append(np.asarray(lg[0]))
+        toks.append(int(jnp.argmax(lg[0])))
+        pos += 1
+
+    # verification: one forward over the same three tokens
+    ver_logits, ver_cache = llama.verify_step(
+        cfg, params, jnp.asarray([toks[:3], toks[:3]], jnp.int32),
+        jnp.asarray([4, 4], jnp.int32), ver_cache)
+    for j in range(3):
+        np.testing.assert_allclose(
+            np.asarray(ver_logits[0, j]), seq_logits[j], rtol=2e-4, atol=2e-4)
+
+
+class TestSpecEngine:
+    def test_single_request_matches_reference(self, setup):
+        cfg, params, ref = setup
+        eng = make_engine(cfg, params)
+        try:
+            out = eng.generate([5, 3, 9], max_new_tokens=12, timeout=120)
+            assert out["tokens"] == ref([5, 3, 9], 12)
+            assert out["finish_reason"] == "length"
+            assert _counter(eng, "app_tpu_spec_proposed") > 0
+        finally:
+            eng.stop()
+
+    def test_concurrent_requests_match_reference(self, setup):
+        cfg, params, ref = setup
+        eng = make_engine(cfg, params)
+        prompts = [[i + 1, (2 * i) % 200 + 1, (7 * i) % 150] for i in range(8)]
+        want = [ref(p, 8) for p in prompts]
+        results = [None] * len(prompts)
+
+        def worker(i):
+            results[i] = eng.generate(prompts[i], max_new_tokens=8, timeout=300)
+
+        try:
+            threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            for i, r in enumerate(results):
+                assert r is not None, f"request {i} did not complete"
+                assert r["tokens"] == want[i], f"request {i} diverged under speculation"
+        finally:
+            eng.stop()
+
+    def test_acceptance_happens_on_cyclic_output(self, setup):
+        """Greedy decode from random weights falls into cycles, so the
+        prompt-lookup draft must land real acceptances (the premise behind
+        the throughput win; measured 35-50%% on this model class)."""
+        cfg, params, ref = setup
+        eng = make_engine(cfg, params)
+        try:
+            out = eng.generate([5, 3, 9], max_new_tokens=40, timeout=300)
+            assert out["tokens"] == ref([5, 3, 9], 40)
+            assert _counter(eng, "app_tpu_spec_accepted") > 0, (
+                "no draft token ever accepted over a 40-token cyclic generation"
+            )
+        finally:
+            eng.stop()
+
+    def test_eos_mid_round_truncates(self, setup):
+        cfg, params, ref = setup
+        want = ref([5, 3, 9], 20)
+        eos = want[5]  # force a stop partway through
+        eng = make_engine(cfg, params, eos_token_id=eos)
+        try:
+            out = eng.generate([5, 3, 9], max_new_tokens=20, timeout=120)
+            assert out["finish_reason"] == "stop"
+            assert out["tokens"] == want[:5]
+        finally:
+            eng.stop()
+
+    def test_sampling_rejected(self, setup):
+        cfg, params, _ = setup
+        eng = make_engine(cfg, params)
+        try:
+            with pytest.raises(ValueError, match="greedy-only"):
+                eng.generate([5, 3, 9], max_new_tokens=4, temperature=0.8, timeout=120)
+        finally:
+            eng.stop()
+
+    def test_paged_layout_rejected(self, setup):
+        cfg, params, _ = setup
+        with pytest.raises(ValueError, match="slot KV layout"):
+            make_engine(cfg, params, kv_layout="paged", page_size=8)
