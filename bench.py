@@ -15,7 +15,8 @@ timeout, retry once, and on failure pin the CPU backend and run a scaled
 preset — the JSON line always appears, with the platform reported honestly.
 
 Env knobs:
-    GOFR_BENCH_PRESET         one_b (default on TPU) | tiny (default on CPU fallback)
+    GOFR_BENCH_PRESET         one_b (default on TPU) | eight_b (Llama-3-8B shape,
+                              the north-star model class) | tiny (CPU fallback default)
     GOFR_BENCH_REQUESTS       total requests (default 512 TPU / 8 CPU)
     GOFR_BENCH_SLOTS          decode slots (default 128 TPU / 16 CPU)
     GOFR_BENCH_CHUNK          decode chunk (default 32 TPU / 8 CPU)
@@ -26,6 +27,10 @@ Env knobs:
     GOFR_BENCH_PLATFORM       force 'cpu' or 'tpu' (skips the probe)
     GOFR_BENCH_PROBE_S        TPU init probe timeout seconds (default 240)
     GOFR_BENCH_KV             'slot' (default) | 'paged' engine KV layout
+    GOFR_BENCH_KV_QUANTIZE    'int8' = int8 KV cache (slot layout only)
+    GOFR_BENCH_SPEC           N>0 = speculative decoding with N lookup drafts
+    GOFR_BENCH_PREFIX         1 = also measure the shared-prefix workload on the
+                              paged engine (prefix cache on vs off)
     GOFR_BENCH_PIPELINE       decode dispatch pipelining depth (default 2; 1 = sync)
     GOFR_BENCH_LATENCY        1 = also measure sequential single-request latency
     GOFR_BENCH_SWEEP          1 = sweep slots x decode_chunk, keep best
@@ -232,7 +237,11 @@ def main() -> None:
     max_new = int(os.environ.get("GOFR_BENCH_NEW", "16" if on_cpu else "64"))
     timeout = 600.0 if on_cpu else 1200.0
 
-    cfg = LlamaConfig.tiny() if preset == "tiny" else LlamaConfig.one_b()
+    presets = {"tiny": LlamaConfig.tiny, "one_b": LlamaConfig.one_b,
+               "eight_b": LlamaConfig.llama3_8b}
+    if preset not in presets:
+        raise SystemExit(f"GOFR_BENCH_PRESET={preset!r}: use {sorted(presets)}")
+    cfg = presets[preset]()
 
     container = new_mock_container()
     params = llama.init(cfg, jax.random.key(0))
@@ -271,12 +280,22 @@ def main() -> None:
         raise SystemExit(f"GOFR_BENCH_PIPELINE={pipeline_env!r}: use 1 (sync) or 2 (pipelined)")
     pipeline = int(pipeline_env)
 
+    kv_quantize = os.environ.get("GOFR_BENCH_KV_QUANTIZE", "")
+    if kv_quantize not in ("", "int8"):
+        raise SystemExit(f"GOFR_BENCH_KV_QUANTIZE={kv_quantize!r}: only 'int8' (or empty)")
+    spec_tokens = int(os.environ.get("GOFR_BENCH_SPEC", "0"))
+
     def engine_kw(s: int, k: int) -> dict:
         kw = dict(slots=s, max_len=prompt_len + max_new + 8,
                   max_prefill_batch=prefill_batch, decode_chunk=k,
                   prefill_buckets=[prompt_len], decode_pipeline=pipeline)
         if kv_layout == "paged":
             kw.update(kv_layout="paged", page_size=128)
+        else:
+            if kv_quantize:
+                kw.update(kv_quantize=kv_quantize)
+            if spec_tokens:
+                kw.update(spec_tokens=spec_tokens)
         return kw
 
     best = (slots, decode_chunk)
@@ -304,6 +323,12 @@ def main() -> None:
             if rate > best_rate:
                 best_rate, best = rate, (s, k)
 
+    def _counter_total(cont, name) -> float:
+        mm = cont.metrics.get(name)
+        return sum(mm._values.values()) if mm is not None else 0.0
+
+    spec_acc0 = _counter_total(container, "app_tpu_spec_accepted")
+    spec_prop0 = _counter_total(container, "app_tpu_spec_proposed")
     try:
         m = _run_once(engine_kw(*best), cfg, params, container, llama,
                       prompts, max_new, timeout)
@@ -358,6 +383,16 @@ def main() -> None:
     }
     if kv_layout != "slot":
         extra["kv_layout"] = kv_layout
+    if kv_quantize:
+        extra["kv_quantize"] = kv_quantize
+    if spec_tokens:
+        extra["spec_tokens"] = spec_tokens
+        # delta vs the pre-headline snapshot: sweep/warmup runs share the
+        # process-wide container counters and must not pollute the ratio
+        acc_d = _counter_total(container, "app_tpu_spec_accepted") - spec_acc0
+        prop_d = _counter_total(container, "app_tpu_spec_proposed") - spec_prop0
+        if prop_d:
+            extra["spec_acceptance"] = round(acc_d / prop_d, 3)
     if "phases" in m:
         extra["phases"] = m["phases"]
         extra["device_seconds"] = m["device_seconds"]
@@ -389,6 +424,39 @@ def main() -> None:
             extra["single_request_error"] = str(e)[:200]
     if sweep_log:
         extra["sweep"] = sweep_log
+
+    # shared-prefix workload on the paged engine: every prompt shares a
+    # 2-page (256-token) prefix; prefix caching serves it from cached KV
+    # pages after the first request (tpu/prefix.py). A/B on vs off.
+    if os.environ.get("GOFR_BENCH_PREFIX") == "1":
+        n_pref = max(8, n_requests // 4)
+        # 2 shared pages + a half-page unique tail, scaled down for tiny
+        # configs so the CPU fallback still smoke-tests the path
+        ppage = 128 if cfg.max_seq_len >= 512 else 16
+        shared = rng.randint(1, cfg.vocab_size, size=2 * ppage).tolist()
+        tail = ppage // 2
+        pprompts = [shared + rng.randint(1, cfg.vocab_size, size=tail).tolist()
+                    for _ in range(n_pref)]
+        pref_ab: dict = {}
+        hits0 = _counter_total(container, "app_tpu_prefix_hit_tokens")
+        for mode, on in (("on", True), ("off", False)):
+            pkw = dict(slots=best[0], max_len=2 * ppage + tail + max_new + 8,
+                       max_prefill_batch=prefill_batch, decode_chunk=best[1],
+                       prefill_buckets=[tail, 2 * ppage + tail],
+                       decode_pipeline=pipeline,
+                       kv_layout="paged", page_size=ppage, prefix_cache=on)
+            try:
+                m2 = _run_once(pkw, cfg, params, container, llama, pprompts,
+                               max_new, timeout)
+                pref_ab[mode] = {
+                    "req_per_s": round(len(pprompts) / m2["elapsed"], 2),
+                    "ttft_p50_s": round(_percentile(m2["ttfts"], 50), 4),
+                }
+            except Exception as e:  # noqa: BLE001
+                pref_ab[mode] = f"error: {e}"[:160]
+        pref_ab["hit_tokens"] = int(
+            _counter_total(container, "app_tpu_prefix_hit_tokens") - hits0)
+        extra["prefix_ab"] = pref_ab
 
     # kernel A/B on the chip: engine throughput with the Pallas kernels
     # forced on vs off (fresh engines retrace under the env toggle)

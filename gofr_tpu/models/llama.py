@@ -29,9 +29,17 @@ from jax import lax
 
 from gofr_tpu.models.base import fan_in_init, truncated_normal
 from gofr_tpu.ops import apply_rope, mha_attention, rms_norm, rope_table
-from gofr_tpu.ops.attention import decode_attention, paged_decode_attention
+from gofr_tpu.ops.attention import decode_attention, decode_attention_q, paged_decode_attention
 from gofr_tpu.ops.quant import qdot
-from gofr_tpu.ops.kvcache import SlotKVCache, append_tokens, write_prompts
+from gofr_tpu.ops.kvcache import (
+    QSlotKVCache,
+    SlotKVCache,
+    append_tokens,
+    append_tokens_q,
+    dequantize_view,
+    write_prompts,
+    write_prompts_q,
+)
 from gofr_tpu.ops.paged import PagedKVCache, append_tokens_paged, gather_kv, write_prompts_paged
 
 
@@ -286,16 +294,30 @@ def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.nd
     positions = (offsets[:, None] if chunked else 0) + jnp.arange(s)[None]
     row = jnp.arange(b)
     total = (offsets + lengths) if chunked else lengths
+    quant = isinstance(cache, QSlotKVCache)  # int8 KV storage (kvcache.py)
 
     def body(x, xs):
-        lp, k_layer, v_layer = xs
+        if quant:
+            lp, k_layer, ks_l, v_layer, vs_l = xs
+        else:
+            lp, k_layer, v_layer = xs
         q, k, v = _qkv(cfg, lp, x)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
-        k_layer, v_layer = write_prompts(k_layer, v_layer, slots, k, v, offsets)
+        if quant:
+            k_layer, ks_l = write_prompts_q(k_layer, ks_l, slots, k, offsets)
+            v_layer, vs_l = write_prompts_q(v_layer, vs_l, slots, v, offsets)
+        else:
+            k_layer, v_layer = write_prompts(k_layer, v_layer, slots, k, v, offsets)
         if chunked:
-            k_view = jnp.take(k_layer, slots, axis=0)  # [B, Hkv, Smax, D]
-            v_view = jnp.take(v_layer, slots, axis=0)
+            if quant:
+                k_view = dequantize_view(jnp.take(k_layer, slots, axis=0),
+                                         jnp.take(ks_l, slots, axis=0), cfg.dtype)
+                v_view = dequantize_view(jnp.take(v_layer, slots, axis=0),
+                                         jnp.take(vs_l, slots, axis=0), cfg.dtype)
+            else:
+                k_view = jnp.take(k_layer, slots, axis=0)  # [B, Hkv, Smax, D]
+                v_view = jnp.take(v_layer, slots, axis=0)
             attn = mha_attention(
                 q, k_view.swapaxes(1, 2), v_view.swapaxes(1, 2),
                 causal=True, q_offset=offsets, kv_lengths=total,
@@ -304,14 +326,20 @@ def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.nd
             attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
         x = x + qdot(attn.reshape(b, s, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
-        return x, (k_layer, v_layer)
+        return x, (k_layer, ks_l, v_layer, vs_l) if quant else (k_layer, v_layer)
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    if quant:
+        xs = (params["blocks"], cache.k, cache.ks, cache.v, cache.vs)
+        x, (new_k, new_ks, new_v, new_vs) = lax.scan(body, x, xs)
+        out_cache = QSlotKVCache(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
+    else:
+        x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+        out_cache = SlotKVCache(k=new_k, v=new_v)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last = x[row, lengths - 1]  # [B,E]
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(last, head).astype(jnp.float32)
-    return logits, SlotKVCache(k=new_k, v=new_v)
+    return logits, out_cache
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
@@ -336,26 +364,43 @@ def verify_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
     pos2d = positions[:, None] + jnp.arange(t)[None]
     total = positions + t
     rows = jnp.arange(n)
+    quant = isinstance(cache, QSlotKVCache)
 
     def body(x, xs):
-        lp, k_layer, v_layer = xs
+        if quant:
+            lp, k_layer, ks_l, v_layer, vs_l = xs
+        else:
+            lp, k_layer, v_layer = xs
         q, k, v = _qkv(cfg, lp, x)
         q = apply_rope(q, pos2d, cos, sin)
         k = apply_rope(k, pos2d, cos, sin)
-        k_layer, v_layer = write_prompts(k_layer, v_layer, rows, k, v, positions)
+        if quant:
+            k_layer, ks_l = write_prompts_q(k_layer, ks_l, rows, k, positions)
+            v_layer, vs_l = write_prompts_q(v_layer, vs_l, rows, v, positions)
+            k_view = dequantize_view(k_layer, ks_l, cfg.dtype)
+            v_view = dequantize_view(v_layer, vs_l, cfg.dtype)
+        else:
+            k_layer, v_layer = write_prompts(k_layer, v_layer, rows, k, v, positions)
+            k_view, v_view = k_layer, v_layer
         attn = mha_attention(
-            q, k_layer.swapaxes(1, 2), v_layer.swapaxes(1, 2),
+            q, k_view.swapaxes(1, 2), v_view.swapaxes(1, 2),
             causal=True, q_offset=positions, kv_lengths=total,
         )
         x = x + qdot(attn.reshape(n, t, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
-        return x, (k_layer, v_layer)
+        return x, (k_layer, ks_l, v_layer, vs_l) if quant else (k_layer, v_layer)
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    if quant:
+        xs = (params["blocks"], cache.k, cache.ks, cache.v, cache.vs)
+        x, (new_k, new_ks, new_v, new_vs) = lax.scan(body, x, xs)
+        out_cache = QSlotKVCache(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
+    else:
+        x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+        out_cache = SlotKVCache(k=new_k, v=new_v)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(x, head).astype(jnp.float32)
-    return logits, SlotKVCache(k=new_k, v=new_v)
+    return logits, out_cache
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
@@ -373,30 +418,54 @@ def decode_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, positions: 
     x = params["embed"][tokens].astype(cfg.dtype)  # [N,E]
     n = tokens.shape[0]
     pos1 = positions[:, None]  # [N,1]
+    quant = isinstance(cache, QSlotKVCache)
 
     def body(x, xs):
-        lp, k_layer, v_layer = xs
+        if quant:
+            lp, k_layer, ks_l, v_layer, vs_l = xs
+        else:
+            lp, k_layer, v_layer = xs
         q, k, v = _qkv(cfg, lp, x[:, None])  # seq dim of 1
         q = apply_rope(q, pos1, cos, sin)[:, 0]  # [N,Hq,D]
         k = apply_rope(k, pos1, cos, sin)[:, 0]
         v = v[:, 0]
-        k_layer, v_layer = append_tokens(k_layer, v_layer, positions, k, v)
-        attn = decode_attention(q, k_layer, v_layer, positions + 1)
+        if quant:
+            k_layer, ks_l = append_tokens_q(k_layer, ks_l, positions, k)
+            v_layer, vs_l = append_tokens_q(v_layer, vs_l, positions, v)
+            attn = decode_attention_q(q, k_layer, v_layer, ks_l, vs_l, positions + 1)
+        else:
+            k_layer, v_layer = append_tokens(k_layer, v_layer, positions, k, v)
+            attn = decode_attention(q, k_layer, v_layer, positions + 1)
         x = x + qdot(attn.reshape(n, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
-        return x, (k_layer, v_layer)
+        return x, (k_layer, ks_l, v_layer, vs_l) if quant else (k_layer, v_layer)
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    if quant:
+        xs = (params["blocks"], cache.k, cache.ks, cache.v, cache.vs)
+        x, (new_k, new_ks, new_v, new_vs) = lax.scan(body, x, xs)
+        out_cache = QSlotKVCache(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
+    else:
+        x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+        out_cache = SlotKVCache(k=new_k, v=new_v)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(x, head).astype(jnp.float32)
-    return logits, SlotKVCache(k=new_k, v=new_v)
+    return logits, out_cache
 
 
 def make_cache(cfg: LlamaConfig, slots: int, max_len: int | None = None) -> SlotKVCache:
     return SlotKVCache.create(
         cfg.num_layers, slots, max_len or cfg.max_seq_len, cfg.num_kv_heads,
         cfg.head_size, dtype=cfg.dtype,
+    )
+
+
+def make_cache_q(cfg: LlamaConfig, slots: int, max_len: int | None = None) -> QSlotKVCache:
+    """int8 KV cache (kvcache.QSlotKVCache): same serving contract as
+    make_cache — prefill/decode_step/verify_step branch on the cache type."""
+    return QSlotKVCache.create(
+        cfg.num_layers, slots, max_len or cfg.max_seq_len, cfg.num_kv_heads,
+        cfg.head_size,
     )
 
 

@@ -196,6 +196,38 @@ def decode_attention(
     return out.reshape(b, hq, d)
 
 
+def decode_attention_q(
+    q: jnp.ndarray,        # [B, Hq, D]
+    k_cache: jnp.ndarray,  # int8 [B, Hkv, Smax, D]
+    v_cache: jnp.ndarray,  # int8 [B, Hkv, Smax, D]
+    k_scale: jnp.ndarray,  # [B, Hkv, Smax]
+    v_scale: jnp.ndarray,  # [B, Hkv, Smax]
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """decode_attention over an int8 KV cache (ops.kvcache.QSlotKVCache).
+
+    The int8 operands convert at the matmul input (XLA fuses the convert
+    into the operand read — HBM traffic stays int8, the same mechanism as
+    weight-only qdot, ops/quant.py:52). Per-position scales fold OUTSIDE
+    the contractions: ``ks`` multiplies scores per key position (constant
+    along the D reduction) and ``vs`` rides the probabilities (constant
+    along the T reduction)."""
+    b, hq, d = q.shape
+    _, hkv, smax, _ = k_cache.shape
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    qg = q.reshape(b, hkv, hq // hkv, d)
+    scores = jnp.einsum("bkgd,bktd->bkgt", qg, k_cache.astype(q.dtype)).astype(jnp.float32)
+    scores = scores * k_scale[:, :, None, :].astype(jnp.float32) * scale
+    mask = jnp.arange(smax)[None, :] < lengths[:, None]
+    scores = jnp.where(mask[:, None, None], scores, NEG_INF)
+    probs = _softmax(scores)
+    pv = (probs * v_scale[:, :, None, :].astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum("bkgt,bktd->bkgd", pv, v_cache.astype(q.dtype))
+    return out.reshape(b, hq, d)
+
+
 def paged_decode_attention(
     q: jnp.ndarray,
     k_pool: jnp.ndarray,

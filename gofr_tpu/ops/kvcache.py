@@ -57,6 +57,100 @@ class SlotKVCache:
         return self.k.shape[3]
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class QSlotKVCache:
+    """int8 KV cache with per-(slot, head, position) symmetric scales.
+
+    Decode attention reads dominate HBM traffic at long context; storing
+    K/V as int8 halves them. Scales live per cached ROW (reduction over D),
+    so dequantization folds into the attention matmuls the same way weight
+    scales fold into qdot (ops/quant.py): scores pick up ``ks`` per key
+    position (constant along the D contraction), and the value matmul picks
+    up ``vs`` on the probabilities (constant along its T contraction) —
+    the int8 buffers convert at the matmul input and HBM traffic stays
+    int8. Scale overhead: 2/D of the cache bytes (bf16 scales)."""
+
+    k: jnp.ndarray   # int8 [L, B, Hkv, Smax, D]
+    v: jnp.ndarray   # int8 [L, B, Hkv, Smax, D]
+    ks: jnp.ndarray  # bf16 [L, B, Hkv, Smax]
+    vs: jnp.ndarray  # bf16 [L, B, Hkv, Smax]
+
+    @classmethod
+    def create(cls, layers: int, slots: int, max_len: int, kv_heads: int,
+               head_dim: int, dtype=jnp.bfloat16) -> "QSlotKVCache":
+        del dtype  # storage is int8 by definition; arg kept for API parity
+        shape = (layers, slots, kv_heads, max_len, head_dim)
+        sshape = (layers, slots, kv_heads, max_len)
+        return cls(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            ks=jnp.zeros(sshape, jnp.bfloat16), vs=jnp.zeros(sshape, jnp.bfloat16),
+        )
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+
+def quantize_row(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 over the last (head_dim) axis: returns (q int8,
+    scale[...] f32 without the reduced axis)."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def write_prompts_q(
+    cache_q: jnp.ndarray,   # int8 [Slots, Hkv, Smax, D] (one of k/v)
+    cache_s: jnp.ndarray,   # [Slots, Hkv, Smax] scales
+    slots: jnp.ndarray,
+    new: jnp.ndarray,       # [B, S, Hkv, D] activation layout
+    offsets: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantized analog of write_prompts for ONE of the k/v planes."""
+    b, s, hkv, _ = new.shape
+    q, sc = quantize_row(new)  # [B,S,Hkv,D] int8, [B,S,Hkv]
+    rows = slots[:, None, None]
+    heads = jnp.arange(hkv)[None, :, None]
+    pos = jnp.arange(s)[None, None, :]
+    if offsets is not None:
+        pos = pos + offsets[:, None, None]
+    cache_q = cache_q.at[rows, heads, pos].set(q.swapaxes(1, 2))
+    cache_s = cache_s.at[rows, heads, pos].set(sc.swapaxes(1, 2).astype(cache_s.dtype))
+    return cache_q, cache_s
+
+
+def append_tokens_q(
+    cache_q: jnp.ndarray,   # int8 [B, Hkv, Smax, D]
+    cache_s: jnp.ndarray,   # [B, Hkv, Smax]
+    positions: jnp.ndarray,
+    new: jnp.ndarray,       # [B, Hkv, D]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantized analog of append_tokens (masked-select lowering; OOB
+    positions drop) for one of the k/v planes."""
+    smax = cache_q.shape[2]
+    q, sc = quantize_row(new)  # [B,Hkv,D] int8, [B,Hkv]
+    mask = (positions[:, None] == jnp.arange(smax)[None, :])  # [B, Smax]
+    cache_q = jnp.where(mask[:, None, :, None], q[:, :, None, :], cache_q)
+    cache_s = jnp.where(mask[:, None, :], sc[:, :, None].astype(cache_s.dtype), cache_s)
+    return cache_q, cache_s
+
+
+def dequantize_view(cache_q: jnp.ndarray, cache_s: jnp.ndarray, dtype) -> jnp.ndarray:
+    """[.., Smax, D] int8 × [.., Smax] scales → dense dtype view (the
+    chunked-prefill gather path; attention proper keeps int8 reads)."""
+    return cache_q.astype(dtype) * cache_s[..., None].astype(dtype)
+
+
 def write_prompts(
     k_layer: jnp.ndarray,
     v_layer: jnp.ndarray,
@@ -102,13 +196,28 @@ def append_tokens(
     """Append one token's K/V per slot: k_new [B, Hkv, D] written at
     ``positions`` [B] in each slot's sequence dimension.
 
-    Implemented as a masked full-buffer select, NOT a scatter. Measured on
-    TPU v5e (round 3, 1B llama decode chunk, 64 slots): XLA lowers the
-    advanced-indexing scatter inside the decode scan to something that
-    scales with Smax and dominates the step — 6429 tok/s (scatter) vs 8893
-    (select) at Smax=256, 2123 vs 4074 at Smax=1024. The select rewrites
-    the whole layer buffer but fuses into one bandwidth-shaped pass, which
-    the scatter evidently also pays (a non-aliased copy) without the fusion."""
+    Two lowerings, chosen by ``GOFR_KV_WRITE`` (read at TRACE time; jit
+    caches traces process-globally, so A/B across processes):
+
+    - ``select`` (default): masked full-buffer select — beat XLA's scatter
+      ~1.4-2x on v5e round 3 (6429 scatter vs 8893 select tok/s at
+      Smax=256, 2123 vs 4074 at Smax=1024) but still rewrites the whole
+      layer buffer every step: O(N*Hkv*Smax*D) HBM traffic.
+    - ``pallas``: in-place tile-patch kernel (ops/pallas/kv_append) —
+      O(N*Hkv*block*D) traffic via input/output aliasing; requires a TPU
+      (or the Pallas interpreter), falls back to select elsewhere."""
+    import os
+
+    if os.environ.get("GOFR_KV_WRITE", "select") == "pallas":
+        from gofr_tpu.ops.pallas import interpret_mode, kernel_platform
+
+        if kernel_platform():
+            from gofr_tpu.ops.pallas.kv_append import append_tokens_inplace
+
+            return append_tokens_inplace(
+                k_layer, v_layer, positions, k_new, v_new,
+                interpret=interpret_mode(),
+            )
     smax = k_layer.shape[2]
     mask = (positions[:, None] == jnp.arange(smax)[None, :])[:, None, :, None]
     k_layer = jnp.where(mask, k_new.astype(k_layer.dtype)[:, :, None, :], k_layer)

@@ -116,10 +116,22 @@ def append_tokens_paged(
     dropping OOB updates)."""
     n, hkv, d = k_new.shape
     p_total, _, page, _ = k_layer.shape
+
+    if os.environ.get("GOFR_PAGED_KV_WRITE", "select") == "pallas":
+        from gofr_tpu.ops.pallas import interpret_mode, kernel_platform
+
+        if kernel_platform():
+            from gofr_tpu.ops.pallas.kv_append import append_tokens_paged_inplace
+
+            return append_tokens_paged_inplace(
+                k_layer, v_layer, table, positions, k_new, v_new,
+                interpret=interpret_mode(),
+            )
+
     pp = jnp.take_along_axis(table, (positions // page)[:, None], axis=1)[:, 0]  # [N]
     off = positions % page
 
-    if os.environ.get("GOFR_PAGED_KV_WRITE", "select") == "select":
+    if os.environ.get("GOFR_PAGED_KV_WRITE", "select") != "scatter":
         flat = pp * page + off  # [N]; OOB rows land >= p_total*page
         grid = jnp.arange(p_total * page)
         m = flat[:, None] == grid[None, :]  # [N, P*page]

@@ -1,0 +1,174 @@
+"""In-place KV append as a Pallas kernel (decode-bandwidth lever).
+
+The XLA lowerings of the per-step KV append both pay O(cache) HBM traffic:
+the masked-select path rewrites the ENTIRE layer buffer every decode step
+(read + write of [N, Hkv, Smax, D]), and the scatter path materializes a
+non-aliased copy (BASELINE.md round-3 select-vs-scatter notes). But the
+append itself only CHANGES one [Hkv, D] row per slot. This kernel writes in
+place via ``input_output_aliases``: the grid walks slots, scalar-prefetched
+positions pick the [block_s, D] tile containing each slot's write row
+(data-dependent BlockSpec index_map), and the kernel copies that one tile
+through with the new row patched in. Per-step traffic drops from
+O(N·Hkv·Smax·D) to O(N·Hkv·block_s·D) — a (Smax/block_s)× reduction on the
+axis long-context decode is bound by.
+
+Out-of-bounds convention (engine padding/bubble rows): positions >= Smax
+clamp to the last tile in the index_map and the row store is skipped, so
+the tile is copied through unchanged — the same dropped-write semantics as
+the XLA paths (ops/kvcache.append_tokens, ops/paged.append_tokens_paged).
+
+The paged variant routes the tile pick through the slot's block table
+(physical page = table[n, pos // page]), writing straight into the pool.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_block(total: int, desired: int) -> int:
+    if total <= desired:
+        return total
+    for cand in range(desired, 0, -1):
+        if total % cand == 0:
+            return cand
+    return total
+
+
+def _append_kernel(pos_ref, knew_ref, vnew_ref, k_ref, v_ref, ko_ref, vo_ref,
+                   *, block_s: int, smax: int):
+    n = pl.program_id(0)
+    pos = pos_ref[n]
+    # copy the resident tile through (aliased output: same HBM buffer, but
+    # the VMEM out block must be fully defined)
+    ko_ref[0] = k_ref[0]
+    vo_ref[0] = v_ref[0]
+
+    @pl.when(pos < smax)
+    def _():
+        off = pos % block_s
+        ko_ref[0, :, pl.ds(off, 1), :] = knew_ref[0][:, None, :].astype(ko_ref.dtype)
+        vo_ref[0, :, pl.ds(off, 1), :] = vnew_ref[0][:, None, :].astype(vo_ref.dtype)
+
+
+def append_tokens_inplace(
+    k_layer: jnp.ndarray,   # [N, Hkv, Smax, D]
+    v_layer: jnp.ndarray,
+    positions: jnp.ndarray, # [N]
+    k_new: jnp.ndarray,     # [N, Hkv, D]
+    v_new: jnp.ndarray,
+    *,
+    block_s: int = 128,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Slot-cache append writing only the tile containing each row."""
+    n, hkv, smax, d = k_layer.shape
+    bs = _pick_block(smax, block_s)
+    pos = positions.astype(jnp.int32)
+
+    def cache_map(bi, pos_ref):
+        return (bi, 0, jnp.minimum(pos_ref[bi] // bs, smax // bs - 1), 0)
+
+    kernel = functools.partial(_append_kernel, block_s=bs, smax=smax)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[
+                pl.BlockSpec((1, hkv, d), lambda bi, p: (bi, 0, 0)),
+                pl.BlockSpec((1, hkv, d), lambda bi, p: (bi, 0, 0)),
+                pl.BlockSpec((1, hkv, bs, d), cache_map),
+                pl.BlockSpec((1, hkv, bs, d), cache_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, hkv, bs, d), cache_map),
+                pl.BlockSpec((1, hkv, bs, d), cache_map),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(k_layer.shape, k_layer.dtype),
+            jax.ShapeDtypeStruct(v_layer.shape, v_layer.dtype),
+        ],
+        # inputs 3/4 are (k_layer, v_layer) AFTER the prefetch operand;
+        # aliasing makes the untouched tiles true no-ops in HBM
+        input_output_aliases={3: 0, 4: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(pos, k_new, v_new, k_layer, v_layer)
+
+
+def append_tokens_paged_inplace(
+    k_pool: jnp.ndarray,    # [P, Hkv, page, D]
+    v_pool: jnp.ndarray,
+    table: jnp.ndarray,     # [N, MaxP] (OOB entries == P)
+    positions: jnp.ndarray, # [N]
+    k_new: jnp.ndarray,     # [N, Hkv, D]
+    v_new: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Paged-pool append writing only the page holding each slot's row.
+
+    OOB rows (table entry == P, or position beyond the table span) clamp
+    to page P-1 for the tile fetch but skip the row store, leaving the
+    clamped page byte-identical (it is copied through unchanged)."""
+    n, hkv, d = k_new.shape
+    pool, _, page, _ = k_pool.shape
+    _, maxp = table.shape
+    pos = positions.astype(jnp.int32)
+    tbl = table.astype(jnp.int32)
+
+    def pool_map(bi, pos_ref, table_ref):
+        logical = jnp.minimum(pos_ref[bi] // page, maxp - 1)
+        return (jnp.minimum(table_ref[bi, logical], pool - 1), 0, 0, 0)
+
+    def _kernel(pos_ref, table_ref, knew_ref, vnew_ref, k_ref, v_ref, ko_ref, vo_ref):
+        i = pl.program_id(0)
+        p = pos_ref[i]
+        logical = p // page
+        valid = (logical < maxp) & (p >= 0)
+        # OOB pages (table entry == pool size) must drop the write
+        entry = table_ref[i, jnp.minimum(logical, maxp - 1)]
+        ko_ref[0] = k_ref[0]
+        vo_ref[0] = v_ref[0]
+
+        @pl.when(valid & (entry < pool))
+        def _():
+            off = p % page
+            ko_ref[0, :, pl.ds(off, 1), :] = knew_ref[0][:, None, :].astype(ko_ref.dtype)
+            vo_ref[0, :, pl.ds(off, 1), :] = vnew_ref[0][:, None, :].astype(vo_ref.dtype)
+
+    return pl.pallas_call(
+        _kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(n,),
+            in_specs=[
+                pl.BlockSpec((1, hkv, d), lambda bi, p, t: (bi, 0, 0)),
+                pl.BlockSpec((1, hkv, d), lambda bi, p, t: (bi, 0, 0)),
+                pl.BlockSpec((1, hkv, page, d), pool_map),
+                pl.BlockSpec((1, hkv, page, d), pool_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, hkv, page, d), pool_map),
+                pl.BlockSpec((1, hkv, page, d), pool_map),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+        ],
+        input_output_aliases={4: 0, 5: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(pos, tbl, k_new, v_new, k_pool, v_pool)

@@ -534,6 +534,7 @@ class GenerateEngine(_EngineBase):
         decode_pipeline: int = 2,
         prefix_cache: bool = True,
         spec_tokens: int = 0,
+        kv_quantize: str = "",
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -597,7 +598,10 @@ class GenerateEngine(_EngineBase):
             raise ValueError(f"model family {family.__name__} has no paged-cache support")
         self.kv_layout = kv_layout
 
+        if kv_layout == "paged" and kv_quantize:
+            raise ValueError("kv_quantize requires the slot KV layout (v1)")
         if kv_layout == "paged":
+            self.kv_quantize = ""
             # Paged cache (ops.paged): HBM scales with tokens in flight, not
             # slots x max_len. Per-slot logical capacity stays max_len +
             # decode_chunk; physical pages are pooled and allocated on demand
@@ -630,7 +634,18 @@ class GenerateEngine(_EngineBase):
             # kernel-friendly multiple of 128 when the model allows it
             cache_len = min(-(-(self.max_len + self._chunk_span) // 128) * 128, cfg.max_seq_len)
             self._cache_len = cache_len
-            self.cache = family.make_cache(cfg, slots, cache_len)
+            # int8 KV (kvcache.QSlotKVCache): halves the cache bytes decode
+            # attention streams per step — the long-context bandwidth lever
+            # on top of weight-only int8 (VERDICT r3 #2)
+            if kv_quantize and kv_quantize != "int8":
+                raise ValueError(f"kv_quantize={kv_quantize!r}: only 'int8' is supported")
+            if kv_quantize and not hasattr(family, "make_cache_q"):
+                raise ValueError(
+                    f"family {getattr(family, '__name__', family)!r} has no int8 KV support"
+                )
+            self.kv_quantize = kv_quantize
+            self.cache = (family.make_cache_q(cfg, slots, cache_len) if kv_quantize
+                          else family.make_cache(cfg, slots, cache_len))
             self._prefix = None  # prefix caching needs the paged layout
         self.slots: list[_Slot | None] = [None] * slots
         self._pending: list[tuple[Request, np.ndarray]] = []
@@ -1011,8 +1026,10 @@ class GenerateEngine(_EngineBase):
                     self._prefix.clear()
                     self.metrics.set_gauge("app_tpu_prefix_cached_pages", 0)
             else:
-                self.cache = self.family.make_cache(
-                    self.cfg, self.num_slots, self._cache_len
+                self.cache = (
+                    self.family.make_cache_q(self.cfg, self.num_slots, self._cache_len)
+                    if self.kv_quantize
+                    else self.family.make_cache(self.cfg, self.num_slots, self._cache_len)
                 )
 
     # -- slot/page bookkeeping -------------------------------------------------
@@ -1909,6 +1926,22 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
                 f"{getattr(family, '__name__', family)!r} (needs slot layout + verify_step)"
             )
             spec_tokens = 0
+        # same precedent for the int8 KV cache knob
+        kvq_kw = kw.pop("kv_quantize", None)
+        kv_quantize = str(kvq_kw if kvq_kw is not None
+                          else conf.get_or_default("ENGINE_KV_QUANTIZE", ""))
+        if kv_quantize and (kv_layout != "slot" or not hasattr(family, "make_cache_q")):
+            if kvq_kw is not None:
+                raise ValueError(
+                    f"kv_quantize needs the slot KV layout and a family with "
+                    f"make_cache_q (layout={kv_layout!r}, "
+                    f"family={getattr(family, '__name__', family)!r})"
+                )
+            container.logger.warn(
+                f"ENGINE_KV_QUANTIZE ignored for family "
+                f"{getattr(family, '__name__', family)!r} (needs slot layout + make_cache_q)"
+            )
+            kv_quantize = ""
         return GenerateEngine(
             family, cfg, params, container,
             slots=int(kw.pop("slots", conf.get_int("ENGINE_SLOTS", 8))),
@@ -1920,6 +1953,7 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             total_pages=int(kw.pop("total_pages", conf.get_int("ENGINE_TOTAL_PAGES", 0))) or None,
             prefix_cache=bool(kw.pop("prefix_cache", conf.get_bool("ENGINE_PREFIX_CACHE", True))),
             spec_tokens=spec_tokens,
+            kv_quantize=kv_quantize,
             decode_pipeline=int(kw.pop("decode_pipeline", conf.get_int("ENGINE_DECODE_PIPELINE", 2))),
             eos_token_id=eos,
             tokenizer=tokenizer,

@@ -137,3 +137,78 @@ def test_flash_grad_matches_xla_plain_causal(monkeypatch):
     got = jax.grad(partial(loss, backend="auto"), argnums=(0, 1, 2))(q, k, v)
     for g, w_ in zip(got, want):
         np.testing.assert_allclose(g, w_, atol=2e-3, rtol=2e-3)
+
+
+# -- in-place KV append kernels (ops/pallas/kv_append) --------------------------
+
+
+def test_append_inplace_matches_select(monkeypatch):
+    """Slot-cache in-place append == the masked-select path, including
+    dropped OOB writes for padding rows."""
+    import numpy as np
+
+    from gofr_tpu.ops.kvcache import append_tokens
+    from gofr_tpu.ops.pallas.kv_append import append_tokens_inplace
+
+    n, hkv, smax, d = 4, 2, 32, 16
+    key = jax.random.key(0)
+    k_layer = jax.random.normal(jax.random.fold_in(key, 1), (n, hkv, smax, d))
+    v_layer = jax.random.normal(jax.random.fold_in(key, 2), (n, hkv, smax, d))
+    k_new = jax.random.normal(jax.random.fold_in(key, 3), (n, hkv, d))
+    v_new = jax.random.normal(jax.random.fold_in(key, 4), (n, hkv, d))
+    # one row per tile-boundary case + one OOB (dropped) row
+    positions = jnp.array([0, 7, 8, smax], jnp.int32)
+
+    want_k, want_v = append_tokens(k_layer, v_layer, positions, k_new, v_new)
+    got_k, got_v = append_tokens_inplace(
+        k_layer, v_layer, positions, k_new, v_new, block_s=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(want_k), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v), rtol=1e-6)
+
+
+def test_append_paged_inplace_matches_select():
+    """Paged-pool in-place append == the select path through a shuffled
+    block table, OOB table rows dropped."""
+    import numpy as np
+
+    from gofr_tpu.ops.paged import append_tokens_paged
+    from gofr_tpu.ops.pallas.kv_append import append_tokens_paged_inplace
+
+    n, hkv, d, page, maxp = 3, 2, 16, 8, 3
+    pool = 10
+    key = jax.random.key(5)
+    k_pool = jax.random.normal(jax.random.fold_in(key, 1), (pool, hkv, page, d))
+    v_pool = jax.random.normal(jax.random.fold_in(key, 2), (pool, hkv, page, d))
+    k_new = jax.random.normal(jax.random.fold_in(key, 3), (n, hkv, d))
+    v_new = jax.random.normal(jax.random.fold_in(key, 4), (n, hkv, d))
+    table = jnp.array([[7, 2, 9], [0, 5, 3], [pool, pool, pool]], jnp.int32)
+    positions = jnp.array([page + 3, 0, 5], jnp.int32)  # row 2 = OOB table
+
+    want_k, want_v = append_tokens_paged(k_pool, v_pool, table, positions, k_new, v_new)
+    got_k, got_v = append_tokens_paged_inplace(
+        k_pool, v_pool, table, positions, k_new, v_new, interpret=True)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(want_k), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v), rtol=1e-6)
+
+
+def test_kv_write_env_dispatch(monkeypatch):
+    """GOFR_KV_WRITE=pallas routes append_tokens through the kernel (under
+    the interpreter here) with identical results to select."""
+    import numpy as np
+
+    from gofr_tpu.ops.kvcache import append_tokens
+
+    n, hkv, smax, d = 2, 2, 16, 8
+    key = jax.random.key(9)
+    k_layer = jax.random.normal(jax.random.fold_in(key, 1), (n, hkv, smax, d))
+    v_layer = k_layer + 1
+    k_new = jax.random.normal(jax.random.fold_in(key, 2), (n, hkv, d))
+    v_new = k_new + 1
+    positions = jnp.array([3, smax], jnp.int32)
+
+    want = append_tokens(k_layer, v_layer, positions, k_new, v_new)
+    monkeypatch.setenv("GOFR_KV_WRITE", "pallas")
+    monkeypatch.setenv("GOFR_PALLAS_INTERPRET", "1")
+    got = append_tokens(k_layer, v_layer, positions, k_new, v_new)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(want[1]), rtol=1e-6)
