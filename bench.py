@@ -291,11 +291,10 @@ def main() -> None:
                   prefill_buckets=[prompt_len], decode_pipeline=pipeline)
         if kv_layout == "paged":
             kw.update(kv_layout="paged", page_size=128)
-        else:
-            if kv_quantize:
-                kw.update(kv_quantize=kv_quantize)
-            if spec_tokens:
-                kw.update(spec_tokens=spec_tokens)
+        elif spec_tokens:
+            kw.update(spec_tokens=spec_tokens)
+        if kv_quantize:
+            kw.update(kv_quantize=kv_quantize)
         return kw
 
     best = (slots, decode_chunk)
@@ -322,6 +321,28 @@ def main() -> None:
             sweep_log.append({"slots": s, "chunk": k, "req_per_s": round(rate, 3)})
             if rate > best_rate:
                 best_rate, best = rate, (s, k)
+
+    # Variant auto-selection (TPU default; GOFR_BENCH_AUTO=0 disables):
+    # short A/B of the int8 KV cache, keeping the winner for the headline.
+    # Valid IN-process unlike the GOFR_*_KV_WRITE lowerings: the quantized
+    # cache is a different pytree type, so jit traces a fresh program.
+    if (os.environ.get("GOFR_BENCH_AUTO", "0" if on_cpu else "1") == "1"
+            and not kv_quantize and not spec_tokens):
+        short = prompts[: max(8, n_requests // 8)]
+        ab_rates: dict = {}
+        for name, kwv in (("base", {}), ("kv8", {"kv_quantize": "int8"})):
+            try:
+                mv = _run_once({**engine_kw(*best), **kwv}, cfg, params, container,
+                               llama, short, max_new, timeout)
+                ab_rates[name] = round(len(short) / mv["elapsed"], 2)
+            except Exception as e:  # noqa: BLE001
+                ab_rates[name] = f"error: {e}"[:120]
+        if (isinstance(ab_rates.get("kv8"), float)
+                and isinstance(ab_rates.get("base"), float)
+                and ab_rates["kv8"] > ab_rates["base"]):
+            kv_quantize = "int8"
+    else:
+        ab_rates = {}
 
     def _counter_total(cont, name) -> float:
         mm = cont.metrics.get(name)
@@ -385,6 +406,8 @@ def main() -> None:
         extra["kv_layout"] = kv_layout
     if kv_quantize:
         extra["kv_quantize"] = kv_quantize
+    if ab_rates:
+        extra["kv8_ab_req_per_s"] = ab_rates
     if spec_tokens:
         extra["spec_tokens"] = spec_tokens
         # delta vs the pre-headline snapshot: sweep/warmup runs share the

@@ -37,10 +37,21 @@ from gofr_tpu.ops.kvcache import (
     append_tokens,
     append_tokens_q,
     dequantize_view,
+    fake_quant_row,
     write_prompts,
     write_prompts_q,
 )
-from gofr_tpu.ops.paged import PagedKVCache, append_tokens_paged, gather_kv, write_prompts_paged
+from gofr_tpu.ops.attention import paged_decode_attention_q
+from gofr_tpu.ops.paged import (
+    PagedKVCache,
+    QPagedKVCache,
+    append_tokens_paged,
+    append_tokens_paged_q,
+    gather_kv,
+    gather_kv_q,
+    write_prompts_paged,
+    write_prompts_paged_q,
+)
 
 
 @dataclass(frozen=True)
@@ -322,6 +333,10 @@ def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.nd
                 q, k_view.swapaxes(1, 2), v_view.swapaxes(1, 2),
                 causal=True, q_offset=offsets, kv_lengths=total,
             )
+        elif quant:
+            # self-consistency with the int8 cache (see prefill_paged)
+            attn = mha_attention(q, fake_quant_row(k), fake_quant_row(v),
+                                 causal=True, kv_lengths=lengths)
         else:
             attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
         x = x + qdot(attn.reshape(b, s, -1), lp["wo"])
@@ -479,6 +494,14 @@ def make_paged_cache(cfg: LlamaConfig, pages: int, page_size: int = 128) -> Page
     )
 
 
+def make_paged_cache_q(cfg: LlamaConfig, pages: int, page_size: int = 128) -> QPagedKVCache:
+    """int8 paged pool (ops.paged.QPagedKVCache): prefill_paged /
+    decode_step_paged branch on the cache type, like the slot layout."""
+    return QPagedKVCache.create(
+        cfg.num_layers, pages, page_size, cfg.num_kv_heads, cfg.head_size,
+    )
+
+
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
 def prefill_paged(
     cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray,
@@ -504,42 +527,60 @@ def prefill_paged(
     chunked = offsets is not None
     # pages holding THIS chunk's writes: logical pages off//page .. (off+s)//page
     total = off + lengths  # [B] cache length after this chunk
+    quant = isinstance(cache, QPagedKVCache)
 
     def body(x, xs):
-        lp, k_layer, v_layer = xs
+        if quant:
+            lp, k_layer, ks_l, v_layer, vs_l = xs
+        else:
+            lp, k_layer, v_layer = xs
         q, k, v = _qkv(cfg, lp, x)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
         if chunked:
-            # scatter via per-token logical position -> physical page
-            pp = jnp.take_along_axis(
-                pages, jnp.minimum(positions // page, pages.shape[1] - 1), axis=1
-            )  # [B,S]
-            offs = positions % page
-            heads = jnp.arange(cfg.num_kv_heads)[None, None, :]
-            k_layer = k_layer.at[pp[:, :, None], heads, offs[:, :, None]].set(
-                k.astype(k_layer.dtype))
-            v_layer = v_layer.at[pp[:, :, None], heads, offs[:, :, None]].set(
-                v.astype(v_layer.dtype))
-            # attend over everything written so far (incl. this chunk)
-            k_view, v_view = gather_kv(k_layer, v_layer, pages)
+            if quant:
+                k_layer, ks_l = write_prompts_paged_q(k_layer, ks_l, pages, k, off)
+                v_layer, vs_l = write_prompts_paged_q(v_layer, vs_l, pages, v, off)
+                gkq, gks = gather_kv_q(k_layer, ks_l, pages)
+                gvq, gvs = gather_kv_q(v_layer, vs_l, pages)
+                k_view = dequantize_view(gkq, gks, cfg.dtype)
+                v_view = dequantize_view(gvq, gvs, cfg.dtype)
+            else:
+                k_layer, v_layer = write_prompts_paged(k_layer, v_layer, pages, k, v, off)
+                # attend over everything written so far (incl. this chunk)
+                k_view, v_view = gather_kv(k_layer, v_layer, pages)
             attn = mha_attention(
                 q, k_view.swapaxes(1, 2), v_view.swapaxes(1, 2),
                 causal=True, q_offset=off, kv_lengths=total,
             )
         else:
-            k_layer, v_layer = write_prompts_paged(k_layer, v_layer, pages, k, v)
-            attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
+            if quant:
+                k_layer, ks_l = write_prompts_paged_q(k_layer, ks_l, pages, k)
+                v_layer, vs_l = write_prompts_paged_q(v_layer, vs_l, pages, v)
+                # attend to what the cache STORES (fake-quantized k/v) so a
+                # later prefix-cache hit — which reads the int8 pages — is
+                # bit-identical to this cold run (kvcache.fake_quant_row)
+                attn = mha_attention(q, fake_quant_row(k), fake_quant_row(v),
+                                     causal=True, kv_lengths=lengths)
+            else:
+                k_layer, v_layer = write_prompts_paged(k_layer, v_layer, pages, k, v)
+                attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
         x = x + qdot(attn.reshape(b, s, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
-        return x, (k_layer, v_layer)
+        return x, (k_layer, ks_l, v_layer, vs_l) if quant else (k_layer, v_layer)
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    if quant:
+        xs = (params["blocks"], cache.k, cache.ks, cache.v, cache.vs)
+        x, (new_k, new_ks, new_v, new_vs) = lax.scan(body, x, xs)
+        out_cache = QPagedKVCache(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
+    else:
+        x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+        out_cache = PagedKVCache(k=new_k, v=new_v)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last = x[row, lengths - 1]  # [B,E]
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(last, head).astype(jnp.float32)
-    return logits, PagedKVCache(k=new_k, v=new_v)
+    return logits, out_cache
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
@@ -553,21 +594,37 @@ def decode_step_paged(
     x = params["embed"][tokens].astype(cfg.dtype)  # [N,E]
     n = tokens.shape[0]
     pos1 = positions[:, None]
+    quant = isinstance(cache, QPagedKVCache)
 
     def body(x, xs):
-        lp, k_layer, v_layer = xs
+        if quant:
+            lp, k_layer, ks_l, v_layer, vs_l = xs
+        else:
+            lp, k_layer, v_layer = xs
         q, k, v = _qkv(cfg, lp, x[:, None])
         q = apply_rope(q, pos1, cos, sin)[:, 0]
         k = apply_rope(k, pos1, cos, sin)[:, 0]
         v = v[:, 0]
-        k_layer, v_layer = append_tokens_paged(k_layer, v_layer, table, positions, k, v)
-        attn = paged_decode_attention(q, k_layer, v_layer, table, positions + 1)
+        if quant:
+            k_layer, ks_l = append_tokens_paged_q(k_layer, ks_l, table, positions, k)
+            v_layer, vs_l = append_tokens_paged_q(v_layer, vs_l, table, positions, v)
+            attn = paged_decode_attention_q(
+                q, k_layer, v_layer, ks_l, vs_l, table, positions + 1)
+        else:
+            k_layer, v_layer = append_tokens_paged(k_layer, v_layer, table, positions, k, v)
+            attn = paged_decode_attention(q, k_layer, v_layer, table, positions + 1)
         x = x + qdot(attn.reshape(n, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
-        return x, (k_layer, v_layer)
+        return x, (k_layer, ks_l, v_layer, vs_l) if quant else (k_layer, v_layer)
 
-    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    if quant:
+        xs = (params["blocks"], cache.k, cache.ks, cache.v, cache.vs)
+        x, (new_k, new_ks, new_v, new_vs) = lax.scan(body, x, xs)
+        out_cache = QPagedKVCache(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
+    else:
+        x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+        out_cache = PagedKVCache(k=new_k, v=new_v)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(x, head).astype(jnp.float32)
-    return logits, PagedKVCache(k=new_k, v=new_v)
+    return logits, out_cache

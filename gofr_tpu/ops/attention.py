@@ -228,6 +228,27 @@ def decode_attention_q(
     return out.reshape(b, hq, d)
 
 
+def paged_decode_attention_q(
+    q: jnp.ndarray,        # [N, Hq, D]
+    kq_pool: jnp.ndarray,  # int8 [P, Hkv, page, D]
+    vq_pool: jnp.ndarray,
+    ks_pool: jnp.ndarray,  # [P, Hkv, page]
+    vs_pool: jnp.ndarray,
+    table: jnp.ndarray,    # [N, MaxP]
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """paged_decode_attention over an int8 pool (ops.paged.QPagedKVCache):
+    gather the int8 logical views + scales per slot, then reuse the
+    folded-scale decode path — gathered bytes stay int8."""
+    from gofr_tpu.ops.paged import gather_kv_q
+
+    gkq, gks = gather_kv_q(kq_pool, ks_pool, table)
+    gvq, gvs = gather_kv_q(vq_pool, vs_pool, table)
+    return decode_attention_q(q, gkq, gvq, gks, gvs, lengths, scale=scale)
+
+
 def paged_decode_attention(
     q: jnp.ndarray,
     k_pool: jnp.ndarray,

@@ -145,6 +145,16 @@ def append_tokens_q(
     return cache_q, cache_s
 
 
+def fake_quant_row(x: jnp.ndarray, dtype=None) -> jnp.ndarray:
+    """Round-trip ``x`` through int8 row quantization. Prefill attention in
+    the quantized branches uses this for the CURRENT chunk's k/v so cold
+    prompts attend to exactly what the cache stores — otherwise a later
+    prefix-cache hit (which attends dequantized pages) could diverge from
+    the cold run near a logit tie, breaking hit/cold bit-identity."""
+    q, s = quantize_row(x)
+    return (q.astype(jnp.float32) * s[..., None]).astype(dtype or x.dtype)
+
+
 def dequantize_view(cache_q: jnp.ndarray, cache_s: jnp.ndarray, dtype) -> jnp.ndarray:
     """[.., Smax, D] int8 × [.., Smax] scales → dense dtype view (the
     chunked-prefill gather path; attention proper keeps int8 reads)."""

@@ -66,22 +66,147 @@ class PagedKVCache:
         return self.k.shape[3]
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class QPagedKVCache:
+    """int8 paged pool with per-(page, head, position) scales — the paged
+    analog of kvcache.QSlotKVCache: cache reads halve and the scales fold
+    outside the attention contractions. Prefix caching composes unchanged:
+    a page's (int8, scale) content is still a deterministic function of
+    the token prefix, so shared pages stay exact across chains."""
+
+    k: jnp.ndarray   # int8 [L, P, Hkv, page, D]
+    v: jnp.ndarray   # int8 [L, P, Hkv, page, D]
+    ks: jnp.ndarray  # bf16 [L, P, Hkv, page]
+    vs: jnp.ndarray  # bf16 [L, P, Hkv, page]
+
+    @classmethod
+    def create(cls, layers: int, pages: int, page_size: int, kv_heads: int,
+               head_dim: int, dtype=None) -> "QPagedKVCache":
+        del dtype
+        shape = (layers, pages, kv_heads, page_size, head_dim)
+        sshape = (layers, pages, kv_heads, page_size)
+        return cls(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            ks=jnp.zeros(sshape, jnp.bfloat16), vs=jnp.zeros(sshape, jnp.bfloat16),
+        )
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+
+def write_prompts_paged_q(
+    cache_q: jnp.ndarray,  # int8 [P, Hkv, page, D] (one of k/v)
+    cache_s: jnp.ndarray,  # [P, Hkv, page]
+    pages: jnp.ndarray,    # [B, S_pages]
+    new: jnp.ndarray,      # [B, S, Hkv, D]
+    offsets: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantized analog of write_prompts_paged for one k/v plane, with
+    chunk offsets (logical positions offsets..offsets+S)."""
+    from gofr_tpu.ops.kvcache import quantize_row
+
+    b, s, hkv, _ = new.shape
+    page = cache_q.shape[2]
+    q, sc = quantize_row(new)  # [B,S,Hkv,D] int8, [B,S,Hkv]
+    pos = jnp.arange(s)[None, :] + (offsets[:, None] if offsets is not None else 0)
+    pp = jnp.take_along_axis(
+        pages, jnp.minimum(pos // page, pages.shape[1] - 1), axis=1)  # [B,S]
+    off = pos % page
+    rows = pp[:, :, None]
+    heads = jnp.arange(hkv)[None, None, :]
+    offs = off[:, :, None]
+    cache_q = cache_q.at[rows, heads, offs].set(q)
+    cache_s = cache_s.at[rows, heads, offs].set(sc.astype(cache_s.dtype))
+    return cache_q, cache_s
+
+
+def append_tokens_paged_q(
+    cache_q: jnp.ndarray,   # int8 [P, Hkv, page, D]
+    cache_s: jnp.ndarray,   # [P, Hkv, page]
+    table: jnp.ndarray,     # [N, MaxP]
+    positions: jnp.ndarray, # [N]
+    new: jnp.ndarray,       # [N, Hkv, D]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantized analog of append_tokens_paged for one k/v plane, honoring
+    the same ``GOFR_PAGED_KV_WRITE`` lowering switch (select default — the
+    measured v5e winner; scatter optional). The one-hot fold runs in f32
+    and casts back: int8 magnitudes <= 127 are exact in f32."""
+    from gofr_tpu.ops.kvcache import quantize_row
+
+    n, hkv, d = new.shape
+    p_total, _, page, _ = cache_q.shape
+    q, sc = quantize_row(new)  # [N,Hkv,D] int8, [N,Hkv] f32
+    pp = jnp.take_along_axis(table, (positions // page)[:, None], axis=1)[:, 0]
+    off = positions % page
+
+    if os.environ.get("GOFR_PAGED_KV_WRITE", "select") != "scatter":
+        flat = pp * page + off  # OOB rows land >= p_total*page
+        grid = jnp.arange(p_total * page)
+        m = flat[:, None] == grid[None, :]  # [N, P*page]
+        any_m = m.reshape(n, p_total, page).any(axis=0)
+        mf = m.astype(jnp.float32)
+        upd = jnp.einsum("np,nhd->phd", mf, q.astype(jnp.float32))
+        upd = upd.reshape(p_total, page, hkv, d).transpose(0, 2, 1, 3)
+        cache_q = jnp.where(any_m[:, None, :, None], upd.astype(jnp.int8), cache_q)
+        upd_s = jnp.einsum("np,nh->ph", mf, sc).reshape(p_total, page, hkv)
+        cache_s = jnp.where(any_m[:, None, :],
+                            upd_s.transpose(0, 2, 1).astype(cache_s.dtype), cache_s)
+        return cache_q, cache_s
+
+    rows = pp[:, None]
+    heads = jnp.arange(hkv)[None, :]
+    cache_q = cache_q.at[rows, heads, off[:, None]].set(q)
+    cache_s = cache_s.at[rows, heads, off[:, None]].set(sc.astype(cache_s.dtype))
+    return cache_q, cache_s
+
+
+def gather_kv_q(
+    cache_q: jnp.ndarray,  # int8 [P, Hkv, page, D]
+    cache_s: jnp.ndarray,  # [P, Hkv, page]
+    table: jnp.ndarray,    # [N, MaxP]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Logical ([N, Hkv, MaxP*page, D] int8, [N, Hkv, MaxP*page] scale)
+    views of each slot's quantized cache (the XLA read path)."""
+    n, maxp = table.shape
+    _, hkv, page, d = cache_q.shape
+    safe = jnp.minimum(table, cache_q.shape[0] - 1)
+
+    gq = cache_q[safe].transpose(0, 2, 1, 3, 4).reshape(n, hkv, maxp * page, d)
+    gs = cache_s[safe].transpose(0, 2, 1, 3).reshape(n, hkv, maxp * page)
+    return gq, gs
+
+
 def write_prompts_paged(
     k_layer: jnp.ndarray,  # [P, Hkv, page, D]
     v_layer: jnp.ndarray,
     pages: jnp.ndarray,    # [B, S_pages] physical page per logical page (P = dropped)
     k_new: jnp.ndarray,    # [B, S, Hkv, D] activation layout
     v_new: jnp.ndarray,
+    offsets: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Write prefilled prompts at logical positions 0..S through per-row
-    block tables. ``pages[b, j]`` is the physical page holding positions
-    j*page .. (j+1)*page of row b."""
+    """Write prefilled prompts (or prompt CHUNKS) through per-row block
+    tables. ``pages[b, j]`` is the physical page holding positions
+    j*page .. (j+1)*page of row b; ``offsets`` [B] places the chunk at
+    logical positions offsets..offsets+S (None = 0)."""
     b, s, hkv, _ = k_new.shape
     page = k_layer.shape[2]
-    pos = jnp.arange(s)
-    # physical page + in-page offset per (row, position)
-    pp = jnp.take_along_axis(pages, (pos // page)[None, :].repeat(b, 0), axis=1)  # [B,S]
-    off = (pos % page)[None, :].repeat(b, 0)  # [B,S]
+    pos = jnp.arange(s)[None, :] + (offsets[:, None] if offsets is not None else 0)
+    # physical page + in-page offset per (row, position); the logical-page
+    # clamp keeps chunked tails inside the table (writes past it are the
+    # caller's OOB rows and drop through page id P)
+    pp = jnp.take_along_axis(
+        pages, jnp.minimum(pos // page, pages.shape[1] - 1), axis=1)  # [B,S]
+    off = pos % page  # [B,S]
     rows = pp[:, :, None]
     heads = jnp.arange(hkv)[None, None, :]
     offs = off[:, :, None]

@@ -1,0 +1,58 @@
+#!/bin/bash
+# Round-4 on-chip measurement session (VERDICT r3 #2/#3/#4/#6 + prefix bench).
+# Each point runs in its OWN process: the KV-write lowering and kernel knobs
+# are read at trace time and jit caches traces process-globally.
+# Usage: bash scripts/chip_session.sh [outfile]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/chip_session.jsonl}"
+: > "$OUT"
+
+run() {
+  local tag="$1"; shift
+  echo "=== $tag ($(date +%H:%M:%S)) ===" >&2
+  local line
+  line=$(env "$@" timeout 1500 python bench.py 2>/dev/null | tail -1)
+  echo "{\"tag\": \"$tag\", \"result\": ${line:-null}}" >> "$OUT"
+  echo "$line" | head -c 400 >&2; echo >&2
+}
+
+# 0) step-time breakdown (writes to stderr only)
+timeout 900 python scripts/profile_decode.py slot int8 2>&1 | grep -v WARNING >&2 || true
+
+# 1) round-3 headline reproduction (regression check)
+run r3_repro GOFR_BENCH_DEBUG=1
+
+# 2) + int8 KV cache
+run kv_int8 GOFR_BENCH_KV_QUANTIZE=int8 GOFR_BENCH_DEBUG=1
+
+# 3) + pallas in-place append (vs select), bf16 KV and int8 KV
+run pallas_append GOFR_KV_WRITE=pallas GOFR_BENCH_DEBUG=1
+run pallas_append_kv8 GOFR_KV_WRITE=pallas GOFR_BENCH_KV_QUANTIZE=int8 GOFR_BENCH_DEBUG=1
+
+# 4) long-context point (KV traffic dominates): 512-token prompts
+run long_ctx GOFR_BENCH_PROMPT=512 GOFR_BENCH_REQUESTS=128
+run long_ctx_kv8 GOFR_BENCH_PROMPT=512 GOFR_BENCH_REQUESTS=128 GOFR_BENCH_KV_QUANTIZE=int8
+run long_ctx_kv8_pallas GOFR_BENCH_PROMPT=512 GOFR_BENCH_REQUESTS=128 \
+    GOFR_BENCH_KV_QUANTIZE=int8 GOFR_KV_WRITE=pallas
+
+# 5) sweep at the best-so-far variant (edit env per findings)
+run sweep GOFR_BENCH_SWEEP=1 GOFR_BENCH_KV_QUANTIZE=int8
+
+# 6) kernel A/B (attention kernels) at the new operating point
+run pallas_ab GOFR_BENCH_PALLAS_AB=1 GOFR_BENCH_KV_QUANTIZE=int8
+
+# 7) speculative decoding: latency mode single-stream gain
+run spec_latency GOFR_BENCH_LATENCY=1 GOFR_BENCH_SPEC=4 GOFR_BENCH_REQUESTS=64
+run plain_latency GOFR_BENCH_LATENCY=1 GOFR_BENCH_REQUESTS=64
+
+# 8) shared-prefix workload (paged + prefix cache A/B)
+run prefix GOFR_BENCH_PREFIX=1 GOFR_BENCH_REQUESTS=128
+
+# 9) the north-star model class: Llama-3-8B shape, int8 weights
+run eight_b GOFR_BENCH_PRESET=eight_b GOFR_BENCH_REQUESTS=256 \
+    GOFR_BENCH_SLOTS=64 GOFR_BENCH_PREFILL_BATCH=32
+run eight_b_kv8 GOFR_BENCH_PRESET=eight_b GOFR_BENCH_REQUESTS=256 \
+    GOFR_BENCH_SLOTS=64 GOFR_BENCH_PREFILL_BATCH=32 GOFR_BENCH_KV_QUANTIZE=int8
+
+echo "session done -> $OUT" >&2

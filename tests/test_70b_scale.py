@@ -90,6 +90,43 @@ _WORKER = textwrap.dedent("""
     hlo_t = lowered_t.compile().as_text()
     assert "all-reduce" in hlo_t, "compiled 70B train step has no collectives"
     print("TRAIN_COMPILED collectives:", hlo_t.count("all-reduce"))
+    # pipeline-parallel SERVING at 70B (BASELINE row 4's weight-fit
+    # topology): blocks + slot KV cache layer-sharded over pp:8, heads
+    # over tp:8 — the GPipe decode program a v5e-64 deployment compiles
+    # (models/llama_pp.py). ppermute must survive into the compiled HLO.
+    from gofr_tpu.models.llama_pp import PPLlamaFamily
+    from gofr_tpu.parallel.sharding import ShardingRules
+
+    mesh_pp = build_mesh("pp:8,tp:8", devices=jax.devices("cpu")[:64])
+    rules_pp = ShardingRules().with_overrides(layers="pp")
+    fam = PPLlamaFamily(mesh_pp, microbatches=8, rules=rules_pp)
+    shardings_pp = sharding_tree(llama.param_axes(cfg), rules_pp, mesh_pp)
+    params_pp = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, shardings_pp,
+    )
+    cache_sh = NamedSharding(mesh_pp, fam._cache_spec())
+    cache_pp = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=cache_sh),
+        jax.eval_shape(lambda: llama.make_cache(cfg, SLOTS, SEQ)),
+    )
+
+    def decode_pp(params, tokens, positions, cache):
+        return fam.decode_step(cfg, params, tokens, positions, cache)
+
+    lowered_pp = jax.jit(decode_pp).lower(
+        params_pp,
+        jax.ShapeDtypeStruct((SLOTS,), jnp.int32),
+        jax.ShapeDtypeStruct((SLOTS,), jnp.int32),
+        cache_pp,
+    )
+    hlo_pp = lowered_pp.compile().as_text()
+    assert "collective-permute" in hlo_pp, (
+        "compiled 70B pp decode has no stage-ring collective-permute")
+    assert "all-reduce" in hlo_pp, "compiled 70B pp decode has no tp psum"
+    print("PP_SERVE_COMPILED collective-permutes:",
+          hlo_pp.count("collective-permute"), "all-reduces:", hlo_pp.count("all-reduce"))
+
     import math
     n_params = sum(math.prod(s.shape) for s in jax.tree.leaves(shapes))
     assert 6.5e10 < n_params < 7.5e10, f"not 70B-scale: {n_params}"
@@ -109,3 +146,4 @@ def test_llama70b_sharded_programs_lower_on_v5e64_mesh():
     assert "SCALE_OK params=" in out.stdout, out.stdout
     assert "PREFILL_LOWERED" in out.stdout
     assert "TRAIN_LOWERED" in out.stdout
+    assert "PP_SERVE_COMPILED" in out.stdout

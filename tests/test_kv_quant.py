@@ -137,6 +137,40 @@ class TestEngineInt8KV:
         finally:
             eng.stop()
 
+    def test_paged_int8_serving_matches_reference(self, setup):
+        """Paged int8 pool (QPagedKVCache): batched prefill, chunked
+        prefill, decode, and the prefix cache all run quantized and still
+        match dense greedy on the f32 tiny model."""
+        cfg, params, ref = setup
+        from gofr_tpu.ops.paged import QPagedKVCache
+        from gofr_tpu.testutil import assert_paged_pool_consistent
+
+        eng = GenerateEngine(llama, cfg, params, new_mock_container(),
+                             slots=4, max_len=64, max_prefill_batch=2,
+                             kv_layout="paged", page_size=8,
+                             kv_quantize="int8")
+        try:
+            assert isinstance(eng.cache, QPagedKVCache)
+            prompt = [(11 * i) % 190 + 1 for i in range(20)]  # 2 full pages
+            assert eng.generate(prompt, max_new_tokens=6, timeout=120)["tokens"] == ref(prompt, 6)
+            # second pass hits the prefix cache on QUANTIZED pages
+            assert eng.generate(prompt, max_new_tokens=6, timeout=120)["tokens"] == ref(prompt, 6)
+            hits = eng.metrics.get("app_tpu_prefix_hit_tokens")
+            assert sum(hits._values.values()) == 16
+            # long prompt exercises the quantized chunked-prefill path
+            lp = [(7 * i) % 150 + 1 for i in range(21)]
+            eng2 = GenerateEngine(llama, cfg, params, new_mock_container(),
+                                  slots=2, max_len=64, max_prefill_batch=1,
+                                  prefill_buckets=[8], kv_layout="paged",
+                                  page_size=8, kv_quantize="int8")
+            try:
+                assert eng2.generate(lp, max_new_tokens=4, timeout=300)["tokens"] == ref(lp, 4)
+            finally:
+                eng2.stop()
+            assert_paged_pool_consistent(eng, slots_empty=True)
+        finally:
+            eng.stop()
+
     def test_spec_decode_with_int8_kv(self, setup):
         """Speculation verifies against the SAME int8 cache it decodes
         from, so acceptance stays self-consistent and exact vs the int8
