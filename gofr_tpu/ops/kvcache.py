@@ -145,14 +145,18 @@ def append_tokens_q(
     return cache_q, cache_s
 
 
-def fake_quant_row(x: jnp.ndarray, dtype=None) -> jnp.ndarray:
-    """Round-trip ``x`` through int8 row quantization. Prefill attention in
-    the quantized branches uses this for the CURRENT chunk's k/v so cold
-    prompts attend to exactly what the cache stores — otherwise a later
-    prefix-cache hit (which attends dequantized pages) could diverge from
-    the cold run near a logit tie, breaking hit/cold bit-identity."""
+def fake_quant_row(x: jnp.ndarray, dtype=None, scale_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Round-trip ``x`` through int8 row quantization EXACTLY as the cache
+    stores and the read path dequantizes it: the scale goes through the
+    cache's scale dtype (bf16) and the multiply/cast order mirrors
+    ``dequantize_view``. Prefill attention in the quantized branches uses
+    this for the CURRENT chunk's k/v so cold prompts attend to exactly
+    what a later prefix-cache hit will read — any representation mismatch
+    (e.g. an f32 scale here vs the stored bf16 scale) would let hit and
+    cold runs diverge near a logit tie."""
     q, s = quantize_row(x)
-    return (q.astype(jnp.float32) * s[..., None]).astype(dtype or x.dtype)
+    out_dtype = dtype or x.dtype
+    return q.astype(out_dtype) * s.astype(scale_dtype)[..., None].astype(out_dtype)
 
 
 def dequantize_view(cache_q: jnp.ndarray, cache_s: jnp.ndarray, dtype) -> jnp.ndarray:

@@ -33,6 +33,17 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+from gofr_tpu.ops.kvcache import quantize_row
+
+
+def _locate(pages: jnp.ndarray, pos: jnp.ndarray, page: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(physical page, in-page offset) per logical position. ``pages``
+    [B, MaxP] block-table rows, ``pos`` [B, S] logical positions. The
+    logical-page clamp keeps chunked tails inside the table; true OOB rows
+    drop through page id P (the pool-size sentinel)."""
+    pp = jnp.take_along_axis(pages, jnp.minimum(pos // page, pages.shape[1] - 1), axis=1)
+    return pp, pos % page
+
 
 @jax.tree_util.register_dataclass
 @dataclass
@@ -113,15 +124,11 @@ def write_prompts_paged_q(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Quantized analog of write_prompts_paged for one k/v plane, with
     chunk offsets (logical positions offsets..offsets+S)."""
-    from gofr_tpu.ops.kvcache import quantize_row
-
     b, s, hkv, _ = new.shape
     page = cache_q.shape[2]
     q, sc = quantize_row(new)  # [B,S,Hkv,D] int8, [B,S,Hkv]
     pos = jnp.arange(s)[None, :] + (offsets[:, None] if offsets is not None else 0)
-    pp = jnp.take_along_axis(
-        pages, jnp.minimum(pos // page, pages.shape[1] - 1), axis=1)  # [B,S]
-    off = pos % page
+    pp, off = _locate(pages, pos, page)  # [B,S] each
     rows = pp[:, :, None]
     heads = jnp.arange(hkv)[None, None, :]
     offs = off[:, :, None]
@@ -141,13 +148,11 @@ def append_tokens_paged_q(
     the same ``GOFR_PAGED_KV_WRITE`` lowering switch (select default — the
     measured v5e winner; scatter optional). The one-hot fold runs in f32
     and casts back: int8 magnitudes <= 127 are exact in f32."""
-    from gofr_tpu.ops.kvcache import quantize_row
-
     n, hkv, d = new.shape
     p_total, _, page, _ = cache_q.shape
     q, sc = quantize_row(new)  # [N,Hkv,D] int8, [N,Hkv] f32
-    pp = jnp.take_along_axis(table, (positions // page)[:, None], axis=1)[:, 0]
-    off = positions % page
+    pp, off = _locate(table, positions[:, None], page)
+    pp, off = pp[:, 0], off[:, 0]
 
     if os.environ.get("GOFR_PAGED_KV_WRITE", "select") != "scatter":
         flat = pp * page + off  # OOB rows land >= p_total*page
@@ -201,12 +206,7 @@ def write_prompts_paged(
     b, s, hkv, _ = k_new.shape
     page = k_layer.shape[2]
     pos = jnp.arange(s)[None, :] + (offsets[:, None] if offsets is not None else 0)
-    # physical page + in-page offset per (row, position); the logical-page
-    # clamp keeps chunked tails inside the table (writes past it are the
-    # caller's OOB rows and drop through page id P)
-    pp = jnp.take_along_axis(
-        pages, jnp.minimum(pos // page, pages.shape[1] - 1), axis=1)  # [B,S]
-    off = pos % page  # [B,S]
+    pp, off = _locate(pages, pos, page)  # [B,S] each
     rows = pp[:, :, None]
     heads = jnp.arange(hkv)[None, None, :]
     offs = off[:, :, None]
@@ -253,8 +253,8 @@ def append_tokens_paged(
                 interpret=interpret_mode(),
             )
 
-    pp = jnp.take_along_axis(table, (positions // page)[:, None], axis=1)[:, 0]  # [N]
-    off = positions % page
+    pp, off = _locate(table, positions[:, None], page)
+    pp, off = pp[:, 0], off[:, 0]  # [N]
 
     if os.environ.get("GOFR_PAGED_KV_WRITE", "select") != "scatter":
         flat = pp * page + off  # [N]; OOB rows land >= p_total*page

@@ -621,11 +621,7 @@ class GenerateEngine(_EngineBase):
                     f"total_pages {self.total_pages} < pages_per_slot "
                     f"{self.pages_per_slot}: one max-length request cannot fit"
                 )
-            self.cache = (
-                family.make_paged_cache_q(cfg, self.total_pages, page_size)
-                if kv_quantize
-                else family.make_paged_cache(cfg, self.total_pages, page_size)
-            )
+            self.cache = self._build_paged_cache()
             self._free_pages: list[int] = list(range(self.total_pages))
             self._slot_pages: list[list[int]] = [[] for _ in range(slots)]
             # OOB convention: unallocated entries point one past the pool
@@ -1019,13 +1015,7 @@ class GenerateEngine(_EngineBase):
             # post-restart step would fail on it, burning the whole restart
             # budget on one fault. Rebuild it (all slots are empty now).
             if self.kv_layout == "paged":
-                self.cache = (
-                    self.family.make_paged_cache_q(
-                        self.cfg, self.total_pages, self.page_size)
-                    if self.kv_quantize
-                    else self.family.make_paged_cache(
-                        self.cfg, self.total_pages, self.page_size)
-                )
+                self.cache = self._build_paged_cache()
                 self._free_pages = list(range(self.total_pages))
                 self._slot_pages = [[] for _ in range(self.num_slots)]
                 self._table = np.full(
@@ -1044,6 +1034,13 @@ class GenerateEngine(_EngineBase):
                 )
 
     # -- slot/page bookkeeping -------------------------------------------------
+
+    def _build_paged_cache(self):
+        """One construction site for ctor AND crash-restart rebuild: the
+        two must always agree on the cache kind (int8 vs dense)."""
+        make = (self.family.make_paged_cache_q if self.kv_quantize
+                else self.family.make_paged_cache)
+        return make(self.cfg, self.total_pages, self.page_size)
 
     def _ref_page(self, p: int) -> None:
         self._page_refs[p] += 1

@@ -12,7 +12,7 @@ run() {
   local tag="$1"; shift
   echo "=== $tag ($(date +%H:%M:%S)) ===" >&2
   local line
-  line=$(env "$@" timeout 1500 python bench.py 2>/dev/null | tail -1)
+  line=$(env GOFR_BENCH_AUTO=0 "$@" timeout 1500 python bench.py 2>/dev/null | tail -1)
   echo "{\"tag\": \"$tag\", \"result\": ${line:-null}}" >> "$OUT"
   echo "$line" | head -c 400 >&2; echo >&2
 }
