@@ -182,6 +182,38 @@ def prefill(cfg: GPT2Config, params: dict, tokens: jnp.ndarray, lengths: jnp.nda
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
+def verify_step(cfg: GPT2Config, params: dict, tokens: jnp.ndarray,
+                positions: jnp.ndarray, cache: SlotKVCache) -> tuple[jnp.ndarray, SlotKVCache]:
+    """Speculative-decoding verification — contract and stale-draft-KV
+    invariants as llama.verify_step: tokens [N, T] per slot written and
+    attended at positions[n]..positions[n]+T-1, logits for ALL T positions."""
+    n, t = tokens.shape
+    pos2d = positions[:, None] + jnp.arange(t)[None]
+    pe = params["wpe"][jnp.minimum(pos2d, cfg.max_seq_len - 1)]
+    x = (params["wte"][tokens] + pe).astype(cfg.dtype)
+    rows = jnp.arange(n)
+    total = positions + t
+
+    def body(x, xs):
+        lp, k_layer, v_layer = xs
+        h = layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
+        q, k, v = _attn_qkv(cfg, lp, h)
+        k_layer, v_layer = write_prompts(k_layer, v_layer, rows, k, v, positions)
+        a = mha_attention(
+            q, k_layer.swapaxes(1, 2), v_layer.swapaxes(1, 2),
+            causal=True, q_offset=positions, kv_lengths=total,
+        )
+        x = x + qdot(a.reshape(n, t, -1), lp["wo"]) + lp["bo"]
+        x = x + _mlp(cfg, lp, x)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+    x = layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.norm_eps)
+    logits = qdot(x, params["wte"].T).astype(jnp.float32)
+    return logits, SlotKVCache(k=new_k, v=new_v)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=4)
 def decode_step(cfg: GPT2Config, params: dict, tokens: jnp.ndarray, positions: jnp.ndarray,
                 cache: SlotKVCache) -> tuple[jnp.ndarray, SlotKVCache]:
     """Engine contract — see llama.decode_step."""

@@ -49,6 +49,16 @@ def test_engine_on_pp_mesh_matches_single_device(config):
     check_mesh_serving(config)
 
 
+def test_int8_kv_and_spec_decode_on_tp_mesh():
+    """Round-4 serving features under GSPMD: int8 KV (quantize/dequant
+    folding must partition) and speculative decoding (verify_step +
+    device-side lookup drafting) stay token-exact on a tp mesh."""
+    check_mesh_serving({"TPU_MESH": "dp:2,tp:4"}, kv_layout="slot",
+                       kv_quantize="int8")
+    check_mesh_serving({"TPU_MESH": "dp:2,tp:4"}, kv_layout="slot",
+                       spec_tokens=2, decode_chunk=4)
+
+
 def test_pp_mesh_microbatch_override():
     """ENGINE_PP_MICROBATCHES > pp: deeper microbatching (smaller bubble
     fraction) must not change tokens."""

@@ -153,3 +153,28 @@ class TestSpecEngine:
         cfg, params, _ = setup
         with pytest.raises(ValueError, match="slot KV layout"):
             make_engine(cfg, params, kv_layout="paged", page_size=8)
+
+
+def test_gpt2_spec_decode_matches_reference():
+    """verify_step parity beyond llama: gpt2 (learned positional
+    embeddings, fused-qkv biases) speculates bit-exactly too."""
+    from gofr_tpu.models import GPT2Config, gpt2
+
+    cfg = GPT2Config.tiny()
+    params = gpt2.init(cfg, jax.random.key(5))
+
+    def ref(prompt, n_new):
+        seq = list(prompt)
+        for _ in range(n_new):
+            logits = gpt2.forward(cfg, params, jnp.asarray([seq], jnp.int32))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        return seq[len(prompt):]
+
+    eng = GenerateEngine(gpt2, cfg, params, new_mock_container(),
+                         slots=2, max_len=64, max_prefill_batch=1,
+                         decode_chunk=4, spec_tokens=3)
+    try:
+        out = eng.generate([5, 3, 9], max_new_tokens=12, timeout=120)
+        assert out["tokens"] == ref([5, 3, 9], 12)
+    finally:
+        eng.stop()
