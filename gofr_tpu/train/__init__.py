@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gofr_tpu.parallel import ShardingRules, logical_sharding
+from gofr_tpu.parallel import ShardingRules
 from gofr_tpu.parallel.sharding import sharding_tree
 
 

@@ -14,8 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from gofr_tpu.config import DictConfig
-from gofr_tpu.container import Container, new_mock_container
+from gofr_tpu.container import new_mock_container
 from gofr_tpu.http.errors import RequestTimeout
 from gofr_tpu.models import LlamaConfig, BertConfig, ViTConfig, ModelSpec, llama
 from gofr_tpu.testutil import assert_paged_pool_consistent

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from gofr_tpu.ops.attention import decode_attention, paged_decode_attention
-from gofr_tpu.ops.kvcache import SlotKVCache, append_tokens, write_prompts
+from gofr_tpu.ops.kvcache import append_tokens
 from gofr_tpu.ops.paged import (
     PagedKVCache,
     append_tokens_paged,

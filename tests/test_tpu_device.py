@@ -2,7 +2,7 @@
 
 
 from gofr_tpu.config import DictConfig
-from gofr_tpu.container import Container, new_mock_container
+from gofr_tpu.container import new_mock_container
 from gofr_tpu.logging import MockLogger
 from gofr_tpu.metrics import Registry
 from gofr_tpu.tpu.device import TPUDevices
