@@ -291,7 +291,7 @@ def main() -> None:
                   prefill_buckets=[prompt_len], decode_pipeline=pipeline)
         if kv_layout == "paged":
             kw.update(kv_layout="paged", page_size=128)
-        elif spec_tokens:
+        if spec_tokens:
             kw.update(spec_tokens=spec_tokens)
         if kv_quantize:
             kw.update(kv_quantize=kv_quantize)

@@ -487,6 +487,60 @@ def make_cache_q(cfg: LlamaConfig, slots: int, max_len: int | None = None) -> QS
 # -- paged-cache entry points (ops.paged; SURVEY.md §7 stage 4) -----------------
 
 
+@partial(jax.jit, static_argnums=0, donate_argnums=4)
+def verify_step_paged(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
+                      positions: jnp.ndarray, cache, table: jnp.ndarray):
+    """Speculative-decoding verification against the paged pool — the
+    contract and stale-draft-KV invariants of ``verify_step``, with writes
+    routed through per-slot block tables (``table`` [N, MaxP]; OOB rows
+    drop) and attention over the gathered logical views. Handles both the
+    dense and int8 pools (cache-type branch, like decode_step_paged)."""
+    cos, sin = _rope(cfg)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    n, t = tokens.shape
+    pos2d = positions[:, None] + jnp.arange(t)[None]
+    total = positions + t
+    quant = isinstance(cache, QPagedKVCache)
+
+    def body(x, xs):
+        if quant:
+            lp, k_layer, ks_l, v_layer, vs_l = xs
+        else:
+            lp, k_layer, v_layer = xs
+        q, k, v = _qkv(cfg, lp, x)
+        q = apply_rope(q, pos2d, cos, sin)
+        k = apply_rope(k, pos2d, cos, sin)
+        if quant:
+            k_layer, ks_l = write_prompts_paged_q(k_layer, ks_l, table, k, positions)
+            v_layer, vs_l = write_prompts_paged_q(v_layer, vs_l, table, v, positions)
+            gkq, gks = gather_kv_q(k_layer, ks_l, table)
+            gvq, gvs = gather_kv_q(v_layer, vs_l, table)
+            k_view = dequantize_view(gkq, gks, cfg.dtype)
+            v_view = dequantize_view(gvq, gvs, cfg.dtype)
+        else:
+            k_layer, v_layer = write_prompts_paged(k_layer, v_layer, table, k, v, positions)
+            k_view, v_view = gather_kv(k_layer, v_layer, table)
+        attn = mha_attention(
+            q, k_view.swapaxes(1, 2), v_view.swapaxes(1, 2),
+            causal=True, q_offset=positions, kv_lengths=total,
+        )
+        x = x + qdot(attn.reshape(n, t, -1), lp["wo"])
+        x = x + _mlp(cfg, lp, x)
+        return x, (k_layer, ks_l, v_layer, vs_l) if quant else (k_layer, v_layer)
+
+    if quant:
+        xs = (params["blocks"], cache.k, cache.ks, cache.v, cache.vs)
+        x, (new_k, new_ks, new_v, new_vs) = lax.scan(body, x, xs)
+        out_cache = QPagedKVCache(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
+    else:
+        x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
+        out_cache = PagedKVCache(k=new_k, v=new_v)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = qdot(x, head).astype(jnp.float32)
+    return logits, out_cache
+
+
 def make_paged_cache(cfg: LlamaConfig, pages: int, page_size: int = 128) -> PagedKVCache:
     return PagedKVCache.create(
         cfg.num_layers, pages, page_size, cfg.num_kv_heads, cfg.head_size,

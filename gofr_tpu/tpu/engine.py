@@ -569,11 +569,10 @@ class GenerateEngine(_EngineBase):
         # the memory-bound occupancies where decode wastes bandwidth.
         self.spec_tokens = max(0, int(spec_tokens))
         if self.spec_tokens:
-            if kv_layout != "slot":
-                raise ValueError("spec_tokens requires the slot KV layout (v1)")
-            if not hasattr(family, "verify_step"):
+            need = "verify_step" if kv_layout == "slot" else "verify_step_paged"
+            if not hasattr(family, need):
                 raise ValueError(
-                    f"family {getattr(family, '__name__', family)!r} has no verify_step; "
+                    f"family {getattr(family, '__name__', family)!r} has no {need}; "
                     "speculative decoding needs it"
                 )
         # cache slack one chunk can write past max_len: each spec round
@@ -612,7 +611,7 @@ class GenerateEngine(_EngineBase):
             # decode_chunk; physical pages are pooled and allocated on demand
             # (admission gate + preemption-by-recompute in _admit/_decode).
             self.page_size = page_size
-            self.pages_per_slot = -(-(self.max_len + self.decode_chunk) // page_size)
+            self.pages_per_slot = -(-(self.max_len + self._chunk_span) // page_size)
             # default pool = same HBM as the slot cache; shrink to
             # oversubscribe, or keep and raise `slots` for more concurrency
             self.total_pages = total_pages if total_pages else slots * self.pages_per_slot
@@ -751,6 +750,50 @@ class GenerateEngine(_EngineBase):
                     body, (tokens, positions, cache, key), None, length=steps
                 )
                 return out.T, toks, cache  # [slots, K], [slots] carry
+
+            if self.spec_tokens:
+                g = self.spec_tokens
+                Wp = self.pages_per_slot
+                Hcap = Wp * page_size  # logical per-slot capacity
+
+                # Paged spec packed layout [2 + Wp + Hcap, n]:
+                #   [0] input token | [1] history length | [2:2+Wp] table.T
+                #   | [2+Wp:] history.T. Inactive lanes ship hlen = Hcap+1
+                #   AND an all-OOB table row, so every write drops.
+                @partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+                def _spec_chunk(params, cache, steps, packed):
+                    n_l = packed.shape[1]
+                    tok0 = packed[0]
+                    hlen0 = packed[1]
+                    table = packed[2:2 + Wp].T      # [n, Wp]
+                    hist0 = packed[2 + Wp:].T       # [n, Hcap]
+                    idx = jnp.arange(Hcap)
+
+                    def outer(carry, _):
+                        tok, hlen, hist, cache = carry
+                        pos = hlen - 1
+                        match = (hist == tok[:, None]) & (idx[None, :] < pos[:, None])
+                        j = jnp.where(match, idx[None, :], -1).max(axis=1)
+                        take = jnp.clip(j[:, None] + 1 + jnp.arange(g)[None, :], 0, Hcap - 1)
+                        drafts = jnp.take_along_axis(hist, take, axis=1)
+                        seq = jnp.concatenate([tok[:, None], drafts], axis=1)
+                        logits, cache = family.verify_step_paged(
+                            cfg, params, seq, pos, cache, table)
+                        tgt = jnp.argmax(logits, -1).astype(jnp.int32)
+                        ok = jnp.cumprod((drafts == tgt[:, :g]).astype(jnp.int32), axis=1)
+                        acc = ok.sum(axis=1)
+                        nxt = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
+                        emit = jnp.arange(g + 1)[None, :] <= acc[:, None]
+                        wpos = jnp.where(emit, hlen[:, None] + jnp.arange(g + 1)[None, :], Hcap)
+                        hist = hist.at[jnp.arange(n_l)[:, None], wpos].set(tgt, mode="drop")
+                        return (nxt, hlen + acc + 1, hist, cache), (tgt, acc)
+
+                    (_, _, _, cache), (toks, accs) = jax.lax.scan(
+                        outer, (tok0, hlen0, hist0, cache), None, length=steps
+                    )
+                    return toks, accs, cache
+
+                self._spec_chunk_fn = _spec_chunk
         else:
             @partial(jax.jit, donate_argnums=(2,))
             def _prefill_sample(params, base_key, cache, packed):
@@ -912,8 +955,14 @@ class GenerateEngine(_EngineBase):
             self._compiled.add(("decode", n, k))
             count += 1
         if self.spec_tokens:
-            spec_packed = np.zeros((2 + self._cache_len, n), np.int32)
-            spec_packed[1, :] = self._cache_len + 1  # all lanes OOB
+            if self.kv_layout == "paged":
+                sw, sh = self.pages_per_slot, self.pages_per_slot * self.page_size
+            else:
+                sw, sh = 0, self._cache_len
+            spec_packed = np.zeros((2 + sw + sh, n), np.int32)
+            spec_packed[1, :] = sh + 1  # all lanes OOB
+            if sw:
+                spec_packed[2:2 + sw] = self.total_pages  # all-OOB tables
             toks, _, self.cache = self._spec_chunk_fn(
                 self.params, self.cache, k, jnp.asarray(spec_packed))
             jax.block_until_ready(toks)
@@ -1150,6 +1199,34 @@ class GenerateEngine(_EngineBase):
             self._ref_page(p)
         if new:
             self.metrics.set_gauge("app_tpu_prefix_cached_pages", len(self._prefix))
+
+    def _alloc_lane_pages(self, i: int, s: "_Slot", upto_pos: int) -> None:
+        """Grow lane i's block table to cover ``upto_pos``, preempting the
+        newest-admitted OTHER slot under pool pressure (LIFO, recompute on
+        return). Caller holds the state lock and must re-check lane
+        identity afterwards — preemption may have evicted lanes, including
+        this one via another lane's pressure."""
+        if self.slots[i] is not s:
+            return  # evicted by an earlier lane's pool pressure
+        while not self._ensure_pages(i, upto_pos):
+            if not self._preempt_newest(except_slot=i):
+                # alone and still short — can't happen when
+                # total_pages >= pages_per_slot (ctor guard)
+                self._free_slot(i)
+                s.request.complete(error=RuntimeError(
+                    "KV page pool exhausted for a single request"))
+                break
+
+    def _masked_table(self, live: set) -> np.ndarray:
+        """Block-table snapshot with NON-decoding rows forced all-OOB: a
+        chunk-prefilling slot owns real pages, and a uniform decode write
+        would corrupt its position 0 otherwise; empty slots are already
+        all-OOB via _free_slot. Caller holds the state lock."""
+        snapshot = self._table.copy()
+        for i in range(self.num_slots):
+            if i not in live:
+                snapshot[i, :] = self.total_pages
+        return snapshot
 
     def _preempt_newest(self, except_slot: int | None = None) -> bool:
         """Pool pressure valve: evict the MOST RECENTLY admitted active slot
@@ -1526,10 +1603,26 @@ class GenerateEngine(_EngineBase):
             if not lanes:
                 return False
             n = self.num_slots
-            H = self._cache_len
             k = self.decode_chunk
-            packed = np.zeros((2 + H, n), np.int32)
+            paged = self.kv_layout == "paged"
+            if paged:
+                # every round writes up to chunk_span positions past pos —
+                # allocate pages for the worst case NOW (the device cannot
+                # allocate mid-chunk)
+                for i, s in list(lanes):
+                    self._alloc_lane_pages(i, s, s.pos + self._chunk_span - 1)
+                lanes = [(i, s) for i, s in lanes if self.slots[i] is s]
+                if not lanes:
+                    return True  # preemption work happened
+                W = self.pages_per_slot
+                H = W * self.page_size
+            else:
+                W = 0
+                H = self._cache_len
+            packed = np.zeros((2 + W + H, n), np.int32)
             packed[1, :] = H + 1  # inactive lanes: every write lands OOB
+            if paged:
+                packed[2:2 + W] = self._masked_table({i for i, _ in lanes}).T
             for i, s in lanes:
                 hist = np.concatenate([
                     np.asarray(s.prompt_tokens, np.int32),
@@ -1537,7 +1630,7 @@ class GenerateEngine(_EngineBase):
                 ])
                 packed[0, i] = s.last_token
                 packed[1, i] = hist.shape[0]  # == s.pos + 1
-                packed[2:2 + hist.shape[0], i] = hist
+                packed[2 + W:2 + W + hist.shape[0], i] = hist
             occupancy = len(lanes) / n
             self._inflight = [s.request for _, s in lanes]
             t0 = time.monotonic()
@@ -1609,21 +1702,9 @@ class GenerateEngine(_EngineBase):
 
             if self.kv_layout == "paged":
                 # every decoding lane must own pages covering this chunk's
-                # writes (p .. p+k-1) BEFORE the table snapshot; pool
-                # exhaustion preempts the newest-admitted slot (LIFO,
-                # recompute on return) — possibly one of `lanes`, hence the
-                # identity re-checks after the loop
+                # writes (p .. p+k-1) BEFORE the table snapshot
                 for i, s, p in list(lanes):
-                    if self.slots[i] is not s:
-                        continue  # evicted by an earlier lane's pool pressure
-                    while not self._ensure_pages(i, p + k - 1):
-                        if not self._preempt_newest(except_slot=i):
-                            # alone and still short — can't happen when
-                            # total_pages >= pages_per_slot (ctor guard)
-                            self._free_slot(i)
-                            s.request.complete(error=RuntimeError(
-                                "KV page pool exhausted for a single request"))
-                            break
+                    self._alloc_lane_pages(i, s, p + k - 1)
                 lanes = [(i, s, p) for i, s, p in lanes if self.slots[i] is s]
                 if not lanes:
                     return False
@@ -1657,16 +1738,7 @@ class GenerateEngine(_EngineBase):
             self._step_count += 1
             packed[3, 0] = self._step_count
             if self.kv_layout == "paged":
-                # table snapshot with NON-decoding rows masked out: a chunk-
-                # prefilling slot owns real pages, and the decode write (which
-                # covers all rows uniformly) would corrupt its position 0
-                # otherwise; empty slots are already all-OOB via _free_slot
-                table_snapshot = self._table.copy()
-                live = {i for i, _, _ in lanes}
-                for i in range(n):
-                    if i not in live:
-                        table_snapshot[i, :] = self.total_pages
-                packed[5:] = table_snapshot.T
+                packed[5:] = self._masked_table({i for i, _, _ in lanes}).T
 
             for _, s, _ in lanes:
                 s.inflight += 1
@@ -1923,15 +1995,17 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
         # legitimately target a different engine in the same app.
         spec_kw = kw.pop("spec_tokens", None)
         spec_tokens = int(spec_kw if spec_kw is not None else conf.get_int("ENGINE_SPEC_TOKENS", 0))
-        if spec_tokens and (kv_layout != "slot" or not hasattr(family, "verify_step")):
+        spec_attr = "verify_step" if kv_layout == "slot" else "verify_step_paged"
+        if spec_tokens and not hasattr(family, spec_attr):
             if spec_kw is not None:
                 raise ValueError(
-                    f"spec_tokens needs the slot KV layout and a family with "
-                    f"verify_step (layout={kv_layout!r}, family={getattr(family, '__name__', family)!r})"
+                    f"spec_tokens: family {getattr(family, '__name__', family)!r} "
+                    f"has no {spec_attr} (speculative verification for the "
+                    f"{kv_layout} layout)"
                 )
             container.logger.warn(
                 f"ENGINE_SPEC_TOKENS ignored for family "
-                f"{getattr(family, '__name__', family)!r} (needs slot layout + verify_step)"
+                f"{getattr(family, '__name__', family)!r} (no {spec_attr})"
             )
             spec_tokens = 0
         # same precedent for the int8 KV cache knob

@@ -149,10 +149,54 @@ class TestSpecEngine:
         finally:
             eng.stop()
 
-    def test_paged_layout_rejected(self, setup):
-        cfg, params, _ = setup
-        with pytest.raises(ValueError, match="slot KV layout"):
-            make_engine(cfg, params, kv_layout="paged", page_size=8)
+    def test_paged_layout_matches_reference(self, setup):
+        """Speculation on the PAGED layout (llama's default): verification
+        writes route through block tables, pages for the worst-case span
+        are allocated before each round, and greedy stays bit-exact — with
+        the prefix cache active alongside."""
+        cfg, params, ref = setup
+        eng = make_engine(cfg, params, kv_layout="paged", page_size=8)
+        try:
+            prompt = [(11 * i) % 190 + 1 for i in range(20)]
+            out = eng.generate(prompt, max_new_tokens=12, timeout=120)
+            assert out["tokens"] == ref(prompt, 12)
+            # again through a prefix hit; spec + prefix must compose
+            out2 = eng.generate(prompt, max_new_tokens=12, timeout=120)
+            assert out2["tokens"] == ref(prompt, 12)
+            assert _counter(eng, "app_tpu_prefix_hit_tokens") > 0
+            from gofr_tpu.testutil import assert_paged_pool_consistent
+
+            assert_paged_pool_consistent(eng, slots_empty=True)
+        finally:
+            eng.stop()
+
+    def test_paged_spec_pool_pressure(self, setup):
+        """Worst-case-span page allocation under a tight pool: preemption
+        and speculation interleave without diverging or leaking pages."""
+        cfg, params, ref = setup
+        eng = make_engine(cfg, params, kv_layout="paged", page_size=8,
+                          total_pages=14, slots=4)
+        prompts = [[i + 1, (3 * i) % 200 + 1, (5 * i) % 150] for i in range(4)]
+        want = [ref(p, 12) for p in prompts]
+        results = [None] * 4
+
+        def worker(i):
+            results[i] = eng.generate(prompts[i], max_new_tokens=12, timeout=300)
+
+        try:
+            threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            for i, r in enumerate(results):
+                assert r is not None, f"request {i} did not complete"
+                assert r["tokens"] == want[i], f"request {i} diverged"
+            from gofr_tpu.testutil import assert_paged_pool_consistent
+
+            assert_paged_pool_consistent(eng, slots_empty=True)
+        finally:
+            eng.stop()
 
 
 def test_gpt2_spec_decode_matches_reference():
