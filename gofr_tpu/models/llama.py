@@ -285,10 +285,11 @@ def forward_pipelined(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
     return qdot(x, head).astype(jnp.float32)
 
 
-@partial(jax.jit, static_argnums=0, donate_argnums=4)
+@partial(jax.jit, static_argnums=0, static_argnames=("attn_fn",), donate_argnums=4)
 def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray,
             cache: SlotKVCache, slots: jnp.ndarray,
-            offsets: jnp.ndarray | None = None) -> tuple[jnp.ndarray, SlotKVCache]:
+            offsets: jnp.ndarray | None = None, *,
+            attn_fn: Any = None) -> tuple[jnp.ndarray, SlotKVCache]:
     """Prefill prompts (or prompt CHUNKS) into cache slots.
 
     tokens [B,S] (padded), lengths [B] = live tokens in this call, slots
@@ -297,7 +298,15 @@ def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.nd
     whole-prompt prefill). Chunked rows attend to everything already in
     their slot through a gathered cache view; whole-prompt rows attend
     prompt-locally.
+
+    ``attn_fn`` swaps the whole-prompt attention (same contract as
+    ops.mha_attention) — e.g. a mesh-bound ring/Ulysses sequence-parallel
+    attention (parallel.ring.make_seq_parallel_attn) so long-prompt
+    prefill shards the sequence over an ``sp`` axis. Whole-prompt rows
+    only: the chunked path's gathered-view attention stays as is.
     """
+    if attn_fn is not None and offsets is not None:
+        raise ValueError("attn_fn applies to whole-prompt prefill only (offsets=None)")
     cos, sin = _rope(cfg)
     x = params["embed"][tokens].astype(cfg.dtype)
     b, s = tokens.shape
@@ -335,10 +344,11 @@ def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.nd
             )
         elif quant:
             # self-consistency with the int8 cache (see prefill_paged)
-            attn = mha_attention(q, fake_quant_row(k), fake_quant_row(v),
-                                 causal=True, kv_lengths=lengths)
+            attn = (attn_fn or mha_attention)(
+                q, fake_quant_row(k), fake_quant_row(v),
+                causal=True, kv_lengths=lengths)
         else:
-            attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
+            attn = (attn_fn or mha_attention)(q, k, v, causal=True, kv_lengths=lengths)
         x = x + qdot(attn.reshape(b, s, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
         return x, (k_layer, ks_l, v_layer, vs_l) if quant else (k_layer, v_layer)
@@ -556,10 +566,11 @@ def make_paged_cache_q(cfg: LlamaConfig, pages: int, page_size: int = 128) -> QP
     )
 
 
-@partial(jax.jit, static_argnums=0, donate_argnums=4)
+@partial(jax.jit, static_argnums=0, static_argnames=("attn_fn",), donate_argnums=4)
 def prefill_paged(
     cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray,
     cache: PagedKVCache, pages: jnp.ndarray, offsets: jnp.ndarray | None = None,
+    *, attn_fn: Any = None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Prefill prompts (or prompt CHUNKS) through per-row block tables.
 
@@ -571,6 +582,8 @@ def prefill_paged(
     gathered view; whole-prompt rows attend prompt-locally, identical to
     ``prefill``. Returns (last-chunk-token logits [B,V] f32, cache).
     """
+    if attn_fn is not None and offsets is not None:
+        raise ValueError("attn_fn applies to whole-prompt prefill only (offsets=None)")
     cos, sin = _rope(cfg)
     x = params["embed"][tokens].astype(cfg.dtype)
     b, s = tokens.shape
@@ -614,11 +627,12 @@ def prefill_paged(
                 # attend to what the cache STORES (fake-quantized k/v) so a
                 # later prefix-cache hit — which reads the int8 pages — is
                 # bit-identical to this cold run (kvcache.fake_quant_row)
-                attn = mha_attention(q, fake_quant_row(k), fake_quant_row(v),
-                                     causal=True, kv_lengths=lengths)
+                attn = (attn_fn or mha_attention)(
+                    q, fake_quant_row(k), fake_quant_row(v),
+                    causal=True, kv_lengths=lengths)
             else:
                 k_layer, v_layer = write_prompts_paged(k_layer, v_layer, pages, k, v)
-                attn = mha_attention(q, k, v, causal=True, kv_lengths=lengths)
+                attn = (attn_fn or mha_attention)(q, k, v, causal=True, kv_lengths=lengths)
         x = x + qdot(attn.reshape(b, s, -1), lp["wo"])
         x = x + _mlp(cfg, lp, x)
         return x, (k_layer, ks_l, v_layer, vs_l) if quant else (k_layer, v_layer)

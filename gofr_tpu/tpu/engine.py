@@ -535,6 +535,8 @@ class GenerateEngine(_EngineBase):
         prefix_cache: bool = True,
         spec_tokens: int = 0,
         kv_quantize: str = "",
+        prefill_attn_fn: Any = None,
+        prefill_attn_divisor: int = 1,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -545,6 +547,17 @@ class GenerateEngine(_EngineBase):
         self.prefill_buckets = sorted(prefill_buckets) if prefill_buckets else _pow2_buckets(
             16, self.max_len
         )
+        if prefill_attn_fn is not None and prefill_attn_divisor > 1:
+            bad = [b for b in self.prefill_buckets if b % prefill_attn_divisor]
+            if bad:
+                # fail at BUILD time, not on the first prompt that lands in
+                # an indivisible bucket mid-serving (the top bucket is
+                # max_len itself, which need not be a power of two)
+                raise ValueError(
+                    f"prefill buckets {bad} are not divisible by the "
+                    f"sequence-parallel axis size {prefill_attn_divisor}; "
+                    f"set ENGINE_MAX_LEN (or prefill_buckets) to multiples of it"
+                )
         self.max_prefill_batch = max_prefill_batch
         self.eos_token_id = eos_token_id
         self.tokenizer = tokenizer
@@ -675,6 +688,10 @@ class GenerateEngine(_EngineBase):
 
         ts = (top_k, top_p)
         W = self.pages_per_slot if kv_layout == "paged" else 1
+        # whole-prompt prefill attention override (e.g. ring/Ulysses
+        # sequence-parallel attention on an sp mesh — build_engine wires it);
+        # chunked prefill keeps the gathered-view attention either way
+        pf = {"attn_fn": prefill_attn_fn} if prefill_attn_fn is not None else {}
 
         # Every step ships its host inputs as ONE packed int32 array (floats
         # bitcast, RNG step folded in on device from the resident base key).
@@ -714,7 +731,7 @@ class GenerateEngine(_EngineBase):
             def _prefill_sample(params, base_key, cache, packed):
                 tokens, lengths, rows, _, temps, step = _unpack_prefill(packed, W)
                 key = jax.random.fold_in(base_key, step)
-                logits, cache = family.prefill_paged(cfg, params, tokens, lengths, cache, rows)
+                logits, cache = family.prefill_paged(cfg, params, tokens, lengths, cache, rows, **pf)
                 toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
                 return toks, cache
 
@@ -799,7 +816,7 @@ class GenerateEngine(_EngineBase):
             def _prefill_sample(params, base_key, cache, packed):
                 tokens, lengths, rows, _, temps, step = _unpack_prefill(packed, W)
                 key = jax.random.fold_in(base_key, step)
-                logits, cache = family.prefill(cfg, params, tokens, lengths, cache, rows[:, 0])
+                logits, cache = family.prefill(cfg, params, tokens, lengths, cache, rows[:, 0], **pf)
                 toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
                 return toks, cache
 
@@ -1930,6 +1947,10 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
         rules = rules.with_overrides(layers="pp")
         family = PPLlamaFamily(mesh, microbatches=pp_microbatches or None, rules=rules)
 
+    prefill_attn = kw.pop("prefill_attn_fn", None)
+    sp_size = (int(mesh.shape["sp"])
+               if mesh is not None and "sp" in getattr(mesh, "axis_names", ()) else 1)
+
     if spec.weights:
         from gofr_tpu.train.checkpoint import is_checkpoint_dir, load_params
 
@@ -2008,6 +2029,35 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
                 f"{getattr(family, '__name__', family)!r} (no {spec_attr})"
             )
             spec_tokens = 0
+        prefix_cache = bool(kw.pop("prefix_cache", conf.get_bool("ENGINE_PREFIX_CACHE", True)))
+        if prefill_attn is None and sp_size > 1 and spec.task == "generate":
+            # sequence-parallel PREFILL: whole-prompt attention shards the
+            # sequence over sp (ring online-softmax, parallel/ring.py) —
+            # the long-context lever for prompt-heavy serving. Batch stays
+            # replicated inside the region (prefill batches are small).
+            # NOT wired when it would break a contract, with a loud warn:
+            # - prefix cache on (paged): a cache hit replays the remainder
+            #   through gathered-view attention, whose reduction order
+            #   differs from ring's — cold/hit bit-identity would be lost;
+            # - non-llama families / the pp family: no attn_fn hook.
+            supported = (spec.family == "llama"
+                         and getattr(family, "__name__", "") != "llama_pp")
+            if not supported:
+                container.logger.warn(
+                    f"mesh has sp:{sp_size} but sequence-parallel prefill is "
+                    f"not wired for family {getattr(family, '__name__', family)!r}"
+                )
+            elif kv_layout == "paged" and prefix_cache:
+                container.logger.warn(
+                    f"mesh has sp:{sp_size} but sequence-parallel prefill is "
+                    "disabled while the prefix cache is on (ring vs gathered-"
+                    "view reduction order would break cold/hit bit-identity); "
+                    "set ENGINE_PREFIX_CACHE=false to enable it"
+                )
+            else:
+                from gofr_tpu.parallel.ring import make_seq_parallel_attn
+
+                prefill_attn = make_seq_parallel_attn(mesh, batch_axes=())
         # same precedent for the int8 KV cache knob
         kvq_kw = kw.pop("kv_quantize", None)
         kv_quantize = str(kvq_kw if kvq_kw is not None
@@ -2033,9 +2083,11 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             kv_layout=kv_layout,
             page_size=int(kw.pop("page_size", conf.get_int("ENGINE_PAGE_SIZE", 128))),
             total_pages=int(kw.pop("total_pages", conf.get_int("ENGINE_TOTAL_PAGES", 0))) or None,
-            prefix_cache=bool(kw.pop("prefix_cache", conf.get_bool("ENGINE_PREFIX_CACHE", True))),
+            prefix_cache=prefix_cache,
             spec_tokens=spec_tokens,
             kv_quantize=kv_quantize,
+            prefill_attn_fn=prefill_attn,
+            prefill_attn_divisor=sp_size if prefill_attn is not None else 1,
             decode_pipeline=int(kw.pop("decode_pipeline", conf.get_int("ENGINE_DECODE_PIPELINE", 2))),
             eos_token_id=eos,
             tokenizer=tokenizer,
