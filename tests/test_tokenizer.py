@@ -115,6 +115,20 @@ class TestEngineTextPath:
         finally:
             eng.stop()
 
+    def test_spec_decode_streams_text(self, text_setup):
+        """Speculative rounds emit several tokens per device call; the
+        stream detokenizer must still produce the exact final text."""
+        cfg, params, _ = text_setup
+        eng = make_text_engine(cfg, params, spec_tokens=3, decode_chunk=4,
+                               kv_layout="slot")
+        try:
+            pieces = list(eng.generate("spec me", max_new_tokens=16,
+                                       timeout=300, stream=True))
+            out = eng.generate("spec me", max_new_tokens=16, timeout=300)
+            assert "".join(pieces) == out["text"]
+        finally:
+            eng.stop()
+
     def test_no_tokenizer_streams_raw_ids(self, text_setup):
         cfg, params, ref = text_setup
         eng = make_text_engine(cfg, params, tokenizer=None)
