@@ -49,6 +49,14 @@ run plain_latency GOFR_BENCH_LATENCY=1 GOFR_BENCH_REQUESTS=64
 # 8) shared-prefix workload (paged + prefix cache A/B)
 run prefix GOFR_BENCH_PREFIX=1 GOFR_BENCH_REQUESTS=128
 
+# 8b) paged layout: headline + int8 + pallas in-place page append
+run paged GOFR_BENCH_KV=paged
+run paged_kv8 GOFR_BENCH_KV=paged GOFR_BENCH_KV_QUANTIZE=int8
+run paged_kv8_pallas GOFR_BENCH_KV=paged GOFR_BENCH_KV_QUANTIZE=int8 \
+    GOFR_PAGED_KV_WRITE=pallas
+run paged_spec_latency GOFR_BENCH_KV=paged GOFR_BENCH_LATENCY=1 \
+    GOFR_BENCH_SPEC=4 GOFR_BENCH_REQUESTS=64
+
 # 9) the north-star model class: Llama-3-8B shape, int8 weights
 run eight_b GOFR_BENCH_PRESET=eight_b GOFR_BENCH_REQUESTS=256 \
     GOFR_BENCH_SLOTS=64 GOFR_BENCH_PREFILL_BATCH=32
