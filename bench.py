@@ -27,7 +27,7 @@ Env knobs:
     GOFR_BENCH_PLATFORM       force 'cpu' or 'tpu' (skips the probe)
     GOFR_BENCH_PROBE_S        TPU init probe timeout seconds (default 240)
     GOFR_BENCH_KV             'slot' (default) | 'paged' engine KV layout
-    GOFR_BENCH_KV_QUANTIZE    'int8' = int8 KV cache (slot layout only)
+    GOFR_BENCH_KV_QUANTIZE    'int8' = int8 KV cache (slot and paged layouts)
     GOFR_BENCH_SPEC           N>0 = speculative decoding with N lookup drafts
     GOFR_BENCH_PREFIX         1 = also measure the shared-prefix workload on the
                               paged engine (prefix cache on vs off)

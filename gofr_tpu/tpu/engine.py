@@ -2057,7 +2057,21 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             else:
                 from gofr_tpu.parallel.ring import make_seq_parallel_attn
 
-                prefill_attn = make_seq_parallel_attn(mesh, batch_axes=())
+                strategy = conf.get_or_default("ENGINE_SP_STRATEGY", "ring")
+                if strategy == "ulysses":
+                    # ulysses all-to-alls heads across sp — per-device query
+                    # heads must divide (ring.py ulysses check). Fail at
+                    # BUILD time like the bucket guard, not mid-serving.
+                    tp_size = int(mesh.shape.get("tp", 1))
+                    local_heads = cfg.num_heads // max(1, tp_size)
+                    if local_heads % sp_size:
+                        raise ValueError(
+                            f"ENGINE_SP_STRATEGY=ulysses needs per-device query "
+                            f"heads ({cfg.num_heads}/tp:{tp_size} = {local_heads}) "
+                            f"divisible by sp:{sp_size}"
+                        )
+                prefill_attn = make_seq_parallel_attn(
+                    mesh, batch_axes=(), strategy=strategy)
         # same precedent for the int8 KV cache knob
         kvq_kw = kw.pop("kv_quantize", None)
         kv_quantize = str(kvq_kw if kvq_kw is not None

@@ -66,6 +66,25 @@ def test_sp_mesh_ring_prefill_matches_single_device():
     check_mesh_serving(config)
 
 
+def test_sp_mesh_ulysses_strategy_and_bucket_guard():
+    """ENGINE_SP_STRATEGY=ulysses swaps the sequence-parallel strategy and
+    stays token-exact; buckets indivisible by sp are rejected at BUILD
+    time (the top bucket is max_len itself, not a power of two)."""
+    from gofr_tpu.models import ModelSpec
+    from gofr_tpu.testutil import tiny_f32_llama
+    from gofr_tpu.tpu.engine import build_engine
+
+    check_mesh_serving({"TPU_MESH": "dp:2,sp:2,tp:2",
+                        "ENGINE_SP_STRATEGY": "ulysses"},
+                       kv_layout="slot", n_requests=3)
+
+    cfg, _ = tiny_f32_llama()
+    c = new_mock_container({"TPU_MESH": "dp:2,sp:2,tp:2", "ENGINE_KV_LAYOUT": "slot"})
+    with pytest.raises(ValueError, match="divisible"):
+        build_engine(ModelSpec("llama", cfg, task="generate"), c, seed=3,
+                     slots=2, max_len=63, max_prefill_batch=1)
+
+
 def test_int8_kv_and_spec_decode_on_tp_mesh():
     """Round-4 serving features under GSPMD: int8 KV (quantize/dequant
     folding must partition) and speculative decoding (verify_step +
