@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gofr_tpu.http.errors import RequestTimeout
+from gofr_tpu.tpu.lockstep import TAG_CHUNK, TAG_DECODE, TAG_PREFILL, TAG_SPEC
 from gofr_tpu.native import plan_prefill
 from gofr_tpu.models.base import ModelSpec, get_family
 from gofr_tpu.ops.sampling import sample_token
@@ -257,6 +258,14 @@ class _EngineBase:
                 if self._stop.is_set() or self._restarts >= self.max_restarts:
                     self._startup_error = e
                     self._fail_all(e)
+                    ls = getattr(self, "_ls", None)
+                    if ls is not None:
+                        # dying ON the device thread: no concurrent
+                        # collective exists, so release blocked followers
+                        try:
+                            ls.stop()
+                        except Exception:  # noqa: BLE001
+                            pass
                     return
                 self._restarts += 1
                 self.metrics.increment_counter("app_tpu_engine_restarts", 1)
@@ -537,6 +546,7 @@ class GenerateEngine(_EngineBase):
         kv_quantize: str = "",
         prefill_attn_fn: Any = None,
         prefill_attn_divisor: int = 1,
+        lockstep_role: str | None = None,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -662,6 +672,25 @@ class GenerateEngine(_EngineBase):
             self.cache = (family.make_cache_q(cfg, slots, cache_len) if kv_quantize
                           else family.make_cache(cfg, slots, cache_len))
             self._prefix = None  # prefix caching needs the paged layout
+        # multi-host lockstep (tpu/lockstep.py): the leader announces every
+        # device call so follower processes issue the same global programs
+        self.lockstep_role = lockstep_role
+        self._ls = None
+        if lockstep_role:
+            # a crash-RESTART would reset step/carry state on the leader
+            # only, desynchronizing followers — never restart in lockstep
+            self.max_restarts = 0
+        if lockstep_role == "leader":
+            from gofr_tpu.tpu.lockstep import LockstepLeader
+
+            self._ls = LockstepLeader()
+        if lockstep_role:
+            # the cache is created process-locally; a multi-host global
+            # program needs it placed as a GLOBAL (replicated) array
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            self.cache = jax.device_put(
+                self.cache, NamedSharding(self.tpu.mesh, _P()))
         self.slots: list[_Slot | None] = [None] * slots
         self._pending: list[tuple[Request, np.ndarray]] = []
         # prompts longer than the largest prefill bucket: admitted one at a
@@ -934,6 +963,7 @@ class GenerateEngine(_EngineBase):
                 packed = np.zeros((nb, lb + w + 3), np.int32)
                 packed[:, lb] = 1  # lengths
                 packed[:, lb + 1:lb + 1 + w] = oob  # all-OOB rows: writes dropped
+                self._announce(TAG_PREFILL, lb, nb, packed)
                 toks, self.cache = self._prefill_sample(
                     self.params, self._base_key, self.cache, jnp.asarray(packed)
                 )
@@ -948,6 +978,7 @@ class GenerateEngine(_EngineBase):
                 packed = np.zeros((1, lb + w + 4), np.int32)
                 packed[0, lb] = 1
                 packed[0, lb + 1:lb + 1 + w] = oob
+                self._announce(TAG_CHUNK, lb, 1, packed)
                 toks, self.cache = self._chunk_prefill(
                     self.params, self._base_key, self.cache, jnp.asarray(packed)
                 )
@@ -964,6 +995,7 @@ class GenerateEngine(_EngineBase):
         if not self.spec_tokens:
             # spec mode never calls _dispatch_decode — don't compile the
             # (expensive) plain decode program it would throw away
+            self._announce(TAG_DECODE, 0, 0, packed)  # a=0: warmup, no carry
             out, _, self.cache = self._decode_chunk(
                 self.params, self._base_key, self.cache, k, jnp.asarray(packed),
                 jnp.zeros((n,), jnp.int32),
@@ -980,6 +1012,7 @@ class GenerateEngine(_EngineBase):
             spec_packed[1, :] = sh + 1  # all lanes OOB
             if sw:
                 spec_packed[2:2 + sw] = self.total_pages  # all-OOB tables
+            self._announce(TAG_SPEC, 2 + sw + sh, 0, spec_packed)
             toks, _, self.cache = self._spec_chunk_fn(
                 self.params, self.cache, k, jnp.asarray(spec_packed))
             jax.block_until_ready(toks)
@@ -1045,6 +1078,29 @@ class GenerateEngine(_EngineBase):
                 yield item
 
         return _StreamIterator(req, it())
+
+    def _announce(self, tag: int, a: int, b: int, packed) -> None:
+        if self._ls is not None:
+            self._ls.announce(tag, a, b, packed)
+
+    def stop(self) -> None:
+        super().stop()
+        if self._ls is not None and not self._poisoned:
+            # after a CLEAN device-thread join no concurrent collective can
+            # interleave with the terminal broadcast. A wedged thread may
+            # still be inside one — broadcasting would corrupt the stream;
+            # followers must be torn down externally then (lockstep.py).
+            self._ls.stop()
+
+    def serve_follower(self) -> None:
+        """Run this process as a lockstep FOLLOWER (multi-host serving,
+        tpu/lockstep.py): blocks executing the leader's announced programs
+        until the leader stops. Do not call start()."""
+        if self.lockstep_role != "follower":
+            raise RuntimeError("engine was not built with lockstep_role='follower'")
+        from gofr_tpu.tpu.lockstep import LockstepFollower
+
+        LockstepFollower(self).run()
 
     # -- device loop -----------------------------------------------------------
 
@@ -1418,6 +1474,7 @@ class GenerateEngine(_EngineBase):
             self._inflight = [s.request]
             t0 = time.monotonic()
 
+        self._announce(TAG_CHUNK, lb, 1, packed)
         first_dev, self.cache = self._chunk_prefill(
             self.params, self._base_key, self.cache, jnp.asarray(packed)
         )
@@ -1565,6 +1622,7 @@ class GenerateEngine(_EngineBase):
             t0 = time.monotonic()
             self._inflight = [req for req, _ in ready]
 
+        self._announce(TAG_PREFILL, lb, nb, packed)
         first_dev, self.cache = self._prefill_sample(
             self.params, self._base_key, self.cache, jnp.asarray(packed)
         )
@@ -1652,6 +1710,7 @@ class GenerateEngine(_EngineBase):
             self._inflight = [s.request for _, s in lanes]
             t0 = time.monotonic()
 
+        self._announce(TAG_SPEC, packed.shape[0], 0, packed)
         toks_dev, accs_dev, self.cache = self._spec_chunk_fn(
             self.params, self.cache, k, jnp.asarray(packed))
         toks = np.asarray(toks_dev)  # [k, n, g+1] int32 — tokens, never logits
@@ -1762,6 +1821,7 @@ class GenerateEngine(_EngineBase):
             occupancy = len(lanes) / n
             t0 = time.monotonic()
 
+        self._announce(TAG_DECODE, 1, 0, packed)  # a=1: live, carry applies
         prev = self._prev_last
         if prev is None:
             prev = jnp.zeros((n,), jnp.int32)
@@ -2029,6 +2089,17 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
                 f"{getattr(family, '__name__', family)!r} (no {spec_attr})"
             )
             spec_tokens = 0
+        # multi-host: every process must issue identical global programs;
+        # the leader (process 0) serves, followers run serve_follower()
+        # (tpu/lockstep.py). A crash-restart would desynchronize followers,
+        # so lockstep engines don't restart.
+        lockstep_role = kw.pop("lockstep_role", None)
+        if (lockstep_role is None and getattr(tpu, "distributed", False)
+                and jax.process_count() > 1):
+            lockstep_role = "leader" if jax.process_index() == 0 else "follower"
+        if lockstep_role:
+            kw["max_restarts"] = 0
+
         prefix_cache = bool(kw.pop("prefix_cache", conf.get_bool("ENGINE_PREFIX_CACHE", True)))
         if prefill_attn is None and sp_size > 1 and spec.task == "generate":
             # sequence-parallel PREFILL: whole-prompt attention shards the
@@ -2102,6 +2173,7 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             kv_quantize=kv_quantize,
             prefill_attn_fn=prefill_attn,
             prefill_attn_divisor=sp_size if prefill_attn is not None else 1,
+            lockstep_role=lockstep_role,
             decode_pipeline=int(kw.pop("decode_pipeline", conf.get_int("ENGINE_DECODE_PIPELINE", 2))),
             eos_token_id=eos,
             tokenizer=tokenizer,

@@ -1,0 +1,131 @@
+"""Multi-host lockstep serving (SURVEY §5.8; BASELINE row 4).
+
+A multi-host mesh (v5e-64 = 16 hosts) runs ONE XLA program per step across
+every process: all processes must issue identical jit calls in identical
+order, but only one process sees the request queue. The reference scales
+out with NCCL/MPI ranks driven by an external launcher; the TPU-native
+analog is leader/follower lockstep over the runtime's own collectives:
+
+- the LEADER (process 0) runs the full GenerateEngine — admission, EDF
+  planning, slot bookkeeping, streaming — and before every device call
+  broadcasts a small header (program tag + shape/flag fields) followed by
+  the packed host inputs (``multihost_utils.broadcast_one_to_all`` — a
+  device collective, so it rides the same ICI/DCN fabric as the program);
+- FOLLOWERS run ``engine.serve_follower()``: receive the header,
+  reconstruct the packed array's shape from it plus engine config,
+  receive the payload, and issue the SAME jit call. Their host loops never
+  touch requests; their contribution is their device shards inside the
+  sharded programs.
+
+Determinism makes this sound: params come from the same seed, the RNG step
+rides inside the packed inputs, decode-chunk length is static, and the
+device-resident ``prev_last`` carry is reproduced on every process because
+each executes the same calls in the same order (warmup decode announces a
+live=0 flag so followers mirror the leader's no-carry warmup exactly).
+
+Failure semantics: the leader broadcasts the STOP tag on ``stop()`` AND
+from the device loop's terminal crash path, so follower processes never
+block forever on a dead leader. A leader stopped with a WEDGED device
+thread cannot safely broadcast (the wedged thread may still be inside a
+collective) — followers must be torn down externally in that case, which
+is also the only safe multi-host response to a wedged program.
+
+v1 scope: no engine crash-RESTART while in lockstep (a restart resets the
+leader's step/carry state and would desynchronize followers; the engine
+forces max_restarts=0).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TAG_STOP = 0
+TAG_PREFILL = 1
+TAG_CHUNK = 2
+TAG_DECODE = 3
+TAG_SPEC = 4
+
+_HEADER_LEN = 3  # (tag, a, b)
+
+
+def _broadcast(value):
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value)
+
+
+class LockstepLeader:
+    """Leader-side announcer: one (header, payload) broadcast per device
+    call. Called from the engine's device thread only."""
+
+    def __init__(self):
+        self._stopped = False
+
+    def announce(self, tag: int, a: int, b: int, packed: np.ndarray) -> None:
+        _broadcast(np.array([tag, a, b], np.int32))
+        _broadcast(np.asarray(packed, np.int32))
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            _broadcast(np.array([TAG_STOP, 0, 0], np.int32))
+
+
+class LockstepFollower:
+    """Follower-side receive loop bound to an engine built with the same
+    config/seed. Blocks in the broadcast collective until the leader's
+    next call; returns when the leader announces stop."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def _recv(self, shape) -> np.ndarray:
+        return np.asarray(_broadcast(np.zeros(shape, np.int32)))
+
+    def run(self) -> None:
+        import jax.numpy as jnp
+
+        from gofr_tpu.ops.pallas import platform_hint
+
+        eng = self.engine
+        w = eng.pages_per_slot if eng.kv_layout == "paged" else 1
+        wt = eng.pages_per_slot if eng.kv_layout == "paged" else 0
+        n, k = eng.num_slots, eng.decode_chunk
+        # same platform pin as the leader's device thread (engine._run):
+        # first-time traces here must resolve kernels for the engine's
+        # actual backend, not whatever jax.default_backend() guesses
+        with platform_hint(getattr(eng.tpu, "platform", None)):
+            while True:
+                header = np.asarray(_broadcast(np.zeros(_HEADER_LEN, np.int32)))
+                tag, a, b = int(header[0]), int(header[1]), int(header[2])
+                if tag == TAG_STOP:
+                    return
+                if tag == TAG_PREFILL:
+                    packed = self._recv((b, a + w + 3))
+                    toks, eng.cache = eng._prefill_sample(
+                        eng.params, eng._base_key, eng.cache, jnp.asarray(packed))
+                    del toks
+                elif tag == TAG_CHUNK:
+                    packed = self._recv((1, a + w + 4))
+                    toks, eng.cache = eng._chunk_prefill(
+                        eng.params, eng._base_key, eng.cache, jnp.asarray(packed))
+                    del toks
+                elif tag == TAG_DECODE:
+                    live = bool(a)  # 0 = leader warmup: zeros carry, no store
+                    packed = self._recv((5 + wt, n))
+                    prev = eng._prev_last if live else None
+                    if prev is None:
+                        prev = jnp.zeros((n,), jnp.int32)
+                    out, last, eng.cache = eng._decode_chunk(
+                        eng.params, eng._base_key, eng.cache, k,
+                        jnp.asarray(packed), prev)
+                    if live:
+                        eng._prev_last = last
+                    del out
+                elif tag == TAG_SPEC:
+                    packed = self._recv((a, n))
+                    toks, accs, eng.cache = eng._spec_chunk_fn(
+                        eng.params, eng.cache, k, jnp.asarray(packed))
+                    del toks, accs
+                else:  # pragma: no cover - protocol corruption
+                    raise RuntimeError(f"lockstep follower: unknown tag {tag}")

@@ -1,0 +1,112 @@
+"""Multi-host lockstep SERVING (tpu/lockstep.py): two REAL processes over a
+localhost coordinator form a global tp:4 mesh (2 CPU devices each); process
+0 runs the full engine and serves requests, process 1 executes the
+announced programs. Tokens must match single-device greedy decoding — the
+cross-process analog of test_mesh_serving, with the params genuinely
+sharded across the process boundary (tp collectives ride the global mesh).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from jaxpin import child_env  # noqa: E402
+
+_WORKER = textwrap.dedent("""
+    import faulthandler, os, sys
+    faulthandler.dump_traceback_later(560, exit=True)  # post-mortem on hang
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import ModelSpec
+    from gofr_tpu.testutil import greedy_reference, tiny_f32_llama
+    from gofr_tpu.tpu.engine import build_engine
+
+    pid = int(sys.argv[1])
+    c = new_mock_container({{
+        "JAX_COORDINATOR": "127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": "2",
+        "JAX_PROCESS_ID": str(pid),
+        "TPU_MESH": "tp:4",
+        "ENGINE_KV_LAYOUT": "slot",
+    }})
+    assert c.tpu.distributed and jax.process_count() == 2
+
+    cfg, params_unused = tiny_f32_llama()
+    eng = build_engine(ModelSpec("llama", cfg, task="generate"), c, seed=3,
+                       slots=2, max_len=64, max_prefill_batch=1,
+                       prefill_buckets=[16], decode_chunk=4)
+    assert eng.lockstep_role == ("leader" if pid == 0 else "follower"), eng.lockstep_role
+
+    if pid == 0:
+        # the engine's params are GLOBAL (tp-sharded across processes);
+        # any jit over them from one process alone would hang waiting for
+        # the other. The reference rebuilds them process-locally from the
+        # same seed instead.
+        from gofr_tpu.models import llama
+        local_params = llama.init(cfg, jax.random.key(3))
+        ref = greedy_reference(cfg, local_params)
+        prompts = [[3, 7, 11], [5, 2, 9, 4]]
+        try:
+            outs = [eng.generate(p, max_new_tokens=5, timeout=240) for p in prompts]
+            for p, o in zip(prompts, outs):
+                want = ref(p, 5)
+                assert o["tokens"] == want, (o["tokens"], want)
+        finally:
+            eng.stop()
+        print("LOCKSTEP_OK leader served token-exact across 2 processes")
+    else:
+        eng.serve_follower()
+        print("LOCKSTEP_OK follower drained and exited on stop")
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_lockstep_serving(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    src = _WORKER.format(repo=repo, port=port)
+    env = child_env()
+    env.pop("XLA_FLAGS", None)
+
+    logs = [open(tmp_path / f"worker{pid}.log", "w+") for pid in (0, 1)]
+    procs = [
+        subprocess.Popen([sys.executable, "-c", src, str(pid)],
+                         env=env, stdout=logs[pid],
+                         stderr=subprocess.STDOUT, text=True)
+        for pid in (0, 1)
+    ]
+
+    def slurp():
+        out = []
+        for f in logs:
+            f.flush()
+            f.seek(0)
+            out.append(f.read())
+        return out
+
+    try:
+        for p in procs:
+            p.wait(timeout=600)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"lockstep workers hung:\n{chr(10).join(slurp())[-5000:]}")
+    outs = slurp()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert "LOCKSTEP_OK" in out, out[-4000:]
