@@ -337,9 +337,12 @@ def main() -> None:
                 ab_rates[name] = round(len(short) / mv["elapsed"], 2)
             except Exception as e:  # noqa: BLE001
                 ab_rates[name] = f"error: {e}"[:120]
+        # Require a >3% margin to switch the headline config so single short-
+        # sample noise can't flip it between rounds (numbers stay comparable);
+        # the raw A/B rates are always recorded in extra either way.
         if (isinstance(ab_rates.get("kv8"), float)
                 and isinstance(ab_rates.get("base"), float)
-                and ab_rates["kv8"] > ab_rates["base"]):
+                and ab_rates["kv8"] > ab_rates["base"] * 1.03):
             kv_quantize = "int8"
     else:
         ab_rates = {}

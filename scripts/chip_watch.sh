@@ -1,0 +1,31 @@
+#!/bin/bash
+# Probe the TPU tunnel on a loop; on first success, run the full measurement
+# session (scripts/chip_session.sh) and the decode profile. Designed to run in
+# the background all round so no window of tunnel liveness is missed.
+# Usage: bash scripts/chip_watch.sh [interval_seconds]
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${1:-300}"
+LOG=/tmp/chip_watch.log
+OUT=/tmp/chip_session.jsonl
+: > "$LOG"
+
+probe() {
+  timeout 120 python - <<'EOF' >/dev/null 2>&1
+import jax, numpy as np
+x = jax.numpy.ones((256, 256), jax.numpy.bfloat16)
+assert np.asarray(x @ x)[0, 0] == 256
+assert jax.devices()[0].platform == "tpu"
+EOF
+}
+
+while true; do
+  if probe; then
+    echo "$(date +%H:%M:%S) TPU alive — starting session" >> "$LOG"
+    bash scripts/chip_session.sh "$OUT" >> "$LOG" 2>&1
+    echo "$(date +%H:%M:%S) session finished" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) probe failed; sleeping ${INTERVAL}s" >> "$LOG"
+  sleep "$INTERVAL"
+done

@@ -72,3 +72,27 @@ def test_typed_getters():
 def test_missing_folder_ok(tmp_path):
     cfg = EnvConfig(folder=str(tmp_path / "nope"), environ={})
     assert cfg.get("ANYTHING") is None
+
+
+def test_every_knob_is_documented():
+    """docs/configs.md must cover every ENGINE_*/GOFR_* knob in the source.
+
+    Generated-from-grep so the catalog can't silently drift as knobs are
+    added (the reference ships a complete configs catalog:
+    docs/references/configs/page.md).
+    """
+    import pathlib
+    import re
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    knobs: set = set()
+    sources = [root / "bench.py", root / "__graft_entry__.py"]
+    for base in (root / "gofr_tpu", root / "scripts", root / "examples"):
+        sources.extend(p for p in base.rglob("*.py"))
+        sources.extend(p for p in base.rglob("*.sh"))
+    for path in sources:
+        text = path.read_text(errors="ignore")
+        knobs.update(re.findall(r"\b(?:ENGINE|GOFR)_[A-Z][A-Z0-9_]+", text))
+    docs = (root / "docs" / "configs.md").read_text()
+    missing = sorted(k for k in knobs if k not in docs)
+    assert not missing, f"undocumented knobs (add to docs/configs.md): {missing}"
