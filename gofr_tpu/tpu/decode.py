@@ -1,0 +1,304 @@
+"""Decode dispatch paths for ``GenerateEngine``.
+
+Split out of tpu/engine.py (the engine's device thread calls these once
+per loop iteration). The interface to the engine is its documented state:
+slot table + page bookkeeping under ``eng._state_lock``, the compiled
+program handles from tpu/programs.py, the pipelined-dispatch queue
+``eng._dq`` with the device-resident carries (``eng._prev_last`` for
+plain decode, ``eng._spec_carry`` for speculative rounds), and the
+emit/finish callbacks.
+
+Plain decode AND slot-layout speculative rounds are PIPELINED: dispatch
+chunk t, then block on chunk t-1 so readback + host bookkeeping overlap
+chunk t's compute. Spec rounds can pipeline because the data-dependent
+state (token, hlen, token history) is device-resident — the host never
+needs chunk t-1's acceptance counts to assemble chunk t. Paged-layout
+spec is synchronous: page allocation depends on data-dependent position
+advance the host only learns at readback.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.http.errors import RequestTimeout
+from gofr_tpu.tpu.lockstep import TAG_DECODE, TAG_SPEC
+
+
+def _fold_spec(eng, toks, accs, meta, k) -> None:
+    """Replay one spec round's device acceptance into slot state. Caller
+    holds the state lock. ``toks`` [k, n, g+1], ``accs`` [k, n]."""
+    now = time.monotonic()
+    emitted = accepted = 0
+    for i, s in meta:
+        if eng.slots[i] is not s:
+            continue  # freed/preempted/reassigned while in flight
+        s.inflight = max(0, s.inflight - 1)
+        if s.request.cancelled or s.request.expired(now):
+            eng._free_slot(i)
+            s.request.complete(error=RequestTimeout())
+            continue
+        for kk in range(k):
+            a = int(accs[kk, i])
+            accepted += a
+            for j in range(a + 1):
+                tok = int(toks[kk, i, j])
+                s.pos += 1
+                s.last_token = tok
+                s.generated.append(tok)
+                emitted += 1
+                eng._emit(s, tok)
+                eng._maybe_finish(i)
+                if eng.slots[i] is not s:  # EOS/budget: rest discarded
+                    break
+            if eng.slots[i] is not s:
+                break
+    eng.metrics.increment_counter("app_tpu_tokens_total", emitted)
+    eng.metrics.increment_counter(
+        "app_tpu_spec_proposed", k * eng.spec_tokens * len(meta))
+    eng.metrics.increment_counter("app_tpu_spec_accepted", accepted)
+
+
+def spec_round(eng) -> bool:
+    """One synchronous PAGED-layout speculative round: ``decode_chunk``
+    outer steps, each drafting ``spec_tokens`` continuation tokens by
+    prompt lookup and verifying them with ONE target forward
+    (family.verify_step_paged). Greedy acceptance makes the emitted
+    stream bit-identical to plain greedy decode; each round trip yields
+    up to decode_chunk*(spec_tokens+1) tokens per slot. Synchronous
+    because the next round's page allocation depends on this round's
+    acceptance counts. (The slot layout pipelines instead —
+    dispatch_spec.)"""
+    with eng._state_lock:
+        lanes = [(i, eng.slots[i]) for i in eng._active()
+                 if eng.slots[i].pos < eng.slots[i].max_total]
+        if not lanes:
+            return False
+        n = eng.num_slots
+        k = eng.decode_chunk
+        # every round writes up to chunk_span positions past pos —
+        # allocate pages for the worst case NOW (the device cannot
+        # allocate mid-chunk)
+        for i, s in list(lanes):
+            eng._alloc_lane_pages(i, s, s.pos + eng._chunk_span - 1)
+        lanes = [(i, s) for i, s in lanes if eng.slots[i] is s]
+        if not lanes:
+            return True  # preemption work happened
+        W = eng.pages_per_slot
+        H = W * eng.page_size
+        packed = np.zeros((2 + W + H, n), np.int32)
+        packed[1, :] = H + 1  # inactive lanes: every write lands OOB
+        packed[2:2 + W] = eng._masked_table({i for i, _ in lanes}).T
+        for i, s in lanes:
+            hist = np.concatenate([
+                np.asarray(s.prompt_tokens, np.int32),
+                np.asarray(s.generated, np.int32),
+            ])
+            packed[0, i] = s.last_token
+            packed[1, i] = hist.shape[0]  # == s.pos + 1
+            packed[2 + W:2 + W + hist.shape[0], i] = hist
+        occupancy = len(lanes) / n
+        eng._inflight = [s.request for _, s in lanes]
+        t0 = time.monotonic()
+
+    eng._announce(TAG_SPEC, packed.shape[0], 0, packed)
+    toks_dev, accs_dev, eng.cache = eng._spec_chunk_fn(
+        eng.params, eng.cache, k, jnp.asarray(packed))
+    toks = np.asarray(toks_dev)  # [k, n, g+1] int32 — tokens, never logits
+    accs = np.asarray(accs_dev)  # [k, n]
+
+    with eng._state_lock:
+        eng._inflight = []
+        if eng._poisoned or eng._stop.is_set():
+            return True
+        eng._record_step("decode_spec", time.monotonic() - t0, occupancy,
+                          ("decode_spec", n, k, eng.spec_tokens))
+        _fold_spec(eng, toks, accs, lanes, k)
+        return True
+
+
+def dispatch_spec(eng) -> bool:
+    """Assemble and asynchronously dispatch one SLOT-layout speculative
+    round. The host ships only [3, n]: per-lane (token, hlen, use_host).
+    A lane with a round already in flight is driven by the device-
+    resident spec carry (use_host=0); its worst-case advance is
+    chunk_span per in-flight round, so lanes whose worst-case position
+    reaches max_total are masked until their in-flight rounds process —
+    which bounds any round's writes to max_total + chunk_span, the same
+    single-chunk_span cache slack plain decode uses (engine ctor
+    comment). Token history lives in the cache pytree
+    (kv, hist); prefill seeded it, the spec program maintains it."""
+    with eng._state_lock:
+        n = eng.num_slots
+        k = eng.decode_chunk
+        span = eng._chunk_span
+        lanes = []
+        for i in eng._active():
+            s = eng.slots[i]
+            if s.pos + span * s.inflight >= s.max_total:
+                continue  # masked until in-flight rounds process
+            lanes.append((i, s))
+        if not lanes:
+            return False
+        packed = np.zeros((3, n), np.int32)
+        packed[1, :] = eng._cache_len + 1  # inactive: every write lands OOB
+        packed[2, :] = 1                   # inactive lanes are host-arbitrated
+        for i, s in lanes:
+            if s.inflight == 0:
+                # host knows this lane's exact (token, hlen) — it just
+                # (re)joined from prefill or a fully-processed round
+                packed[0, i] = s.last_token
+                packed[1, i] = s.pos + 1
+            else:
+                packed[2, i] = 0  # device carry owns (token, hlen)
+        for _, s in lanes:
+            s.inflight += 1
+        occupancy = len(lanes) / n
+        t0 = time.monotonic()
+
+    eng._announce(TAG_SPEC, 1, 0, packed)  # slot spec: a=1 → [3, n] payload
+    carry = eng._spec_carry
+    if carry is None:
+        carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
+    toks_dev, accs_dev, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
+        eng.params, eng.cache, k, jnp.asarray(packed), carry)
+    eng._dq.append(("spec", (toks_dev, accs_dev), [(i, s) for i, s in lanes],
+                    t0, occupancy, (n, k)))
+    return True
+
+
+def dispatch_decode(eng) -> bool:
+    """Assemble and asynchronously dispatch one decode chunk. Positions
+    are SPECULATIVE: a lane with a chunk already in flight decodes from
+    ``pos + k*inflight`` and takes its input token from the on-device
+    ``prev_last`` carry rather than the host (which hasn't read that
+    chunk back yet). Lanes guaranteed dead once their in-flight chunk is
+    processed (speculative pos >= max_total) are masked out, so writes
+    never exceed the existing decode_chunk cache slack. Returns True when
+    a chunk was dispatched."""
+    with eng._state_lock:
+        n = eng.num_slots
+        k = eng.decode_chunk
+
+        # (slot index, slot, speculative position) for lanes that decode
+        lanes = []
+        for i in eng._active():
+            s = eng.slots[i]
+            p = s.pos + k * s.inflight
+            if p >= s.max_total:
+                continue  # will be freed when its in-flight chunk processes
+            lanes.append((i, s, p))
+        if not lanes:
+            return False
+
+        if eng.kv_layout == "paged":
+            # every decoding lane must own pages covering this chunk's
+            # writes (p .. p+k-1) BEFORE the table snapshot
+            for i, s, p in list(lanes):
+                eng._alloc_lane_pages(i, s, p + k - 1)
+            lanes = [(i, s, p) for i, s, p in lanes if eng.slots[i] is s]
+            if not lanes:
+                return False
+
+        # always the FULL chunk — one compiled decode program for the whole
+        # serving lifetime. A slot that hits its budget/EOS mid-chunk simply
+        # has its surplus tokens discarded (the cache carries decode_chunk
+        # slack past max_len, so overshoot writes stay in bounds; paged
+        # slots' tables carry the same slack via pages_per_slot). All host
+        # inputs ride ONE packed array (layout at the jit definitions).
+        wt = eng.pages_per_slot if eng.kv_layout == "paged" else 0
+        packed = np.zeros((5 + wt, n), np.int32)
+        temps = np.zeros((n,), np.float32)
+        if eng.kv_layout != "paged":
+            # non-decoding rows (empty, chunk-prefilling, or dead-lane-
+            # masked) write at an out-of-bounds position so the masked-
+            # select append drops them — a position-0 write would corrupt
+            # a prefilling slot's first token (paged masks via OOB table
+            # rows instead)
+            packed[1, :] = eng._cache_len
+        for i, s, p in lanes:
+            if s.inflight == 0:
+                # host knows this lane's exact last token (from prefill or
+                # its last processed chunk); otherwise the device carry
+                # from the in-flight chunk supplies it (use_host stays 0)
+                packed[0, i] = s.last_token
+                packed[4, i] = 1
+            packed[1, i] = p
+            temps[i] = float(s.request.kw.get("temperature", 0.0))
+        packed[2] = temps.view(np.int32)
+        eng._step_count += 1
+        packed[3, 0] = eng._step_count
+        if eng.kv_layout == "paged":
+            packed[5:] = eng._masked_table({i for i, _, _ in lanes}).T
+
+        for _, s, _ in lanes:
+            s.inflight += 1
+        occupancy = len(lanes) / n
+        t0 = time.monotonic()
+
+    eng._announce(TAG_DECODE, 1, 0, packed)  # a=1: live, carry applies
+    prev = eng._prev_last
+    if prev is None:
+        prev = jnp.zeros((n,), jnp.int32)
+    chunk_dev, last_dev, eng.cache = eng._decode_chunk(
+        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), prev
+    )
+    eng._prev_last = last_dev
+    eng._dq.append(("plain", chunk_dev, [(i, s) for i, s, _ in lanes],
+                    t0, occupancy, (n, k)))
+    return True
+
+
+def process_decode(eng) -> bool:
+    """Block on the OLDEST dispatched chunk's tokens (overlapping any
+    younger chunk's compute) and fold them into slot state. Lanes whose
+    slot object changed since dispatch (freed, preempted, reassigned)
+    have their results discarded — the identity check is what makes
+    speculative dispatch safe. Handles both plain and spec entries on
+    ``eng._dq``."""
+    if not eng._dq:
+        return False
+    kind, dev, meta, t0, occupancy, (n, k) = eng._dq.popleft()
+    if kind == "spec":
+        toks = np.asarray(dev[0])  # [k, n, g+1] int32 — tokens, never logits
+        accs = np.asarray(dev[1])  # [k, n]
+    else:
+        chunk = np.asarray(dev)  # [slots, k] int32 — tokens, never logits
+    if eng._poisoned:
+        # stop() declared this thread wedged and already failed/cleared
+        # everything; the slot/page state now belongs to the caller.
+        return False
+    with eng._state_lock:
+        if kind == "spec":
+            eng._record_step("decode_spec", time.monotonic() - t0, occupancy,
+                              ("decode_spec", n, k, eng.spec_tokens))
+            _fold_spec(eng, toks, accs, meta, k)
+            return True
+        eng._record_step("decode", time.monotonic() - t0, occupancy, ("decode", n, k))
+
+        now = time.monotonic()
+        accepted = 0
+        for i, s in meta:
+            if eng.slots[i] is not s:
+                continue  # freed/preempted/reassigned while in flight
+            s.inflight -= 1
+            if s.request.cancelled or s.request.expired(now):
+                # slot invalidation: free the lane; in-flight work is discarded
+                eng._free_slot(i)
+                s.request.complete(error=RequestTimeout())
+                continue
+            for j in range(k):
+                tok = int(chunk[i, j])
+                s.pos += 1
+                s.last_token = tok
+                s.generated.append(tok)
+                accepted += 1
+                eng._emit(s, tok)
+                eng._maybe_finish(i)
+                if eng.slots[i] is not s:  # EOS/length mid-chunk: rest discarded
+                    break
+        eng.metrics.increment_counter("app_tpu_tokens_total", accepted)
+        return True

@@ -42,6 +42,13 @@ from gofr_tpu.native import plan_prefill
 from gofr_tpu.models.base import ModelSpec, get_family
 from gofr_tpu.ops.sampling import sample_token
 from gofr_tpu.parallel import shard_pytree
+from gofr_tpu.tpu.decode import (
+    dispatch_decode,
+    dispatch_spec,
+    process_decode,
+    spec_round,
+)
+from gofr_tpu.tpu.programs import build_programs
 
 
 def next_bucket(n: int, buckets: list[int]) -> int:
@@ -547,6 +554,7 @@ class GenerateEngine(_EngineBase):
         prefill_attn_fn: Any = None,
         prefill_attn_divisor: int = 1,
         lockstep_role: str | None = None,
+        spec_draft: tuple | None = None,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -598,11 +606,60 @@ class GenerateEngine(_EngineBase):
                     f"family {getattr(family, '__name__', family)!r} has no {need}; "
                     "speculative decoding needs it"
                 )
+        # Draft-model speculative decoding (VERDICT r4 #4): spec_draft is a
+        # (family, cfg, params) triple for a small model sharing the target's
+        # tokenizer/vocab. Drafts come from g autoregressive draft-model
+        # steps on device instead of prompt lookup (tpu/programs.py); the
+        # bit-exact greedy verify is unchanged, so the draft only moves the
+        # acceptance rate — real text accepts far more than lookup can.
+        if spec_draft is not None:
+            if not self.spec_tokens:
+                raise ValueError("spec_draft requires spec_tokens > 0")
+            if kv_layout != "slot":
+                raise ValueError(
+                    "spec_draft (draft-model speculative decoding) is "
+                    "slot-layout only (v1): the paged layout's page allocation "
+                    "would need the draft cache paged too — use "
+                    "kv_layout='slot' or drop spec_draft"
+                )
+            dfam = spec_draft[0]
+            missing = [a for a in ("prefill", "decode_step", "make_cache")
+                       if not hasattr(dfam, a)]
+            if missing:
+                raise ValueError(
+                    f"spec_draft family {getattr(dfam, '__name__', dfam)!r} "
+                    f"lacks {missing}; the draft must follow the slot-cache "
+                    "decoder protocol"
+                )
+            if (getattr(family, "SLOT_CHUNKED_PREFILL", False)
+                    and not getattr(dfam, "SLOT_CHUNKED_PREFILL", False)):
+                raise ValueError(
+                    "spec_draft family has no chunked (offset) prefill, but the "
+                    "target serves long prompts through it — use a draft "
+                    "family with SLOT_CHUNKED_PREFILL"
+                )
+        self._draft = None  # (family, cfg) once validated (slot branch below)
+        # Pipelined decode (depth 2 = one chunk in flight): chunk t+1 is
+        # dispatched BEFORE chunk t's tokens are read back, so the ~RTT of
+        # device→host readback + host bookkeeping overlaps the next chunk's
+        # compute. The data dependency (t+1's input token = t's last output)
+        # stays ON DEVICE via the `prev_last` carry — or, for speculative
+        # rounds on the slot layout, the (token, hlen) spec carry plus the
+        # device-resident history (tpu/programs.py). Depth 1 is the fully
+        # synchronous path. Over the round-3 tunnel (~100ms/sync) this is
+        # the difference between RTT-bound and compute-bound decode.
+        self.decode_pipeline = max(1, min(2, int(decode_pipeline)))
         # cache slack one chunk can write past max_len: each spec round
-        # writes up to spec_tokens+1 positions plus spec_tokens draft slots
+        # writes up to spec_tokens+1 positions plus spec_tokens draft slots.
         chunk_span = (self.decode_chunk * (self.spec_tokens + 1) + self.spec_tokens
                       if self.spec_tokens else self.decode_chunk)
         self._chunk_span = chunk_span
+        # One chunk_span of slack suffices at ANY pipeline depth: dispatch
+        # masks a lane once its worst-case in-flight position
+        # (pos + chunk_span*inflight) reaches max_total, so at dispatch
+        # time the device-side hlen is < max_total and the new round's
+        # writes stay < max_total + chunk_span — the same dead-lane bound
+        # plain pipelined decode relies on (decode.dispatch_spec).
         requested_max_len = self.max_len
         self.max_len = min(self.max_len, cfg.max_seq_len - chunk_span)
         if self.max_len < requested_max_len:
@@ -659,7 +716,8 @@ class GenerateEngine(_EngineBase):
         else:
             # cache headroom so a chunk never writes past Smax; round to a
             # kernel-friendly multiple of 128 when the model allows it
-            cache_len = min(-(-(self.max_len + self._chunk_span) // 128) * 128, cfg.max_seq_len)
+            cache_len = min(-(-(self.max_len + self._chunk_span) // 128) * 128,
+                            cfg.max_seq_len)
             self._cache_len = cache_len
             # int8 KV (kvcache.QSlotKVCache): halves the cache bytes decode
             # attention streams per step — the long-context bandwidth lever
@@ -669,8 +727,20 @@ class GenerateEngine(_EngineBase):
                     f"family {getattr(family, '__name__', family)!r} has no int8 KV support"
                 )
             self.kv_quantize = kv_quantize
-            self.cache = (family.make_cache_q(cfg, slots, cache_len) if kv_quantize
-                          else family.make_cache(cfg, slots, cache_len))
+            if spec_draft is not None:
+                dfam, dcfg, dparams = spec_draft
+                if getattr(dcfg, "max_seq_len", cache_len) < cache_len:
+                    raise ValueError(
+                        f"spec_draft max_seq_len {dcfg.max_seq_len} < engine "
+                        f"cache length {cache_len}: the draft cache must cover "
+                        "every position the target serves"
+                    )
+                self._draft = (dfam, dcfg)
+                # every compiled program sees one params pytree; with a
+                # draft it is {'t': target, 'd': draft} (tpu/programs.py)
+                params = {"t": params, "d": dparams}
+                self.params = params
+            self.cache = self._build_slot_cache()
             self._prefix = None  # prefix caching needs the paged layout
         # multi-host lockstep (tpu/lockstep.py): the leader announces every
         # device call so follower processes issue the same global programs
@@ -684,6 +754,10 @@ class GenerateEngine(_EngineBase):
             from gofr_tpu.tpu.lockstep import LockstepLeader
 
             self._ls = LockstepLeader()
+        # follower liveness deadline (lockstep.py): leader heartbeats at a
+        # third of it so watchdogs only fire on true leader death
+        deadline = container.config.get_float("LOCKSTEP_DEADLINE_S", 0.0)
+        self._hb_interval = deadline / 3 if deadline > 0 else 0.0
         if lockstep_role:
             # the cache is created process-locally; a multi-host global
             # program needs it placed as a GLOBAL (replicated) array
@@ -703,233 +777,30 @@ class GenerateEngine(_EngineBase):
         self._admit_seq = 0  # admission order (preemption picks newest)
         self._base_key = jax.random.key(seed)
         self._step_count = 0
-        # Pipelined decode (depth 2 = one chunk in flight): chunk t+1 is
-        # dispatched BEFORE chunk t's tokens are read back, so the ~RTT of
-        # device→host readback + host bookkeeping overlaps the next chunk's
-        # compute. The data dependency (t+1's input token = t's last output)
-        # stays ON DEVICE via the `prev_last` carry; the host only overrides
-        # it (use_host flag) for lanes it has exact state for. Depth 1 is the
-        # fully synchronous path. Over the round-3 tunnel (~100ms/sync) this
-        # is the difference between RTT-bound and compute-bound decode.
-        self.decode_pipeline = max(1, min(2, int(decode_pipeline)))
         self._dq: collections.deque = collections.deque()  # dispatched, unprocessed
         self._prev_last = None  # device-resident [slots] last-sampled-token carry
+        self._spec_carry = None  # device-resident ([slots] token, [slots] hlen)
 
-        ts = (top_k, top_p)
-        W = self.pages_per_slot if kv_layout == "paged" else 1
-        # whole-prompt prefill attention override (e.g. ring/Ulysses
-        # sequence-parallel attention on an sp mesh — build_engine wires it);
-        # chunked prefill keeps the gathered-view attention either way
-        pf = {"attn_fn": prefill_attn_fn} if prefill_attn_fn is not None else {}
-
-        # Every step ships its host inputs as ONE packed int32 array (floats
-        # bitcast, RNG step folded in on device from the resident base key).
-        # Over a tunneled device each separate H2D transfer and out-of-jit
-        # RNG op costs a round trip (~70ms measured on the round-3 tunnel);
-        # packing turns 4-6 of them into one.
-        #
-        # Prefill packed layout [nb, lb + W + 3] (W = 1 slot-id column for
-        # the slot layout, pages_per_slot block-table columns for paged):
-        #   [:, :lb] tokens | [:, lb] lengths | [:, lb+1:lb+1+W] rows
-        #   | [:, lb+1+W] temps (f32 bitcast) | [0, lb+2+W] rng step
-        # Chunked-prefill adds an offsets column before temps.
-        # Decode packed layout [5 + W_t, n] (W_t = pages_per_slot table rows
-        # for paged, 0 for slot):
-        #   [0] tokens | [1] positions | [2] temps | [3 0] rng step
-        #   | [4] use_host flags | [5:] table.T
-        # Row 4 arbitrates the input token per lane: 1 = take the host's
-        # packed token (lane just (re)joined decode — prefill sampled its
-        # first token, or its previous chunk was already processed); 0 = take
-        # the on-device `prev_last` carry from the previous dispatched chunk
-        # (lane has a chunk in flight the host hasn't read back yet).
-
-        def _unpack_prefill(packed, w, chunked=False):
-            extra = 1 if chunked else 0
-            lb = packed.shape[1] - (w + 3 + extra)
-            tokens = packed[:, :lb]
-            lengths = packed[:, lb]
-            rows = packed[:, lb + 1:lb + 1 + w]
-            offsets = packed[:, lb + 1 + w] if chunked else None
-            temps = jax.lax.bitcast_convert_type(
-                packed[:, lb + 1 + w + extra], jnp.float32)
-            step = packed[0, lb + 2 + w + extra]
-            return tokens, lengths, rows, offsets, temps, step
-
-        if kv_layout == "paged":
-            @partial(jax.jit, donate_argnums=(2,))
-            def _prefill_sample(params, base_key, cache, packed):
-                tokens, lengths, rows, _, temps, step = _unpack_prefill(packed, W)
-                key = jax.random.fold_in(base_key, step)
-                logits, cache = family.prefill_paged(cfg, params, tokens, lengths, cache, rows, **pf)
-                toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
-                return toks, cache
-
-            @partial(jax.jit, donate_argnums=(2,))
-            def _chunk_prefill(params, base_key, cache, packed):
-                tokens, lengths, rows, offsets, temps, step = _unpack_prefill(
-                    packed, W, chunked=True)
-                key = jax.random.fold_in(base_key, step)
-                logits, cache = family.prefill_paged(
-                    cfg, params, tokens, lengths, cache, rows, offsets
-                )
-                toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
-                return toks, cache
-
-            self._chunk_prefill = _chunk_prefill
-
-            @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
-            def _decode_chunk(params, base_key, cache, steps, packed, prev_last):
-                tokens = jnp.where(packed[4] != 0, packed[0], prev_last)
-                positions = packed[1]
-                temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
-                key = jax.random.fold_in(base_key, packed[3, 0])
-                table = packed[5:].T
-
-                def body(carry, _):
-                    toks, pos, cache, key = carry
-                    logits, cache = family.decode_step_paged(cfg, params, toks, pos, cache, table)
-                    key, sub = jax.random.split(key)
-                    nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
-                    return (nxt, pos + 1, cache, key), nxt
-
-                (toks, pos, cache, key), out = jax.lax.scan(
-                    body, (tokens, positions, cache, key), None, length=steps
-                )
-                return out.T, toks, cache  # [slots, K], [slots] carry
-
-            if self.spec_tokens:
-                g = self.spec_tokens
-                Wp = self.pages_per_slot
-                Hcap = Wp * page_size  # logical per-slot capacity
-
-                # Paged spec packed layout [2 + Wp + Hcap, n]:
-                #   [0] input token | [1] history length | [2:2+Wp] table.T
-                #   | [2+Wp:] history.T. Inactive lanes ship hlen = Hcap+1
-                #   AND an all-OOB table row, so every write drops.
-                @partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
-                def _spec_chunk(params, cache, steps, packed):
-                    n_l = packed.shape[1]
-                    tok0 = packed[0]
-                    hlen0 = packed[1]
-                    table = packed[2:2 + Wp].T      # [n, Wp]
-                    hist0 = packed[2 + Wp:].T       # [n, Hcap]
-                    idx = jnp.arange(Hcap)
-
-                    def outer(carry, _):
-                        tok, hlen, hist, cache = carry
-                        pos = hlen - 1
-                        match = (hist == tok[:, None]) & (idx[None, :] < pos[:, None])
-                        j = jnp.where(match, idx[None, :], -1).max(axis=1)
-                        take = jnp.clip(j[:, None] + 1 + jnp.arange(g)[None, :], 0, Hcap - 1)
-                        drafts = jnp.take_along_axis(hist, take, axis=1)
-                        seq = jnp.concatenate([tok[:, None], drafts], axis=1)
-                        logits, cache = family.verify_step_paged(
-                            cfg, params, seq, pos, cache, table)
-                        tgt = jnp.argmax(logits, -1).astype(jnp.int32)
-                        ok = jnp.cumprod((drafts == tgt[:, :g]).astype(jnp.int32), axis=1)
-                        acc = ok.sum(axis=1)
-                        nxt = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
-                        emit = jnp.arange(g + 1)[None, :] <= acc[:, None]
-                        wpos = jnp.where(emit, hlen[:, None] + jnp.arange(g + 1)[None, :], Hcap)
-                        hist = hist.at[jnp.arange(n_l)[:, None], wpos].set(tgt, mode="drop")
-                        return (nxt, hlen + acc + 1, hist, cache), (tgt, acc)
-
-                    (_, _, _, cache), (toks, accs) = jax.lax.scan(
-                        outer, (tok0, hlen0, hist0, cache), None, length=steps
-                    )
-                    return toks, accs, cache
-
-                self._spec_chunk_fn = _spec_chunk
-        else:
-            @partial(jax.jit, donate_argnums=(2,))
-            def _prefill_sample(params, base_key, cache, packed):
-                tokens, lengths, rows, _, temps, step = _unpack_prefill(packed, W)
-                key = jax.random.fold_in(base_key, step)
-                logits, cache = family.prefill(cfg, params, tokens, lengths, cache, rows[:, 0], **pf)
-                toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
-                return toks, cache
-
-            if getattr(family, "SLOT_CHUNKED_PREFILL", False):
-                @partial(jax.jit, donate_argnums=(2,))
-                def _chunk_prefill(params, base_key, cache, packed):
-                    tokens, lengths, rows, offsets, temps, step = _unpack_prefill(
-                        packed, W, chunked=True)
-                    key = jax.random.fold_in(base_key, step)
-                    logits, cache = family.prefill(
-                        cfg, params, tokens, lengths, cache, rows[:, 0], offsets
-                    )
-                    toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
-                    return toks, cache
-
-                self._chunk_prefill = _chunk_prefill
-
-            @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
-            def _decode_chunk(params, base_key, cache, steps, packed, prev_last):
-                tokens = jnp.where(packed[4] != 0, packed[0], prev_last)
-                positions = packed[1]
-                temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
-                key = jax.random.fold_in(base_key, packed[3, 0])
-
-                def body(carry, _):
-                    toks, pos, cache, key = carry
-                    logits, cache = family.decode_step(cfg, params, toks, pos, cache)
-                    key, sub = jax.random.split(key)
-                    nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
-                    return (nxt, pos + 1, cache, key), nxt
-
-                (toks, pos, cache, key), out = jax.lax.scan(
-                    body, (tokens, positions, cache, key), None, length=steps
-                )
-                return out.T, toks, cache  # [slots, K], [slots] carry
-
-            if self.spec_tokens:
-                g = self.spec_tokens
-                H = cache_len
-
-                # Spec packed layout [2 + H, n]:
-                #   [0] input token | [1] history length (hlen; the input
-                #   token is hist[hlen-1], its KV goes to position hlen-1)
-                #   | [2:] token history hist.T (prompt + generated so far).
-                # Inactive lanes ship hlen = H + 1: every cache/history
-                # write lands out of bounds and is dropped.
-                @partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
-                def _spec_chunk(params, cache, steps, packed):
-                    n_l = packed.shape[1]
-                    tok0 = packed[0]
-                    hlen0 = packed[1]
-                    hist0 = packed[2:].T  # [n, H]
-                    idx = jnp.arange(H)
-
-                    def outer(carry, _):
-                        tok, hlen, hist, cache = carry
-                        pos = hlen - 1
-                        # prompt-lookup draft: continuation after the most
-                        # recent EARLIER occurrence of the current token
-                        match = (hist == tok[:, None]) & (idx[None, :] < pos[:, None])
-                        j = jnp.where(match, idx[None, :], -1).max(axis=1)  # -1 = miss
-                        take = jnp.clip(j[:, None] + 1 + jnp.arange(g)[None, :], 0, H - 1)
-                        drafts = jnp.take_along_axis(hist, take, axis=1)  # [n, g]
-                        seq = jnp.concatenate([tok[:, None], drafts], axis=1)
-                        logits, cache = family.verify_step(cfg, params, seq, pos, cache)
-                        tgt = jnp.argmax(logits, -1).astype(jnp.int32)  # [n, g+1]
-                        ok = jnp.cumprod((drafts == tgt[:, :g]).astype(jnp.int32), axis=1)
-                        acc = ok.sum(axis=1)  # accepted drafts per lane, 0..g
-                        nxt = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
-                        emit = jnp.arange(g + 1)[None, :] <= acc[:, None]
-                        wpos = jnp.where(emit, hlen[:, None] + jnp.arange(g + 1)[None, :], H)
-                        hist = hist.at[jnp.arange(n_l)[:, None], wpos].set(
-                            tgt, mode="drop")
-                        return (nxt, hlen + acc + 1, hist, cache), (tgt, acc)
-
-                    (_, _, _, cache), (toks, accs) = jax.lax.scan(
-                        outer, (tok0, hlen0, hist0, cache), None, length=steps
-                    )
-                    return toks, accs, cache  # [K, n, g+1], [K, n]
-
-                self._spec_chunk_fn = _spec_chunk
-
-        self._prefill_sample = _prefill_sample
-        self._decode_chunk = _decode_chunk
+        # Compiled packed-program handles (tpu/programs.py documents the
+        # packed layouts; lockstep followers call the same handles).
+        progs = build_programs(
+            family, cfg,
+            kv_layout=kv_layout,
+            spec_tokens=self.spec_tokens,
+            top_k=top_k,
+            top_p=top_p,
+            pages_per_slot=getattr(self, "pages_per_slot", 0),
+            page_size=page_size,
+            cache_len=getattr(self, "_cache_len", 0),
+            prefill_attn_fn=prefill_attn_fn,
+            draft=self._draft,
+        )
+        self._prefill_sample = progs.prefill_sample
+        if progs.chunk_prefill is not None:
+            self._chunk_prefill = progs.chunk_prefill
+        self._decode_chunk = progs.decode_chunk
+        if progs.spec_chunk is not None:
+            self._spec_chunk_fn = progs.spec_chunk
 
     # -- public API ------------------------------------------------------------
 
@@ -1006,15 +877,25 @@ class GenerateEngine(_EngineBase):
         if self.spec_tokens:
             if self.kv_layout == "paged":
                 sw, sh = self.pages_per_slot, self.pages_per_slot * self.page_size
-            else:
-                sw, sh = 0, self._cache_len
-            spec_packed = np.zeros((2 + sw + sh, n), np.int32)
-            spec_packed[1, :] = sh + 1  # all lanes OOB
-            if sw:
+                spec_packed = np.zeros((2 + sw + sh, n), np.int32)
+                spec_packed[1, :] = sh + 1  # all lanes OOB
                 spec_packed[2:2 + sw] = self.total_pages  # all-OOB tables
-            self._announce(TAG_SPEC, 2 + sw + sh, 0, spec_packed)
-            toks, _, self.cache = self._spec_chunk_fn(
-                self.params, self.cache, k, jnp.asarray(spec_packed))
+                self._announce(TAG_SPEC, 2 + sw + sh, 0, spec_packed)
+                toks, _, self.cache = self._spec_chunk_fn(
+                    self.params, self.cache, k, jnp.asarray(spec_packed))
+            else:
+                # slot layout: all lanes host-arbitrated and OOB, so no
+                # cache/history write survives; the carry is stored (same on
+                # followers) but any lane later rejoining ships use_host=1
+                spec_packed = np.zeros((3, n), np.int32)
+                spec_packed[1, :] = self._cache_len + 1
+                spec_packed[2, :] = 1
+                self._announce(TAG_SPEC, 1, 0, spec_packed)
+                carry = self._spec_carry
+                if carry is None:
+                    carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
+                toks, _, self.cache, self._spec_carry = self._spec_chunk_fn(
+                    self.params, self.cache, k, jnp.asarray(spec_packed), carry)
             jax.block_until_ready(toks)
             self._compiled.add(("decode_spec", n, k, self.spec_tokens))
             count += 1
@@ -1095,12 +976,15 @@ class GenerateEngine(_EngineBase):
     def serve_follower(self) -> None:
         """Run this process as a lockstep FOLLOWER (multi-host serving,
         tpu/lockstep.py): blocks executing the leader's announced programs
-        until the leader stops. Do not call start()."""
+        until the leader stops. Do not call start(). With
+        LOCKSTEP_DEADLINE_S set, a liveness watchdog hard-exits this
+        process if the leader goes silent (kill -9/OOM — lockstep.py)."""
         if self.lockstep_role != "follower":
             raise RuntimeError("engine was not built with lockstep_role='follower'")
         from gofr_tpu.tpu.lockstep import LockstepFollower
 
-        LockstepFollower(self).run()
+        deadline = self.container.config.get_float("LOCKSTEP_DEADLINE_S", 0.0)
+        LockstepFollower(self, deadline_s=deadline).run()
 
     # -- device loop -----------------------------------------------------------
 
@@ -1149,13 +1033,26 @@ class GenerateEngine(_EngineBase):
                     self._prefix.clear()
                     self.metrics.set_gauge("app_tpu_prefix_cached_pages", 0)
             else:
-                self.cache = (
-                    self.family.make_cache_q(self.cfg, self.num_slots, self._cache_len)
-                    if self.kv_quantize
-                    else self.family.make_cache(self.cfg, self.num_slots, self._cache_len)
-                )
+                self.cache = self._build_slot_cache()
+            self._spec_carry = None  # rode the same suspect device state
 
     # -- slot/page bookkeeping -------------------------------------------------
+
+    def _build_slot_cache(self):
+        """One construction site for ctor AND crash-restart rebuild. With
+        speculative decoding on, the cache is a 2-tuple pytree: (kv, hist)
+        for prompt-lookup — the device-resident token history the
+        prefill/spec programs maintain (tpu/programs.py), so the host never
+        ships history — or (kv, draft_kv) with a draft model."""
+        kv = (self.family.make_cache_q(self.cfg, self.num_slots, self._cache_len)
+              if self.kv_quantize
+              else self.family.make_cache(self.cfg, self.num_slots, self._cache_len))
+        if self._draft is not None:
+            dfam, dcfg = self._draft
+            return (kv, dfam.make_cache(dcfg, self.num_slots, self._cache_len))
+        if self.spec_tokens:
+            return (kv, jnp.zeros((self.num_slots, self._cache_len), jnp.int32))
+        return kv
 
     def _build_paged_cache(self):
         """One construction site for ctor AND crash-restart rebuild: the
@@ -1347,6 +1244,7 @@ class GenerateEngine(_EngineBase):
     def _loop(self) -> None:
         self._dq.clear()  # a restarted loop must not read a dead life's futures
         self._prev_last = None
+        self._spec_carry = None
         while not self._stop.is_set() and not self._poisoned:
             admitted = self._admit()
             # one chunk of ONE long prompt per iteration, so decode of the
@@ -1354,15 +1252,24 @@ class GenerateEngine(_EngineBase):
             chunked = self._advance_chunked()
             # pipelined decode: dispatch chunk t, then block on chunk t-1 —
             # its readback + host bookkeeping overlap chunk t's compute.
-            # Speculative rounds are synchronous instead: positions depend
-            # on data (acceptance counts), so no chunk can be dispatched
-            # before the previous one is read back.
-            dispatched = (self._decode_round_spec() if self.spec_tokens
-                          else self._dispatch_decode())
+            # Slot-layout spec rounds pipeline the same way (the data-
+            # dependent positions live in the device-resident spec carry);
+            # paged spec is synchronous — no chunk can be dispatched before
+            # the previous one is read back.
+            if not self.spec_tokens:
+                dispatched = dispatch_decode(self)
+            elif self.kv_layout == "slot":
+                dispatched = dispatch_spec(self)
+            else:
+                dispatched = spec_round(self)
             processed = False
             while len(self._dq) > (self.decode_pipeline - 1 if dispatched else 0):
-                processed = self._process_decode() or processed
+                processed = process_decode(self) or processed
             if not admitted and not chunked and not dispatched and not processed:
+                if self._ls is not None and self._hb_interval:
+                    # idle leader: heartbeat so follower watchdogs see
+                    # liveness between announcements (LOCKSTEP_DEADLINE_S)
+                    self._ls.maybe_heartbeat(self._hb_interval)
                 # idle: block briefly for work
                 try:
                     req = self._queue.get(timeout=0.2)
@@ -1663,216 +1570,6 @@ class GenerateEngine(_EngineBase):
                 self._maybe_finish(free[i])
             return True
 
-    # -- decode ----------------------------------------------------------------
-
-    def _decode_round_spec(self) -> bool:
-        """One synchronous speculative round: ``decode_chunk`` outer steps,
-        each drafting ``spec_tokens`` continuation tokens by prompt lookup
-        and verifying them with ONE target forward (family.verify_step).
-        Greedy acceptance makes the emitted stream bit-identical to plain
-        greedy decode; each round trip yields up to
-        decode_chunk*(spec_tokens+1) tokens per slot."""
-        with self._state_lock:
-            lanes = [(i, self.slots[i]) for i in self._active()
-                     if self.slots[i].pos < self.slots[i].max_total]
-            if not lanes:
-                return False
-            n = self.num_slots
-            k = self.decode_chunk
-            paged = self.kv_layout == "paged"
-            if paged:
-                # every round writes up to chunk_span positions past pos —
-                # allocate pages for the worst case NOW (the device cannot
-                # allocate mid-chunk)
-                for i, s in list(lanes):
-                    self._alloc_lane_pages(i, s, s.pos + self._chunk_span - 1)
-                lanes = [(i, s) for i, s in lanes if self.slots[i] is s]
-                if not lanes:
-                    return True  # preemption work happened
-                W = self.pages_per_slot
-                H = W * self.page_size
-            else:
-                W = 0
-                H = self._cache_len
-            packed = np.zeros((2 + W + H, n), np.int32)
-            packed[1, :] = H + 1  # inactive lanes: every write lands OOB
-            if paged:
-                packed[2:2 + W] = self._masked_table({i for i, _ in lanes}).T
-            for i, s in lanes:
-                hist = np.concatenate([
-                    np.asarray(s.prompt_tokens, np.int32),
-                    np.asarray(s.generated, np.int32),
-                ])
-                packed[0, i] = s.last_token
-                packed[1, i] = hist.shape[0]  # == s.pos + 1
-                packed[2 + W:2 + W + hist.shape[0], i] = hist
-            occupancy = len(lanes) / n
-            self._inflight = [s.request for _, s in lanes]
-            t0 = time.monotonic()
-
-        self._announce(TAG_SPEC, packed.shape[0], 0, packed)
-        toks_dev, accs_dev, self.cache = self._spec_chunk_fn(
-            self.params, self.cache, k, jnp.asarray(packed))
-        toks = np.asarray(toks_dev)  # [k, n, g+1] int32 — tokens, never logits
-        accs = np.asarray(accs_dev)  # [k, n]
-
-        with self._state_lock:
-            self._inflight = []
-            if self._poisoned or self._stop.is_set():
-                return True
-            self._record_step("decode_spec", time.monotonic() - t0, occupancy,
-                              ("decode_spec", n, k, self.spec_tokens))
-            now = time.monotonic()
-            emitted = accepted = 0
-            for i, s in lanes:
-                if self.slots[i] is not s:
-                    continue
-                if s.request.cancelled or s.request.expired(now):
-                    self._free_slot(i)
-                    s.request.complete(error=RequestTimeout())
-                    continue
-                for kk in range(k):
-                    a = int(accs[kk, i])
-                    accepted += a
-                    for j in range(a + 1):
-                        tok = int(toks[kk, i, j])
-                        s.pos += 1
-                        s.last_token = tok
-                        s.generated.append(tok)
-                        emitted += 1
-                        self._emit(s, tok)
-                        self._maybe_finish(i)
-                        if self.slots[i] is not s:  # EOS/budget: rest discarded
-                            break
-                    if self.slots[i] is not s:
-                        break
-            self.metrics.increment_counter("app_tpu_tokens_total", emitted)
-            self.metrics.increment_counter(
-                "app_tpu_spec_proposed", k * self.spec_tokens * len(lanes))
-            self.metrics.increment_counter("app_tpu_spec_accepted", accepted)
-            return True
-
-    def _dispatch_decode(self) -> bool:
-        """Assemble and asynchronously dispatch one decode chunk. Positions
-        are SPECULATIVE: a lane with a chunk already in flight decodes from
-        ``pos + k*inflight`` and takes its input token from the on-device
-        ``prev_last`` carry rather than the host (which hasn't read that
-        chunk back yet). Lanes guaranteed dead once their in-flight chunk is
-        processed (speculative pos >= max_total) are masked out, so writes
-        never exceed the existing decode_chunk cache slack. Returns True when
-        a chunk was dispatched."""
-        with self._state_lock:
-            n = self.num_slots
-            k = self.decode_chunk
-
-            # (slot index, slot, speculative position) for lanes that decode
-            lanes: list[tuple[int, _Slot, int]] = []
-            for i in self._active():
-                s = self.slots[i]
-                p = s.pos + k * s.inflight
-                if p >= s.max_total:
-                    continue  # will be freed when its in-flight chunk processes
-                lanes.append((i, s, p))
-            if not lanes:
-                return False
-
-            if self.kv_layout == "paged":
-                # every decoding lane must own pages covering this chunk's
-                # writes (p .. p+k-1) BEFORE the table snapshot
-                for i, s, p in list(lanes):
-                    self._alloc_lane_pages(i, s, p + k - 1)
-                lanes = [(i, s, p) for i, s, p in lanes if self.slots[i] is s]
-                if not lanes:
-                    return False
-
-            # always the FULL chunk — one compiled decode program for the whole
-            # serving lifetime. A slot that hits its budget/EOS mid-chunk simply
-            # has its surplus tokens discarded (the cache carries decode_chunk
-            # slack past max_len, so overshoot writes stay in bounds; paged
-            # slots' tables carry the same slack via pages_per_slot). All host
-            # inputs ride ONE packed array (layout at the jit definitions).
-            wt = self.pages_per_slot if self.kv_layout == "paged" else 0
-            packed = np.zeros((5 + wt, n), np.int32)
-            temps = np.zeros((n,), np.float32)
-            if self.kv_layout != "paged":
-                # non-decoding rows (empty, chunk-prefilling, or dead-lane-
-                # masked) write at an out-of-bounds position so the masked-
-                # select append drops them — a position-0 write would corrupt
-                # a prefilling slot's first token (paged masks via OOB table
-                # rows instead)
-                packed[1, :] = self._cache_len
-            for i, s, p in lanes:
-                if s.inflight == 0:
-                    # host knows this lane's exact last token (from prefill or
-                    # its last processed chunk); otherwise the device carry
-                    # from the in-flight chunk supplies it (use_host stays 0)
-                    packed[0, i] = s.last_token
-                    packed[4, i] = 1
-                packed[1, i] = p
-                temps[i] = float(s.request.kw.get("temperature", 0.0))
-            packed[2] = temps.view(np.int32)
-            self._step_count += 1
-            packed[3, 0] = self._step_count
-            if self.kv_layout == "paged":
-                packed[5:] = self._masked_table({i for i, _, _ in lanes}).T
-
-            for _, s, _ in lanes:
-                s.inflight += 1
-            occupancy = len(lanes) / n
-            t0 = time.monotonic()
-
-        self._announce(TAG_DECODE, 1, 0, packed)  # a=1: live, carry applies
-        prev = self._prev_last
-        if prev is None:
-            prev = jnp.zeros((n,), jnp.int32)
-        chunk_dev, last_dev, self.cache = self._decode_chunk(
-            self.params, self._base_key, self.cache, k, jnp.asarray(packed), prev
-        )
-        self._prev_last = last_dev
-        self._dq.append((chunk_dev, [(i, s) for i, s, _ in lanes], t0, occupancy, (n, k)))
-        return True
-
-    def _process_decode(self) -> bool:
-        """Block on the OLDEST dispatched chunk's tokens (overlapping any
-        younger chunk's compute) and fold them into slot state. Lanes whose
-        slot object changed since dispatch (freed, preempted, reassigned)
-        have their results discarded — the identity check is what makes
-        speculative dispatch safe."""
-        if not self._dq:
-            return False
-        chunk_dev, meta, t0, occupancy, (n, k) = self._dq.popleft()
-        chunk = np.asarray(chunk_dev)  # [slots, k] int32 — tokens, never logits
-        if self._poisoned:
-            # stop() declared this thread wedged and already failed/cleared
-            # everything; the slot/page state now belongs to the caller.
-            return False
-        with self._state_lock:
-            self._record_step("decode", time.monotonic() - t0, occupancy, ("decode", n, k))
-
-            now = time.monotonic()
-            accepted = 0
-            for i, s in meta:
-                if self.slots[i] is not s:
-                    continue  # freed/preempted/reassigned while in flight
-                s.inflight -= 1
-                if s.request.cancelled or s.request.expired(now):
-                    # slot invalidation: free the lane; in-flight work is discarded
-                    self._free_slot(i)
-                    s.request.complete(error=RequestTimeout())
-                    continue
-                for j in range(k):
-                    tok = int(chunk[i, j])
-                    s.pos += 1
-                    s.last_token = tok
-                    s.generated.append(tok)
-                    accepted += 1
-                    self._emit(s, tok)
-                    self._maybe_finish(i)
-                    if self.slots[i] is not s:  # EOS/length mid-chunk: rest discarded
-                        break
-            self.metrics.increment_counter("app_tpu_tokens_total", accepted)
-            return True
-
     # -- completion ------------------------------------------------------------
 
     # stream detokenizer bounds: ctx anchors in-context decoding (a few
@@ -1965,6 +1662,35 @@ def _resolve_config(family_name: str, config: Any):
     return cls(**config) if isinstance(config, dict) else cls()
 
 
+def _resolve_weights(spec, family, container, *, seed, rules, mesh, what=None):
+    """One weights-to-(cfg, params) resolution path for the target AND the
+    speculative draft: orbax checkpoint dir, HF converter, or random init
+    (dev/bench), then shard over the mesh by the family's logical axes."""
+    name = what or f"model {spec.family}"
+    if spec.weights:
+        from gofr_tpu.train.checkpoint import is_checkpoint_dir, load_params
+
+        if is_checkpoint_dir(spec.weights):
+            # orbax checkpoint dir (train/checkpoint.py): config must be given
+            cfg = _resolve_config(spec.family, spec.config)
+            like = jax.eval_shape(lambda: family.init(cfg, jax.random.key(0)))
+            params = load_params(spec.weights, like)
+        else:
+            from gofr_tpu.models import convert
+
+            converter = getattr(convert, f"{spec.family}_from_hf", None)
+            if converter is None:
+                raise ValueError(f"no weight converter for family {spec.family!r}")
+            cfg, params = converter(spec.weights, dtype=spec.dtype)
+    else:
+        cfg = _resolve_config(spec.family, spec.config)
+        params = family.init(cfg, jax.random.key(seed))
+        container.logger.warn(
+            f"{name}: no weights given — randomly initialized (dev/bench mode)"
+        )
+    return cfg, shard_pytree(params, family.param_axes(cfg), rules, mesh)
+
+
 def _load_tokenizer(path_or_id):
     if not path_or_id:
         return None
@@ -2011,28 +1737,9 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
     sp_size = (int(mesh.shape["sp"])
                if mesh is not None and "sp" in getattr(mesh, "axis_names", ()) else 1)
 
-    if spec.weights:
-        from gofr_tpu.train.checkpoint import is_checkpoint_dir, load_params
-
-        if is_checkpoint_dir(spec.weights):
-            # orbax checkpoint dir (train/checkpoint.py): config must be given
-            cfg = _resolve_config(spec.family, spec.config)
-            like = jax.eval_shape(lambda: family.init(cfg, jax.random.key(0)))
-            params = load_params(spec.weights, like)
-        else:
-            from gofr_tpu.models import convert
-
-            converter = getattr(convert, f"{spec.family}_from_hf", None)
-            if converter is None:
-                raise ValueError(f"no weight converter for family {spec.family!r}")
-            cfg, params = converter(spec.weights, dtype=spec.dtype)
-    else:
-        cfg = _resolve_config(spec.family, spec.config)
-        params = family.init(cfg, jax.random.key(int(kw.pop("seed", 0))))
-        container.logger.warn(
-            f"model {spec.family}: no weights given — randomly initialized (dev/bench mode)"
-        )
-    params = shard_pytree(params, family.param_axes(cfg), rules, mesh)
+    cfg, params = _resolve_weights(
+        spec, family, container, seed=int(kw.pop("seed", 0)),
+        rules=rules, mesh=mesh)
 
     quantize_kw = kw.pop("quantize", None)
     quantize = str(quantize_kw if quantize_kw is not None else conf.get_or_default("ENGINE_QUANTIZE", ""))
@@ -2089,6 +1796,22 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
                 f"{getattr(family, '__name__', family)!r} (no {spec_attr})"
             )
             spec_tokens = 0
+        # draft model for speculative decoding: a ModelSpec (resolved and
+        # sharded through the same _resolve_weights path as the target) or
+        # a prebuilt (family, cfg, params) triple. Engine-level validation
+        # covers layout/protocol fit. Deliberately NOT routed through the
+        # target-only extras: pp-family wrapping (the draft is replicated,
+        # never pipeline-sharded) and ENGINE_QUANTIZE (a tiny draft's
+        # weight reads are noise; quantize the target instead).
+        draft_kw = kw.pop("spec_draft", None)
+        if isinstance(draft_kw, ModelSpec):
+            dfamily = get_family(draft_kw.family)
+            dcfg, dparams = _resolve_weights(
+                draft_kw, dfamily, container, seed=1, rules=rules, mesh=mesh,
+                what=f"spec_draft {draft_kw.family}")
+            draft_kw = (dfamily, dcfg, dparams)
+        if draft_kw is not None:
+            kw["spec_draft"] = draft_kw
         # multi-host: every process must issue identical global programs;
         # the leader (process 0) serves, followers run serve_follower()
         # (tpu/lockstep.py). A crash-restart would desynchronize followers,

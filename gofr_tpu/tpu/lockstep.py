@@ -25,17 +25,43 @@ live=0 flag so followers mirror the leader's no-carry warmup exactly).
 
 Failure semantics: the leader broadcasts the STOP tag on ``stop()`` AND
 from the device loop's terminal crash path, so follower processes never
-block forever on a dead leader. A leader stopped with a WEDGED device
-thread cannot safely broadcast (the wedged thread may still be inside a
-collective) — followers must be torn down externally in that case, which
-is also the only safe multi-host response to a wedged program.
+block forever on a CLEANLY-dying leader. A leader stopped with a WEDGED
+device thread cannot safely broadcast (the wedged thread may still be
+inside a collective) — followers must be torn down externally in that
+case, which is also the only safe multi-host response to a wedged
+program.
 
-v1 scope: no engine crash-RESTART while in lockstep (a restart resets the
-leader's step/carry state and would desynchronize followers; the engine
-forces max_restarts=0).
+Liveness against a HARD-KILLED leader (kill -9 / OOM — no STOP reaches
+the fabric): set ``LOCKSTEP_DEADLINE_S``. The leader then broadcasts a
+NOP heartbeat from its device thread whenever it idles with no
+announcement for deadline/3, and each follower arms a watchdog that
+hard-exits the process (``os._exit(LOCKSTEP_EXIT_CODE)``, default
+handler) when nothing — program, heartbeat, or stop — arrives for a full
+deadline. Hard exit is deliberate: the follower is blocked INSIDE a
+device collective that can never complete, so no Python-level unwind can
+release it; the supervisor (k8s, systemd) sees a distinct exit code and
+restarts the pod. Size the deadline above the worst-case program
+compile+step gap (run ``warmup()`` before serving so steady-state gaps
+are steps, not compiles). Heartbeats ride the leader's device thread —
+never a second thread — because interleaving a second broadcast stream
+would corrupt the collective ordering.
+
+Restart-resync design (documented for v2; NOT implemented): after any
+process death, the group must be torn down and re-formed — coordinator
+restart, same seed, fresh engines — because KV/hist/carry state cannot
+be trusted to match across survivors. The leader's request queue (and
+any durable queue in front of it) is the only state worth preserving;
+slot-resident generations are lost, exactly like the single-host
+crash-recover path (engine._crash_recover). v1 therefore forbids
+in-lockstep engine restarts (max_restarts=0) and treats every failure
+as group-fatal.
 """
 
 from __future__ import annotations
+
+import os
+import threading
+import time
 
 import numpy as np
 
@@ -44,6 +70,9 @@ TAG_PREFILL = 1
 TAG_CHUNK = 2
 TAG_DECODE = 3
 TAG_SPEC = 4
+TAG_NOP = 5  # leader heartbeat: header only, no payload, no device call
+
+LOCKSTEP_EXIT_CODE = 17  # follower watchdog hard-exit (distinct for supervisors)
 
 _HEADER_LEN = 3  # (tag, a, b)
 
@@ -60,10 +89,21 @@ class LockstepLeader:
 
     def __init__(self):
         self._stopped = False
+        self._last_announce = time.monotonic()
 
     def announce(self, tag: int, a: int, b: int, packed: np.ndarray) -> None:
         _broadcast(np.array([tag, a, b], np.int32))
         _broadcast(np.asarray(packed, np.int32))
+        self._last_announce = time.monotonic()
+
+    def maybe_heartbeat(self, interval_s: float) -> None:
+        """NOP-header broadcast when idle past ``interval_s`` — resets the
+        followers' liveness watchdogs. Device-thread only (a heartbeat from
+        any other thread could interleave with a live announcement and
+        corrupt the collective stream)."""
+        if not self._stopped and time.monotonic() - self._last_announce > interval_s:
+            _broadcast(np.array([TAG_NOP, 0, 0], np.int32))
+            self._last_announce = time.monotonic()
 
     def stop(self) -> None:
         if not self._stopped:
@@ -74,10 +114,34 @@ class LockstepLeader:
 class LockstepFollower:
     """Follower-side receive loop bound to an engine built with the same
     config/seed. Blocks in the broadcast collective until the leader's
-    next call; returns when the leader announces stop."""
+    next call; returns when the leader announces stop.
 
-    def __init__(self, engine):
+    ``deadline_s > 0`` arms a liveness watchdog: when no header (program,
+    heartbeat, or stop) completes for a full deadline, ``on_timeout`` runs
+    — by default a CRITICAL log + ``os._exit(LOCKSTEP_EXIT_CODE)``,
+    because the receive thread is wedged inside a collective that can
+    never complete once the leader is gone (module docstring)."""
+
+    def __init__(self, engine, deadline_s: float = 0.0, on_timeout=None):
         self.engine = engine
+        self.deadline_s = float(deadline_s)
+        self._on_timeout = on_timeout or self._default_timeout
+        self._progress_at = time.monotonic()
+        self._done = threading.Event()
+
+    def _default_timeout(self) -> None:  # pragma: no cover - exits hard
+        self.engine.logger.fatal(
+            f"lockstep follower: no leader traffic for {self.deadline_s:.0f}s "
+            f"— leader presumed dead; exiting {LOCKSTEP_EXIT_CODE}"
+        )
+        os._exit(LOCKSTEP_EXIT_CODE)
+
+    def _watch(self) -> None:
+        step = min(1.0, self.deadline_s / 4)
+        while not self._done.wait(step):
+            if time.monotonic() - self._progress_at > self.deadline_s:
+                self._on_timeout()
+                return
 
     def _recv(self, shape) -> np.ndarray:
         return np.asarray(_broadcast(np.zeros(shape, np.int32)))
@@ -87,6 +151,15 @@ class LockstepFollower:
 
         from gofr_tpu.ops.pallas import platform_hint
 
+        if self.deadline_s > 0:
+            threading.Thread(target=self._watch, name="lockstep-watchdog",
+                             daemon=True).start()
+        try:
+            self._run_inner(jnp, platform_hint)
+        finally:
+            self._done.set()
+
+    def _run_inner(self, jnp, platform_hint) -> None:
         eng = self.engine
         w = eng.pages_per_slot if eng.kv_layout == "paged" else 1
         wt = eng.pages_per_slot if eng.kv_layout == "paged" else 0
@@ -97,9 +170,12 @@ class LockstepFollower:
         with platform_hint(getattr(eng.tpu, "platform", None)):
             while True:
                 header = np.asarray(_broadcast(np.zeros(_HEADER_LEN, np.int32)))
+                self._progress_at = time.monotonic()
                 tag, a, b = int(header[0]), int(header[1]), int(header[2])
                 if tag == TAG_STOP:
                     return
+                if tag == TAG_NOP:
+                    continue  # leader heartbeat: liveness only
                 if tag == TAG_PREFILL:
                     packed = self._recv((b, a + w + 3))
                     toks, eng.cache = eng._prefill_sample(
@@ -123,9 +199,22 @@ class LockstepFollower:
                         eng._prev_last = last
                     del out
                 elif tag == TAG_SPEC:
-                    packed = self._recv((a, n))
-                    toks, accs, eng.cache = eng._spec_chunk_fn(
-                        eng.params, eng.cache, k, jnp.asarray(packed))
+                    if eng.kv_layout == "slot":
+                        # slot spec: a is a live flag, payload is [3, n],
+                        # and the device-resident (token, hlen) carry is
+                        # reproduced because every process executes the
+                        # same deterministic (greedy) calls in order
+                        packed = self._recv((3, n))
+                        carry = eng._spec_carry
+                        if carry is None:
+                            carry = (jnp.zeros((n,), jnp.int32),
+                                     jnp.zeros((n,), jnp.int32))
+                        toks, accs, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
+                            eng.params, eng.cache, k, jnp.asarray(packed), carry)
+                    else:
+                        packed = self._recv((a, n))
+                        toks, accs, eng.cache = eng._spec_chunk_fn(
+                            eng.params, eng.cache, k, jnp.asarray(packed))
                     del toks, accs
                 else:  # pragma: no cover - protocol corruption
                     raise RuntimeError(f"lockstep follower: unknown tag {tag}")

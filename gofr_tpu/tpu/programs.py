@@ -1,0 +1,360 @@
+"""Jitted packed-program builders for ``GenerateEngine``.
+
+Every serving step ships its host inputs as ONE packed int32 array (floats
+bitcast, RNG step folded in on device from the resident base key). Over a
+tunneled device each separate H2D transfer and out-of-jit RNG op costs a
+round trip (~70ms measured on the round-3 tunnel); packing turns 4-6 of
+them into one. This module holds the compiled-program side of that
+contract; the engine (tpu/engine.py) packs the host side.
+
+Packed layouts (W = 1 slot-id column for the slot layout, pages_per_slot
+block-table columns for paged):
+
+- Prefill ``[nb, lb + W + 3]``:
+  ``[:, :lb]`` tokens | ``[:, lb]`` lengths | ``[:, lb+1:lb+1+W]`` rows
+  | ``[:, lb+1+W]`` temps (f32 bitcast) | ``[0, lb+2+W]`` rng step.
+  Chunked prefill adds an offsets column before temps.
+- Decode ``[5 + W_t, n]`` (W_t = pages_per_slot table rows for paged, 0
+  for slot): ``[0]`` tokens | ``[1]`` positions | ``[2]`` temps | ``[3,0]``
+  rng step | ``[4]`` use_host flags | ``[5:]`` table.T. Row 4 arbitrates
+  the input token per lane: 1 = take the host's packed token (lane just
+  (re)joined decode); 0 = take the on-device ``prev_last`` carry from the
+  previous dispatched chunk (lane has a chunk in flight the host hasn't
+  read back yet).
+- Spec (slot) ``[3, n]``: ``[0]`` input token | ``[1]`` history length
+  (the input token is hist[hlen-1], its KV goes to position hlen-1)
+  | ``[2]`` use_host flags — same arbitration as decode row 4, against a
+  device-resident ``(token, hlen)`` carry, which is what lets spec rounds
+  ride the pipelined dispatch queue. The token HISTORY itself never
+  leaves the device: with spec on, the slot cache is the pytree
+  ``(kv, hist)`` and the prefill programs write each admitted prompt
+  (plus its sampled first token) into ``hist`` rows on device, so the
+  host never re-ships O(pos) history per round. Inactive lanes ship
+  use_host=1 with hlen = H + 1: every cache/history write lands out of
+  bounds and drops.
+- Spec (paged) ``[2 + Wp + Hcap, n]``: ``[0]`` input token | ``[1]``
+  history length | ``[2:2+Wp]`` table.T | ``[2+Wp:]`` history.T.
+  Inactive lanes ship hlen = Hcap + 1 AND an all-OOB table row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.ops.sampling import sample_token
+
+
+def unpack_prefill(packed, w, chunked=False):
+    extra = 1 if chunked else 0
+    lb = packed.shape[1] - (w + 3 + extra)
+    tokens = packed[:, :lb]
+    lengths = packed[:, lb]
+    rows = packed[:, lb + 1:lb + 1 + w]
+    offsets = packed[:, lb + 1 + w] if chunked else None
+    temps = jax.lax.bitcast_convert_type(
+        packed[:, lb + 1 + w + extra], jnp.float32)
+    step = packed[0, lb + 2 + w + extra]
+    return tokens, lengths, rows, offsets, temps, step
+
+
+@dataclass
+class Programs:
+    """Compiled-program handles the engine (and lockstep followers) call.
+
+    ``chunk_prefill`` is None when the layout/family has no chunked-prefill
+    support; ``spec_chunk`` is None unless speculative decoding is on.
+    """
+
+    prefill_sample: Any
+    chunk_prefill: Any | None
+    decode_chunk: Any
+    spec_chunk: Any | None
+
+
+def build_programs(
+    family: Any,
+    cfg: Any,
+    *,
+    kv_layout: str,
+    spec_tokens: int,
+    top_k: int,
+    top_p: float,
+    pages_per_slot: int = 0,
+    page_size: int = 0,
+    cache_len: int = 0,
+    prefill_attn_fn: Any = None,
+    draft: Any = None,
+) -> Programs:
+    """``draft`` (slot layout + spec only) is a ``(family, cfg)`` pair for a
+    DRAFT MODEL: instead of prompt-lookup, each spec round runs
+    ``spec_tokens`` autoregressive draft-model decode steps on device, then
+    the one target verify forward. With a draft, ``params`` to every program
+    is the pytree ``{"t": target_params, "d": draft_params}``, and the
+    engine cache is ``(kv, draft_kv)`` — the draft's slot KV cache replaces
+    the token-history buffer (the draft needs no history, killing the
+    history writes too). Verification is unchanged, so outputs stay
+    bit-identical to plain greedy decode regardless of draft quality — the
+    draft only moves the acceptance rate."""
+    ts = (top_k, top_p)
+    W = pages_per_slot if kv_layout == "paged" else 1
+    # whole-prompt prefill attention override (e.g. ring/Ulysses
+    # sequence-parallel attention on an sp mesh — build_engine wires it);
+    # chunked prefill keeps the gathered-view attention either way
+    pf = {"attn_fn": prefill_attn_fn} if prefill_attn_fn is not None else {}
+    chunk_prefill = None
+    spec_chunk = None
+
+    if kv_layout == "paged":
+        @partial(jax.jit, donate_argnums=(2,))
+        def _prefill_sample(params, base_key, cache, packed):
+            tokens, lengths, rows, _, temps, step = unpack_prefill(packed, W)
+            key = jax.random.fold_in(base_key, step)
+            logits, cache = family.prefill_paged(cfg, params, tokens, lengths, cache, rows, **pf)
+            toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
+            return toks, cache
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def _chunk_prefill(params, base_key, cache, packed):
+            tokens, lengths, rows, offsets, temps, step = unpack_prefill(
+                packed, W, chunked=True)
+            key = jax.random.fold_in(base_key, step)
+            logits, cache = family.prefill_paged(
+                cfg, params, tokens, lengths, cache, rows, offsets
+            )
+            toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
+            return toks, cache
+
+        chunk_prefill = _chunk_prefill
+
+        @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
+        def _decode_chunk(params, base_key, cache, steps, packed, prev_last):
+            tokens = jnp.where(packed[4] != 0, packed[0], prev_last)
+            positions = packed[1]
+            temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
+            key = jax.random.fold_in(base_key, packed[3, 0])
+            table = packed[5:].T
+
+            def body(carry, _):
+                toks, pos, cache, key = carry
+                logits, cache = family.decode_step_paged(cfg, params, toks, pos, cache, table)
+                key, sub = jax.random.split(key)
+                nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
+                return (nxt, pos + 1, cache, key), nxt
+
+            (toks, pos, cache, key), out = jax.lax.scan(
+                body, (tokens, positions, cache, key), None, length=steps
+            )
+            return out.T, toks, cache  # [slots, K], [slots] carry
+
+        if spec_tokens:
+            g = spec_tokens
+            Wp = pages_per_slot
+            Hcap = Wp * page_size  # logical per-slot capacity
+
+            @partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+            def _spec_chunk(params, cache, steps, packed):
+                n_l = packed.shape[1]
+                tok0 = packed[0]
+                hlen0 = packed[1]
+                table = packed[2:2 + Wp].T      # [n, Wp]
+                hist0 = packed[2 + Wp:].T       # [n, Hcap]
+                idx = jnp.arange(Hcap)
+
+                def outer(carry, _):
+                    tok, hlen, hist, cache = carry
+                    pos = hlen - 1
+                    match = (hist == tok[:, None]) & (idx[None, :] < pos[:, None])
+                    j = jnp.where(match, idx[None, :], -1).max(axis=1)
+                    take = jnp.clip(j[:, None] + 1 + jnp.arange(g)[None, :], 0, Hcap - 1)
+                    drafts = jnp.take_along_axis(hist, take, axis=1)
+                    seq = jnp.concatenate([tok[:, None], drafts], axis=1)
+                    logits, cache = family.verify_step_paged(
+                        cfg, params, seq, pos, cache, table)
+                    tgt = jnp.argmax(logits, -1).astype(jnp.int32)
+                    ok = jnp.cumprod((drafts == tgt[:, :g]).astype(jnp.int32), axis=1)
+                    acc = ok.sum(axis=1)
+                    nxt = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
+                    emit = jnp.arange(g + 1)[None, :] <= acc[:, None]
+                    wpos = jnp.where(emit, hlen[:, None] + jnp.arange(g + 1)[None, :], Hcap)
+                    hist = hist.at[jnp.arange(n_l)[:, None], wpos].set(tgt, mode="drop")
+                    return (nxt, hlen + acc + 1, hist, cache), (tgt, acc)
+
+                (_, _, _, cache), (toks, accs) = jax.lax.scan(
+                    outer, (tok0, hlen0, hist0, cache), None, length=steps
+                )
+                return toks, accs, cache
+
+            spec_chunk = _spec_chunk
+    else:
+        # With spec on, the engine's cache is a 2-tuple pytree: (kv, hist)
+        # for prompt-lookup — the prefill programs seed hist rows on device
+        # and the spec program maintains them, so no program input ever
+        # carries token history — or (kv, draft_kv) with a draft model.
+        tuple_cache = bool(spec_tokens)
+        dfamily, dcfg = draft if draft is not None else (None, None)
+
+        def _tparams(params):
+            return params["t"] if draft is not None else params
+
+        def _split(cache):
+            return cache if tuple_cache else (cache, None)
+
+        def _join(kv, aux):
+            return (kv, aux) if tuple_cache else kv
+
+        def _seed_hist(hist, rows, tokens, lengths, toks, offsets=None):
+            """Write an admitted prompt chunk (and its sampled token) into
+            the device history. OOB rows (padding: slot id == num_slots)
+            drop. On non-final chunks the sampled-token write at
+            offset+length is garbage the NEXT chunk overwrites — final
+            state is always (prompt .. first sampled token)."""
+            lb = tokens.shape[1]
+            base = offsets if offsets is not None else jnp.zeros_like(lengths)
+            cols = base[:, None] + jnp.arange(lb)[None, :]
+            hist = hist.at[rows[:, None], cols].set(tokens, mode="drop")
+            return hist.at[rows, base + lengths].set(toks, mode="drop")
+
+        def _seed_aux(params, aux, rows, tokens, lengths, toks, offsets=None):
+            """Bring the spec sidecar state up to date with an admitted
+            prompt: prefill the draft model's KV cache over the same
+            tokens, or seed the prompt-lookup history rows."""
+            if draft is None:
+                return _seed_hist(aux, rows, tokens, lengths, toks, offsets)
+            if offsets is None:
+                _, aux = dfamily.prefill(
+                    dcfg, params["d"], tokens, lengths, aux, rows)
+            else:
+                _, aux = dfamily.prefill(
+                    dcfg, params["d"], tokens, lengths, aux, rows, offsets)
+            return aux
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def _prefill_sample(params, base_key, cache, packed):
+            kv, aux = _split(cache)
+            tokens, lengths, rows, _, temps, step = unpack_prefill(packed, W)
+            key = jax.random.fold_in(base_key, step)
+            logits, kv = family.prefill(
+                cfg, _tparams(params), tokens, lengths, kv, rows[:, 0], **pf)
+            toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
+            if tuple_cache:
+                aux = _seed_aux(params, aux, rows[:, 0], tokens, lengths, toks)
+            return toks, _join(kv, aux)
+
+        if getattr(family, "SLOT_CHUNKED_PREFILL", False):
+            @partial(jax.jit, donate_argnums=(2,))
+            def _chunk_prefill(params, base_key, cache, packed):
+                kv, aux = _split(cache)
+                tokens, lengths, rows, offsets, temps, step = unpack_prefill(
+                    packed, W, chunked=True)
+                key = jax.random.fold_in(base_key, step)
+                logits, kv = family.prefill(
+                    cfg, _tparams(params), tokens, lengths, kv, rows[:, 0], offsets
+                )
+                toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
+                if tuple_cache:
+                    aux = _seed_aux(params, aux, rows[:, 0], tokens, lengths,
+                                    toks, offsets)
+                return toks, _join(kv, aux)
+
+            chunk_prefill = _chunk_prefill
+
+        @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
+        def _decode_chunk(params, base_key, cache, steps, packed, prev_last):
+            kv, aux = _split(cache)
+            tokens = jnp.where(packed[4] != 0, packed[0], prev_last)
+            positions = packed[1]
+            temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
+            key = jax.random.fold_in(base_key, packed[3, 0])
+
+            def body(carry, _):
+                toks, pos, kv, key = carry
+                logits, kv = family.decode_step(cfg, _tparams(params), toks, pos, kv)
+                key, sub = jax.random.split(key)
+                nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
+                return (nxt, pos + 1, kv, key), nxt
+
+            (toks, pos, kv, key), out = jax.lax.scan(
+                body, (tokens, positions, kv, key), None, length=steps
+            )
+            return out.T, toks, _join(kv, aux)  # [slots, K], [slots] carry
+
+        if spec_tokens:
+            g = spec_tokens
+            H = cache_len
+
+            @partial(jax.jit, static_argnums=(2,), donate_argnums=(1, 4))
+            def _spec_chunk(params, cache, steps, packed, carry):
+                kv, aux0 = cache
+                n_l = packed.shape[1]
+                use_host = packed[2] != 0
+                tok0 = jnp.where(use_host, packed[0], carry[0])
+                hlen0 = jnp.where(use_host, packed[1], carry[1])
+                idx = jnp.arange(H)
+
+                def outer(loop, _):
+                    tok, hlen, aux, kv = loop
+                    pos = hlen - 1
+                    if draft is None:
+                        # prompt-lookup draft: continuation after the most
+                        # recent EARLIER occurrence of the current token
+                        match = (aux == tok[:, None]) & (idx[None, :] < pos[:, None])
+                        j = jnp.where(match, idx[None, :], -1).max(axis=1)  # -1 = miss
+                        take = jnp.clip(j[:, None] + 1 + jnp.arange(g)[None, :], 0, H - 1)
+                        drafts = jnp.take_along_axis(aux, take, axis=1)  # [n, g]
+                    else:
+                        # draft-model proposal: g+1 autoregressive greedy
+                        # steps of the (tiny) draft, its KV cache riding in
+                        # aux. g+1, not g: the extra step's OUTPUT is
+                        # discarded but its input write puts the g-th
+                        # draft's KV at pos+g — without it, a fully-
+                        # accepted round would leave a PERMANENT hole there
+                        # (the next round starts writing at pos+g+1) and
+                        # acceptance would silently decay with generation
+                        # length, worst in the high-acceptance regime the
+                        # draft exists for. With the write, the draft KV
+                        # covers pos..pos+g like the target's verify write,
+                        # and on partial acceptance the next round's writes
+                        # from the new pos re-cover every stale entry
+                        # before its attention can see it.
+                        def dstep(c, _):
+                            dtok, dpos, dkv = c
+                            dlogits, dkv = dfamily.decode_step(
+                                dcfg, params["d"], dtok, dpos, dkv)
+                            nxt_d = jnp.argmax(dlogits, -1).astype(jnp.int32)
+                            return (nxt_d, dpos + 1, dkv), nxt_d
+
+                        (_, _, aux), drafts_t = jax.lax.scan(
+                            dstep, (tok, pos, aux), None, length=g + 1)
+                        drafts = drafts_t[:g].T  # [n, g]
+                    seq = jnp.concatenate([tok[:, None], drafts], axis=1)
+                    logits, kv = family.verify_step(cfg, _tparams(params), seq, pos, kv)
+                    tgt = jnp.argmax(logits, -1).astype(jnp.int32)  # [n, g+1]
+                    ok = jnp.cumprod((drafts == tgt[:, :g]).astype(jnp.int32), axis=1)
+                    acc = ok.sum(axis=1)  # accepted drafts per lane, 0..g
+                    nxt = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
+                    if draft is None:
+                        emit = jnp.arange(g + 1)[None, :] <= acc[:, None]
+                        wpos = jnp.where(emit, hlen[:, None] + jnp.arange(g + 1)[None, :], H)
+                        aux = aux.at[jnp.arange(n_l)[:, None], wpos].set(
+                            tgt, mode="drop")
+                    return (nxt, hlen + acc + 1, aux, kv), (tgt, acc)
+
+                (tok_f, hlen_f, aux, kv), (toks, accs) = jax.lax.scan(
+                    outer, (tok0, hlen0, aux0, kv), None, length=steps
+                )
+                # [K, n, g+1], [K, n], cache, next-round (token, hlen) carry
+                return toks, accs, (kv, aux), (tok_f, hlen_f)
+
+            spec_chunk = _spec_chunk
+
+    return Programs(
+        prefill_sample=_prefill_sample,
+        chunk_prefill=chunk_prefill,
+        decode_chunk=_decode_chunk,
+        spec_chunk=spec_chunk,
+    )

