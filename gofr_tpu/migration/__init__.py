@@ -150,6 +150,28 @@ def run_migrations(migrations: dict[int, Migration | Any], container) -> list[in
 
     if db is not None:
         db.execute(MIGRATION_TABLE_DDL)
+        # a version recorded as redis-pending means a previous run
+        # committed SQL but died before (or during) the Redis EXEC: its
+        # Redis writes were NEVER applied, and because the skip point is
+        # the max across datasources a silent rerun would skip them
+        # forever. Refuse to proceed until the operator replays the
+        # migration's Redis writes and clears the marker
+        # (UPDATE gofr_migrations SET method='UP' WHERE version=N) —
+        # docs/migrations.md#redis-pending.
+        row = db.query_row(
+            "SELECT version FROM gofr_migrations WHERE method = 'UP:redis-pending'"
+        )
+        if row and row.get("version") is not None:
+            raise RuntimeError(
+                f"migration {row['version']} is marked UP:redis-pending (SQL "
+                "committed, Redis EXEC unconfirmed). Check Redis first: "
+                f"HGET gofr_migrations {row['version']} — if the completion "
+                "record EXISTS the EXEC succeeded and only the marker-clear "
+                "failed (do NOT replay; just clear the marker); if ABSENT, "
+                "replay the migration's Redis writes manually. Then clear: "
+                f"UPDATE gofr_migrations SET method='UP' WHERE "
+                f"version={row['version']} (docs/migrations.md#redis-pending)"
+            )
     last = _last_applied(db, redis)
 
     applied: list[int] = []
@@ -173,9 +195,15 @@ def run_migrations(migrations: dict[int, Migration | Any], container) -> list[in
             # everything back cleanly; a Redis failure after the SQL commit
             # leaves SQL recorded and is surfaced loudly below.
             if tx is not None:
+                # with Redis also in play, the version commits as
+                # 'UP:redis-pending' and flips to 'UP' only after the EXEC
+                # confirms — a crash in the window leaves a durable marker
+                # that run_migrations refuses to skip past (ADVICE r4;
+                # docs/migrations.md#redis-pending)
+                method = "UP:redis-pending" if redis_tx is not None else "UP"
                 tx.execute(
                     "INSERT INTO gofr_migrations (version, method, start_time, duration_ms) VALUES (?, ?, ?, ?)",
-                    (version, "UP", stamp, duration_ms),
+                    (version, method, stamp, duration_ms),
                 )
                 tx.commit()
             if redis_tx is not None:
@@ -189,11 +217,32 @@ def run_migrations(migrations: dict[int, Migration | Any], container) -> list[in
                     if tx is not None:
                         logger.errorf(
                             "migration %d: SQL committed but the Redis EXEC failed — "
-                            "Redis writes for this version were NOT applied and must "
-                            "be replayed manually (the version is recorded as applied)",
+                            "Redis writes for this version were NOT applied; the "
+                            "version stays marked UP:redis-pending and the next "
+                            "run_migrations will refuse to start until it is "
+                            "replayed and cleared (docs/migrations.md#redis-pending)",
                             version,
                         )
                     raise
+                if tx is not None:
+                    # EXEC confirmed: clear the pending marker. A failure
+                    # RIGHT HERE must not read as a failed migration — the
+                    # writes are fully applied; the stale marker is a
+                    # safe-side false positive (the refusal message tells
+                    # the operator how to distinguish it via HGET).
+                    try:
+                        db.execute(
+                            "UPDATE gofr_migrations SET method = 'UP' WHERE version = ?",
+                            (version,),
+                        )
+                    except Exception as clear_err:  # noqa: BLE001
+                        logger.errorf(
+                            "migration %d: Redis EXEC CONFIRMED but clearing the "
+                            "redis-pending marker failed (%r). Do NOT replay — "
+                            "just clear the marker: UPDATE gofr_migrations SET "
+                            "method='UP' WHERE version=%d",
+                            version, clear_err, version,
+                        )
         except Exception as e:  # noqa: BLE001
             if redis_tx is not None:
                 redis_tx._discard()

@@ -117,9 +117,15 @@ def append_tokens_paged_inplace(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Paged-pool append writing only the page holding each slot's row.
 
-    OOB rows (table entry == P, or position beyond the table span) clamp
-    to page P-1 for the tile fetch but skip the row store, leaving the
-    clamped page byte-identical (it is copied through unchanged)."""
+    OOB rows (table entry == P) redirect their tile fetch to page 0 and
+    skip the row store. Page 0 is RESERVED as a never-allocated sink by
+    the engine whenever this lowering is enabled (GOFR_PAGED_KV_WRITE=
+    pallas), so an OOB copy-through can never revisit a tile that a real
+    row writes in the same call — under Mosaic's double-buffered block
+    pipelining such a revisit could write back a stale copy over the real
+    row (ADVICE r4). Positions beyond the table span clamp to the lane's
+    OWN last page (each lane appears in the grid once, so no cross-step
+    tile sharing there either)."""
     n, hkv, d = k_new.shape
     pool, _, page, _ = k_pool.shape
     _, maxp = table.shape
@@ -128,7 +134,10 @@ def append_tokens_paged_inplace(
 
     def pool_map(bi, pos_ref, table_ref):
         logical = jnp.minimum(pos_ref[bi] // page, maxp - 1)
-        return (jnp.minimum(table_ref[bi, logical], pool - 1), 0, 0, 0)
+        entry = table_ref[bi, logical]
+        # OOB sentinel (== pool) -> the reserved sink page 0, never a
+        # clamp onto a page another grid step may write
+        return (jnp.where(entry < pool, entry, 0), 0, 0, 0)
 
     def _kernel(pos_ref, table_ref, knew_ref, vnew_ref, k_ref, v_ref, ko_ref, vo_ref):
         i = pl.program_id(0)

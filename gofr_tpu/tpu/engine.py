@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import collections
 import itertools
+import math
+import os
 import queue
 from functools import partial
 import threading
@@ -673,6 +675,20 @@ class GenerateEngine(_EngineBase):
 
         if kv_layout not in ("slot", "paged"):
             raise ValueError(f"kv_layout {kv_layout!r}: use 'slot' or 'paged'")
+        # pp serving (models/llama_pp.py): decode runs microbatches over the
+        # slot dimension. A non-dividing value would silently degrade to
+        # gcd(slots, microbatches) — potentially 1 microbatch, the WORST
+        # bubble fraction — so fail at build time like the sp bucket guard
+        # (docs/configs.md documents the divisibility requirement).
+        fam_mb = getattr(family, "microbatches", 0)
+        if fam_mb and slots % fam_mb:
+            raise ValueError(
+                f"pipeline microbatches {fam_mb} (ENGINE_PP_MICROBATCHES, "
+                f"default = the pp mesh degree) does not divide the slot "
+                f"count {slots}: decode would fall back to "
+                f"gcd={math.gcd(slots, fam_mb)} microbatches "
+                f"(worse pipeline bubbles); align it with ENGINE_SLOTS"
+            )
         if kv_layout == "paged" and not hasattr(family, "make_paged_cache"):
             raise ValueError(f"model family {family.__name__} has no paged-cache support")
         self.kv_layout = kv_layout
@@ -695,13 +711,20 @@ class GenerateEngine(_EngineBase):
             # default pool = same HBM as the slot cache; shrink to
             # oversubscribe, or keep and raise `slots` for more concurrency
             self.total_pages = total_pages if total_pages else slots * self.pages_per_slot
-            if self.total_pages < self.pages_per_slot:
+            # The in-place Pallas page append redirects OOB rows' aliased
+            # tile fetch to page 0 (ops/pallas/kv_append.py) — reserve it
+            # as a never-allocated sink so an OOB copy-through can never
+            # share a tile with a real write in the same call (ADVICE r4)
+            self._page_sink = (1 if os.environ.get("GOFR_PAGED_KV_WRITE",
+                                                   "select") == "pallas" else 0)
+            if self.total_pages - self._page_sink < self.pages_per_slot:
                 raise ValueError(
-                    f"total_pages {self.total_pages} < pages_per_slot "
-                    f"{self.pages_per_slot}: one max-length request cannot fit"
+                    f"total_pages {self.total_pages} (minus {self._page_sink} "
+                    f"sink) < pages_per_slot {self.pages_per_slot}: one "
+                    "max-length request cannot fit"
                 )
             self.cache = self._build_paged_cache()
-            self._free_pages: list[int] = list(range(self.total_pages))
+            self._free_pages: list[int] = list(range(self._page_sink, self.total_pages))
             self._slot_pages: list[list[int]] = [[] for _ in range(slots)]
             # OOB convention: unallocated entries point one past the pool
             self._table = np.full((slots, self.pages_per_slot), self.total_pages, np.int32)
@@ -1022,7 +1045,7 @@ class GenerateEngine(_EngineBase):
             # budget on one fault. Rebuild it (all slots are empty now).
             if self.kv_layout == "paged":
                 self.cache = self._build_paged_cache()
-                self._free_pages = list(range(self.total_pages))
+                self._free_pages = list(range(self._page_sink, self.total_pages))
                 self._slot_pages = [[] for _ in range(self.num_slots)]
                 self._table = np.full(
                     (self.num_slots, self.pages_per_slot), self.total_pages, np.int32

@@ -393,3 +393,45 @@ def test_inmemory_broker_concurrent_commit_keeps_at_least_once():
     b.rewind_uncommitted("t", group="g")
     assert b.subscribe("t", group="g", timeout=0.1) is None
     b.close()
+
+
+def test_redis_exec_failure_leaves_durable_pending_marker():
+    """ADVICE r4: when SQL commits but the Redis EXEC dies, the version
+    must stay marked UP:redis-pending (a durable SQL marker), and the
+    NEXT run_migrations must refuse to start — never silently skip the
+    version's Redis writes forever."""
+    c = new_mock_container()
+    c.sql, _ = make_db()
+
+    class ExplodingPipeRedis(FakeRedis):
+        def pipeline(self):
+            class _Boom:
+                def command(self, *a):
+                    return self
+
+                def execute(self):
+                    raise ConnectionError("redis died at EXEC")
+
+            return _Boom()
+
+    c.redis = ExplodingPipeRedis()
+
+    def up(d):
+        d.sql.execute("CREATE TABLE pend (x INTEGER)")
+        d.redis.set("flag", "on")
+
+    with pytest.raises(ConnectionError):
+        run_migrations({1: Migration(up=up)}, c)
+    row = c.sql.query_row("SELECT method FROM gofr_migrations WHERE version = 1")
+    assert row["method"] == "UP:redis-pending"
+
+    # rerun refuses loudly instead of skipping the lost Redis writes
+    with pytest.raises(RuntimeError, match="redis-pending"):
+        run_migrations({2: Migration(up=lambda d: None)}, c)
+
+    # operator replays + clears the marker -> runs proceed
+    c.sql.execute("UPDATE gofr_migrations SET method = 'UP' WHERE version = 1")
+    c.redis = FakeRedis()
+    assert run_migrations({2: Migration(up=lambda d: d.redis.set("k", "v"))}, c) == [2]
+    assert c.sql.query_row(
+        "SELECT method FROM gofr_migrations WHERE version = 2")["method"] == "UP"

@@ -100,3 +100,19 @@ def test_pp_mesh_microbatch_override():
     fraction) must not change tokens."""
     check_mesh_serving({"TPU_MESH": "pp:2", "TPU_DEVICES": "2",
                         "ENGINE_PP_MICROBATCHES": "4"})
+
+
+def test_pp_microbatches_must_divide_slots():
+    """A non-dividing ENGINE_PP_MICROBATCHES would silently collapse to
+    gcd(slots, m) microbatches (worst bubbles) — build_engine must reject
+    it instead (ADVICE r4)."""
+    from gofr_tpu.models import ModelSpec
+    from gofr_tpu.testutil import tiny_f32_llama
+    from gofr_tpu.tpu.engine import build_engine
+
+    cfg, _ = tiny_f32_llama()
+    c = new_mock_container({"TPU_MESH": "pp:2", "TPU_DEVICES": "2",
+                            "ENGINE_PP_MICROBATCHES": "3"})
+    with pytest.raises(ValueError, match="does not divide the slot count"):
+        build_engine(ModelSpec("llama", cfg, task="generate"), c, seed=3,
+                     slots=4, max_len=64, max_prefill_batch=1)
