@@ -86,6 +86,15 @@ def check_mesh_serving(config: dict[str, str], *, n_requests: int = 6,
     engine_kw.setdefault("slots", 4)
     engine_kw.setdefault("max_len", 64)
     engine_kw.setdefault("max_prefill_batch", 2)
+    if engine_kw.pop("spec_self_draft", False):
+        # draft-model speculation with the target as its own draft: the
+        # sharded draft path compiles/executes, every proposal is accepted,
+        # and tokens must still match the single-device reference. The
+        # draft params must be the ENGINE's sharded tree, so rebuild from
+        # the same seed the engine will use.
+        from gofr_tpu.models import llama as _llama
+
+        engine_kw["spec_draft"] = (_llama, cfg, _llama.init(cfg, jax.random.key(3)))
     eng = build_engine(ModelSpec(family="llama", task="generate", config=cfg),
                        container, seed=3, **engine_kw)
     prompts = [[i + 1, (2 * i) % 200 + 1, (7 * i) % 150 + 1] for i in range(n_requests)]

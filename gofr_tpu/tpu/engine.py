@@ -1833,6 +1833,12 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
                 draft_kw, dfamily, container, seed=1, rules=rules, mesh=mesh,
                 what=f"spec_draft {draft_kw.family}")
             draft_kw = (dfamily, dcfg, dparams)
+        elif draft_kw is not None:
+            # prebuilt (family, cfg, params) triple: shard the draft over
+            # the mesh like everything else the programs close over
+            dfamily, dcfg, dparams = draft_kw
+            draft_kw = (dfamily, dcfg,
+                        shard_pytree(dparams, dfamily.param_axes(dcfg), rules, mesh))
         if draft_kw is not None:
             kw["spec_draft"] = draft_kw
         # multi-host: every process must issue identical global programs;

@@ -123,3 +123,105 @@ def test_unknown_alg_rejected():
     payload = _b64url(json.dumps({"sub": "x"}).encode())
     with pytest.raises(ValueError, match="unsupported alg"):
         verify_jwt(f"{header}.{payload}.", hs_secret=b"s")
+
+
+class TestJWKSRotation:
+    """Key-rotation behavior of the background-refresh cache (reference
+    oauth.go:53-71): new kids become verifiable after refresh, stale kids
+    stop, and a FAILING fetch must keep serving the last good key set
+    (availability over freshness, same as the reference's ticker)."""
+
+    def _server(self, jwks_box):
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if jwks_box.get("fail"):
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                body = json.dumps(jwks_box["jwks"]).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, f"http://127.0.0.1:{srv.server_address[1]}/jwks"
+
+    @staticmethod
+    def _jwk(key, kid):
+        pub = key.public_key().public_numbers()
+
+        def int_b64(n):
+            return _b64url(n.to_bytes((n.bit_length() + 7) // 8, "big"))
+
+        return {"kty": "RSA", "kid": kid, "n": int_b64(pub.n), "e": int_b64(pub.e)}
+
+    def test_rotation_and_stale_keys_on_failure(self, rsa_key):
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        key_b = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        box = {"jwks": {"keys": [self._jwk(rsa_key, "kid-a")]}}
+        srv, url = self._server(box)
+        try:
+            cache = JWKSCache(url, refresh_interval=3600)
+            cache.refresh()
+            tok_a = make_rs256(rsa_key, {"sub": "x"}, kid="kid-a")
+            tok_b = make_rs256(key_b, {"sub": "y"}, kid="kid-b")
+            assert verify_jwt(tok_a, jwks=cache)["sub"] == "x"
+            with pytest.raises(ValueError):
+                verify_jwt(tok_b, jwks=cache)
+
+            # rotate: kid-a retired, kid-b published
+            box["jwks"] = {"keys": [self._jwk(key_b, "kid-b")]}
+            cache.refresh()
+            assert verify_jwt(tok_b, jwks=cache)["sub"] == "y"
+            with pytest.raises(ValueError):
+                verify_jwt(tok_a, jwks=cache)
+
+            # endpoint down: the last good key set keeps serving
+            box["fail"] = True
+            cache.refresh()
+            assert verify_jwt(tok_b, jwks=cache)["sub"] == "y"
+        finally:
+            srv.shutdown()
+
+    def test_concurrent_verify_during_rotation_never_errors_spuriously(self, rsa_key):
+        """Verifiers racing refresh() must always see a CONSISTENT key set
+        (the whole dict swaps under the lock): a token signed by the
+        currently-published key verifies, never a KeyError/partial state."""
+        box = {"jwks": {"keys": [self._jwk(rsa_key, "kid-a")]}}
+        srv, url = self._server(box)
+        try:
+            cache = JWKSCache(url, refresh_interval=3600)
+            cache.refresh()
+            tok = make_rs256(rsa_key, {"sub": "x"}, kid="kid-a")
+            stop = threading.Event()
+            errors = []
+
+            def churn():
+                while not stop.is_set():
+                    cache.refresh()
+
+            def verify_loop():
+                while not stop.is_set():
+                    try:
+                        assert verify_jwt(tok, jwks=cache)["sub"] == "x"
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+
+            ts = [threading.Thread(target=churn)] + [
+                threading.Thread(target=verify_loop) for _ in range(3)]
+            for t in ts:
+                t.start()
+            time.sleep(1.0)
+            stop.set()
+            for t in ts:
+                t.join()
+            assert not errors, errors
+        finally:
+            srv.shutdown()

@@ -8,6 +8,7 @@ import time
 from dataclasses import dataclass
 
 import httpx
+import pytest
 
 import gofr_tpu.app as appmod
 from gofr_tpu.config import DictConfig
@@ -352,3 +353,85 @@ def test_subscriber_workers_parallel_consumption():
         while time.time() < deadline and len(seen) < 12:
             time.sleep(0.02)
     assert sorted(seen) == list(range(12)), seen
+
+
+def test_cors_preflight_variants():
+    """Preflight edge cases the reference's middleware tier covers: custom
+    env-configured origin/headers/methods win; preflight succeeds on any
+    path (including unregistered); actual responses carry the headers too
+    without clobbering handler-set values."""
+    app = make_app({
+        "ACCESS_CONTROL_ALLOW_ORIGIN": "https://app.example",
+        "ACCESS_CONTROL_ALLOW_HEADERS": "X-Custom, Authorization",
+        "ACCESS_CONTROL_ALLOW_METHODS": "GET, PATCH",
+    })
+    app.get("/y", lambda ctx: "y")
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as client:
+        r = client.options("/y", headers={
+            "Origin": "https://app.example",
+            "Access-Control-Request-Method": "PATCH",
+            "Access-Control-Request-Headers": "X-Custom",
+        })
+        assert r.status_code == 200
+        assert r.headers["Access-Control-Allow-Origin"] == "https://app.example"
+        assert r.headers["Access-Control-Allow-Methods"] == "GET, PATCH"
+        assert "X-Custom" in r.headers["Access-Control-Allow-Headers"]
+        # preflight for a path with no registered handler still answers
+        # (the reference registers OPTIONS at the router level)
+        r2 = client.options("/never-registered")
+        assert r2.status_code == 200
+        assert r2.headers["Access-Control-Allow-Origin"] == "https://app.example"
+        # non-preflight responses carry CORS headers as well
+        r3 = client.get("/y")
+        assert r3.status_code == 200
+        assert r3.headers["Access-Control-Allow-Origin"] == "https://app.example"
+
+
+def test_multipart_malformed_bodies():
+    """Malformed multipart bodies must produce clean BindErrors or safe
+    degradation — never a 500 from an uncaught parser crash."""
+    import dataclasses
+
+    from gofr_tpu.utils.bind import BindError
+    from gofr_tpu.http.multipart import bind_multipart, parse_multipart
+
+    # no boundary parameter at all
+    with pytest.raises(BindError, match="boundary"):
+        parse_multipart("multipart/form-data", b"--x\r\n\r\nhi\r\n--x--")
+
+    b = "multipart/form-data; boundary=BB"
+    # part without a content-disposition name is skipped, not fatal
+    body = (b"--BB\r\ncontent-type: text/plain\r\n\r\norphan\r\n"
+            b"--BB\r\ncontent-disposition: form-data; name=\"a\"\r\n\r\nva\r\n--BB--")
+    parts = parse_multipart(b, body)
+    assert [p[0] for p in parts] == ["a"] and parts[0][3] == b"va"
+
+    # headers but no blank line: data degrades to empty, no crash
+    parts = parse_multipart(b, b"--BB\r\ncontent-disposition: form-data; name=\"h\"\r\n--BB--")
+    assert parts == [("h", None, "application/octet-stream", b"")]
+
+    # trailing CRLF inside the content is PRESERVED (only delimiter CRLFs
+    # stripped) and binary bytes pass through undecoded
+    payload = b"\x00\x01\r\n"
+    body = (b"--BB\r\ncontent-disposition: form-data; name=\"f\"; filename=\"x.bin\"\r\n"
+            b"content-type: application/octet-stream\r\n\r\n" + payload + b"\r\n--BB--")
+    (name, fname, ctype, data), = parse_multipart(b, body)
+    assert (name, fname, ctype, data) == ("f", "x.bin", "application/octet-stream", payload)
+
+    # dataclass bind: unknown fields ignored, missing field -> None default
+    @dataclasses.dataclass
+    class Form:
+        a: str = ""
+        missing: str | None = None
+
+    bound = bind_multipart(
+        b,
+        b"--BB\r\ncontent-disposition: form-data; name=\"a\"\r\n\r\nhello\r\n"
+        b"--BB\r\ncontent-disposition: form-data; name=\"zzz\"\r\n\r\nskip\r\n--BB--",
+        Form,
+    )
+    assert bound.a == "hello" and bound.missing is None
+
+    # bind target that is neither dataclass nor dict is a BindError
+    with pytest.raises(BindError):
+        bind_multipart(b, b"--BB--", object)

@@ -116,3 +116,11 @@ def test_pp_microbatches_must_divide_slots():
     with pytest.raises(ValueError, match="does not divide the slot count"):
         build_engine(ModelSpec("llama", cfg, task="generate"), c, seed=3,
                      slots=4, max_len=64, max_prefill_batch=1)
+
+
+def test_draft_model_spec_on_tp_mesh():
+    """Round-5 draft-model speculation under GSPMD: the draft's decode
+    loop + the target verify must partition over tp and stay token-exact
+    (self-draft, so acceptance also proves the sharded draft is coherent)."""
+    check_mesh_serving({"TPU_MESH": "dp:2,tp:4"}, kv_layout="slot",
+                       spec_tokens=2, decode_chunk=4, spec_self_draft=True)
