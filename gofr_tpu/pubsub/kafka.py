@@ -48,6 +48,12 @@ class KafkaBroker:
                     bootstrap_servers=self._brokers,
                     group_id=group or "gofr-tpu",
                     enable_auto_commit=False,
+                    # a NEW group must start from the log's beginning, not
+                    # its end — with 'latest' (the client default) any
+                    # message published before the group's first poll is
+                    # silently skipped, breaking at-least-once for
+                    # publish-then-subscribe startups
+                    auto_offset_reset="earliest",
                 )
             return self._consumers[key]
 

@@ -20,6 +20,12 @@ Two engines:
 Shape discipline: every compiled signature is (batch_bucket, len_bucket)
 with power-of-two buckets, so the compile-cache population is tiny and
 steady-state serving is 100% cache hits (tracked in app_tpu_* metrics).
+
+Module layout (round-5 split): tpu/programs.py builds the jitted packed
+programs and documents every packed layout; tpu/decode.py holds the
+decode dispatch paths (plain + speculative, pipelined + synchronous);
+this file keeps engine state, admission/prefill, streaming, supervision,
+and the build_engine factory.
 """
 
 from __future__ import annotations
@@ -1736,6 +1742,11 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
     conf = container.config
 
     rules = tpu.rules
+    # the PRE-pp-override rules: the speculative draft shards with these —
+    # it is replicated/tp-sharded, never pipeline-layer-sharded (a 2-layer
+    # draft's stacked blocks cannot divide a pp axis, and sharding it over
+    # pp would contradict the draft's replicated-everywhere contract)
+    base_rules = rules
     mesh = tpu.mesh
     # popped unconditionally: the knob must be ignorable on non-pp meshes,
     # not crash GenerateEngine with an unexpected-keyword TypeError
@@ -1830,15 +1841,16 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
         if isinstance(draft_kw, ModelSpec):
             dfamily = get_family(draft_kw.family)
             dcfg, dparams = _resolve_weights(
-                draft_kw, dfamily, container, seed=1, rules=rules, mesh=mesh,
-                what=f"spec_draft {draft_kw.family}")
+                draft_kw, dfamily, container, seed=1, rules=base_rules,
+                mesh=mesh, what=f"spec_draft {draft_kw.family}")
             draft_kw = (dfamily, dcfg, dparams)
         elif draft_kw is not None:
             # prebuilt (family, cfg, params) triple: shard the draft over
             # the mesh like everything else the programs close over
+            # (base_rules: never the pp layer override — see above)
             dfamily, dcfg, dparams = draft_kw
             draft_kw = (dfamily, dcfg,
-                        shard_pytree(dparams, dfamily.param_axes(dcfg), rules, mesh))
+                        shard_pytree(dparams, dfamily.param_axes(dcfg), base_rules, mesh))
         if draft_kw is not None:
             kw["spec_draft"] = draft_kw
         # multi-host: every process must issue identical global programs;

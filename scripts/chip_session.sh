@@ -42,9 +42,15 @@ run sweep GOFR_BENCH_SWEEP=1 GOFR_BENCH_KV_QUANTIZE=int8
 # 6) kernel A/B (attention kernels) at the new operating point
 run pallas_ab GOFR_BENCH_PALLAS_AB=1 GOFR_BENCH_KV_QUANTIZE=int8
 
-# 7) speculative decoding: latency mode single-stream gain
+# 7) speculative decoding: latency mode single-stream gain. Round 5 made
+# slot-layout spec PIPELINED (device-resident state); the sync point
+# isolates what the pipelining contributes on top of drafting.
 run spec_latency GOFR_BENCH_LATENCY=1 GOFR_BENCH_SPEC=4 GOFR_BENCH_REQUESTS=64
+run spec_latency_sync GOFR_BENCH_LATENCY=1 GOFR_BENCH_SPEC=4 \
+    GOFR_BENCH_PIPELINE=1 GOFR_BENCH_REQUESTS=64
 run plain_latency GOFR_BENCH_LATENCY=1 GOFR_BENCH_REQUESTS=64
+# spec under THROUGHPUT (full slots): weight-read amortization at occupancy
+run spec_throughput GOFR_BENCH_SPEC=4
 
 # 8) shared-prefix workload (paged + prefix cache A/B)
 run prefix GOFR_BENCH_PREFIX=1 GOFR_BENCH_REQUESTS=128

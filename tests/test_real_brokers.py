@@ -87,18 +87,21 @@ class TestRealKafka:
         c = Container.create(DictConfig({
             "PUBSUB_BACKEND": "kafka",
             "PUBSUB_BROKER": KAFKA_BROKER,
-            "CONSUMER_GROUP": f"gofr-ci-{uuid.uuid4().hex[:8]}",
             "LOG_LEVEL": "ERROR",
         }))
         ps = c.pubsub
         assert ps is not None, "config-gated wiring did not connect kafka"
+        # fresh group per run (passed to subscribe — that is the group
+        # API); auto_offset_reset=earliest in the client means the
+        # pre-subscribe publish below is still delivered to the new group
+        group = f"gofr-ci-{uuid.uuid4().hex[:8]}"
         topic = f"gofr-ci-{uuid.uuid4().hex[:12]}"
         payload = f"hello-{time.time()}".encode()
         ps.publish(topic, payload)
         deadline = time.time() + 60
         got = None
         while time.time() < deadline and got is None:
-            msg = ps.subscribe(topic, timeout=5.0)
+            msg = ps.subscribe(topic, group=group, timeout=5.0)
             if msg is not None and bytes(msg.value) == payload:
                 got = msg
                 msg.commit()

@@ -3,6 +3,7 @@ verification must be BIT-IDENTICAL to plain greedy decode — acceptance rate
 only changes how many device rounds it takes, never the tokens."""
 
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -371,3 +372,55 @@ class TestDraftModelSpec:
             GenerateEngine(llama, cfg, params, new_mock_container(),
                            slots=2, max_len=64,
                            spec_draft=(llama, cfg, params))
+
+
+def test_gpt2_draft_model_spec():
+    """The draft path is family-protocol-generic: gpt2 drafting for a gpt2
+    target (self-draft => full agreement) stays bit-exact and accepts."""
+    from gofr_tpu.models import GPT2Config, gpt2
+
+    cfg = GPT2Config.tiny()
+    params = gpt2.init(cfg, jax.random.key(5))
+
+    def ref(prompt, n_new):
+        seq = list(prompt)
+        for _ in range(n_new):
+            logits = gpt2.forward(cfg, params, jnp.asarray([seq], jnp.int32))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        return seq[len(prompt):]
+
+    eng = GenerateEngine(gpt2, cfg, params, new_mock_container(),
+                         slots=2, max_len=64, max_prefill_batch=1,
+                         decode_chunk=4, spec_tokens=3,
+                         spec_draft=(gpt2, cfg, params))
+    try:
+        out = eng.generate([5, 3, 9], max_new_tokens=12, timeout=300)
+        assert out["tokens"] == ref([5, 3, 9], 12)
+        assert _counter(eng, "app_tpu_spec_accepted") > 0
+    finally:
+        eng.stop()
+
+
+def test_cancel_and_timeout_mid_pipelined_spec(setup):
+    """Requests cancelled/expired while spec rounds are IN FLIGHT must
+    complete with their error, free their slots for reuse, and leave
+    survivors bit-exact (slot-identity discard under the pipelined queue)."""
+    cfg, params, ref = setup
+    eng = make_engine(cfg, params, decode_pipeline=2, decode_chunk=2,
+                      spec_tokens=2, slots=4)
+    try:
+        victim1 = eng.submit([9, 9, 9], max_new_tokens=40)
+        victim2 = eng.submit([8, 8, 8], max_new_tokens=40, timeout=0.05)
+        survivor = eng.submit([5, 3, 9, 2], max_new_tokens=12)
+        time.sleep(0.2)
+        victim1.cancel()
+        out = survivor.result(timeout=300)
+        assert out["tokens"] == ref([5, 3, 9, 2], 12)
+        for v in (victim1, victim2):
+            with pytest.raises(Exception):
+                v.result(timeout=60)
+        # slots all free again; a fresh request is exact
+        out2 = eng.generate([2, 4, 6], max_new_tokens=8, timeout=300)
+        assert out2["tokens"] == ref([2, 4, 6], 8)
+    finally:
+        eng.stop()
