@@ -122,7 +122,8 @@ def spec_round(eng) -> bool:
 
 def dispatch_spec(eng) -> bool:
     """Assemble and asynchronously dispatch one SLOT-layout speculative
-    round. The host ships only [3, n]: per-lane (token, hlen, use_host).
+    round. The host ships only [5, n]: per-lane (token, hlen, use_host,
+    temperature) plus the rng step — never history, never logits.
     A lane with a round already in flight is driven by the device-
     resident spec carry (use_host=0); its worst-case advance is
     chunk_span per in-flight round, so lanes whose worst-case position
@@ -143,9 +144,10 @@ def dispatch_spec(eng) -> bool:
             lanes.append((i, s))
         if not lanes:
             return False
-        packed = np.zeros((3, n), np.int32)
+        packed = np.zeros((5, n), np.int32)
         packed[1, :] = eng._cache_len + 1  # inactive: every write lands OOB
         packed[2, :] = 1                   # inactive lanes are host-arbitrated
+        temps = np.zeros((n,), np.float32)
         for i, s in lanes:
             if s.inflight == 0:
                 # host knows this lane's exact (token, hlen) — it just
@@ -154,17 +156,21 @@ def dispatch_spec(eng) -> bool:
                 packed[1, i] = s.pos + 1
             else:
                 packed[2, i] = 0  # device carry owns (token, hlen)
+            temps[i] = float(s.request.kw.get("temperature", 0.0))
+        packed[3] = temps.view(np.int32)
+        eng._step_count += 1
+        packed[4, 0] = eng._step_count
         for _, s in lanes:
             s.inflight += 1
         occupancy = len(lanes) / n
         t0 = time.monotonic()
 
-    eng._announce(TAG_SPEC, 1, 0, packed)  # slot spec: a=1 → [3, n] payload
+    eng._announce(TAG_SPEC, 1, 0, packed)  # slot spec: a=1 → [5, n] payload
     carry = eng._spec_carry
     if carry is None:
         carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
     toks_dev, accs_dev, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
-        eng.params, eng.cache, k, jnp.asarray(packed), carry)
+        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), carry)
     eng._dq.append(("spec", (toks_dev, accs_dev), [(i, s) for i, s in lanes],
                     t0, occupancy, (n, k)))
     return True

@@ -200,17 +200,20 @@ class LockstepFollower:
                     del out
                 elif tag == TAG_SPEC:
                     if eng.kv_layout == "slot":
-                        # slot spec: a is a live flag, payload is [3, n],
+                        # slot spec: a is a live flag, payload is [5, n],
                         # and the device-resident (token, hlen) carry is
                         # reproduced because every process executes the
-                        # same deterministic (greedy) calls in order
-                        packed = self._recv((3, n))
+                        # same deterministic calls in order (sampled
+                        # requests too: the rng step rides the payload and
+                        # folds into the same config-seeded base key)
+                        packed = self._recv((5, n))
                         carry = eng._spec_carry
                         if carry is None:
                             carry = (jnp.zeros((n,), jnp.int32),
                                      jnp.zeros((n,), jnp.int32))
                         toks, accs, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
-                            eng.params, eng.cache, k, jnp.asarray(packed), carry)
+                            eng.params, eng._base_key, eng.cache, k,
+                            jnp.asarray(packed), carry)
                     else:
                         packed = self._recv((a, n))
                         toks, accs, eng.cache = eng._spec_chunk_fn(

@@ -21,17 +21,17 @@ block-table columns for paged):
   (re)joined decode); 0 = take the on-device ``prev_last`` carry from the
   previous dispatched chunk (lane has a chunk in flight the host hasn't
   read back yet).
-- Spec (slot) ``[3, n]``: ``[0]`` input token | ``[1]`` history length
+- Spec (slot) ``[5, n]``: ``[0]`` input token | ``[1]`` history length
   (the input token is hist[hlen-1], its KV goes to position hlen-1)
   | ``[2]`` use_host flags — same arbitration as decode row 4, against a
   device-resident ``(token, hlen)`` carry, which is what lets spec rounds
-  ride the pipelined dispatch queue. The token HISTORY itself never
-  leaves the device: with spec on, the slot cache is the pytree
-  ``(kv, hist)`` and the prefill programs write each admitted prompt
-  (plus its sampled first token) into ``hist`` rows on device, so the
-  host never re-ships O(pos) history per round. Inactive lanes ship
-  use_host=1 with hlen = H + 1: every cache/history write lands out of
-  bounds and drops.
+  ride the pipelined dispatch queue | ``[3]`` temps (f32 bitcast)
+  | ``[4, 0]`` rng step. The token HISTORY itself never leaves the
+  device: with spec on, the slot cache is the pytree ``(kv, hist)`` and
+  the prefill programs write each admitted prompt (plus its sampled
+  first token) into ``hist`` rows on device, so the host never re-ships
+  O(pos) history per round. Inactive lanes ship use_host=1 with
+  hlen = H + 1: every cache/history write lands out of bounds and drops.
 - Spec (paged) ``[2 + Wp + Hcap, n]``: ``[0]`` input token | ``[1]``
   history length | ``[2:2+Wp]`` table.T | ``[2+Wp:]`` history.T.
   Inactive lanes ship hlen = Hcap + 1 AND an all-OOB table row.
@@ -47,6 +47,74 @@ import jax
 import jax.numpy as jnp
 
 from gofr_tpu.ops.sampling import sample_token
+
+
+def speculative_sample(key, p_logits, drafts, temps, q_logits=None):
+    """Distribution-exact speculative sampling for one verify step
+    (Leviathan/Chen rejection scheme): accept draft j with probability
+    min(1, p_j(d_j)/q_j(d_j)) while the prefix holds, then sample the
+    correction from norm((p_acc − q_acc)+) — or, on full acceptance, the
+    bonus token from p_g. Each emitted token is distributed EXACTLY as a
+    plain sampled decode at the same position; rows with temperature <= 0
+    reduce bit-exactly to greedy (p collapses to the argmax one-hot, so
+    acceptance == argmax-match and the correction == the argmax).
+
+    ``p_logits`` [n, g+1, V] target logits; ``drafts`` [n, g] proposals;
+    ``temps`` [n]; ``q_logits`` [n, g, V] draft-model logits, or None for
+    DETERMINISTIC proposals (prompt-lookup: q is the one-hot at the
+    proposal, so the accept test is u < p(d) and the residual is p with
+    the rejected token zeroed).
+
+    Returns ``(out [n, g+1] int32, acc [n] int32)``: ``out[:, :acc]`` are
+    the accepted drafts, ``out[:, acc]`` the correction/bonus; entries
+    past ``acc`` are garbage the caller discards. Exposed at module level
+    so the distribution guarantee is testable directly (test_spec_decode).
+    """
+    n, gp1, vocab = p_logits.shape
+    g = gp1 - 1
+    greedy_rows = (temps <= 0)[:, None, None]
+    temp = jnp.maximum(temps, 1e-6)[:, None, None]
+    p = jax.nn.softmax(p_logits.astype(jnp.float32) / temp, axis=-1)
+    p = jnp.where(
+        greedy_rows,
+        jax.nn.one_hot(jnp.argmax(p_logits, -1), vocab, dtype=jnp.float32),
+        p,
+    )
+    if q_logits is None:
+        q_d = jnp.ones((n, g), jnp.float32)
+    else:
+        q = jax.nn.softmax(q_logits.astype(jnp.float32) / temp, axis=-1)
+        q = jnp.where(
+            greedy_rows,
+            jax.nn.one_hot(jnp.argmax(q_logits, -1), vocab, dtype=jnp.float32),
+            q,
+        )
+        q_d = jnp.take_along_axis(q, drafts[..., None], axis=-1)[..., 0]
+    p_d = jnp.take_along_axis(p[:, :g], drafts[..., None], axis=-1)[..., 0]
+    ku, kr = jax.random.split(key)
+    u = jax.random.uniform(ku, (n, g))
+    ok = (u * q_d < p_d).astype(jnp.int32)
+    ok = jnp.cumprod(ok, axis=1)
+    acc = ok.sum(axis=1)  # leading accepted drafts per lane, 0..g
+    p_sel = jnp.take_along_axis(p, acc[:, None, None], axis=1)[:, 0]  # [n, V]
+    if q_logits is None:
+        d_at = jnp.take_along_axis(
+            drafts, jnp.minimum(acc, g - 1)[:, None], axis=1)[:, 0]
+        q_sel = jnp.where((acc < g)[:, None],
+                          jax.nn.one_hot(d_at, vocab, dtype=jnp.float32), 0.0)
+    else:
+        q_pad = jnp.concatenate([q, jnp.zeros((n, 1, vocab), q.dtype)], axis=1)
+        q_sel = jnp.take_along_axis(q_pad, acc[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(p_sel - q_sel, 0.0)
+    rs = resid.sum(-1, keepdims=True)
+    # p == q at the rejection point is a zero residual only when the
+    # rejection had probability zero — sampling p there is equivalent
+    resid = jnp.where(rs > 0, resid, p_sel)
+    corr = jax.random.categorical(
+        kr, jnp.log(jnp.maximum(resid, 1e-38)), axis=-1).astype(jnp.int32)
+    out = jnp.concatenate([drafts, jnp.zeros((n, 1), jnp.int32)], axis=1)
+    out = out.at[jnp.arange(n), acc].set(corr)
+    return out, acc
 
 
 def unpack_prefill(packed, w, chunked=False):
@@ -287,65 +355,67 @@ def build_programs(
             g = spec_tokens
             H = cache_len
 
-            @partial(jax.jit, static_argnums=(2,), donate_argnums=(1, 4))
-            def _spec_chunk(params, cache, steps, packed, carry):
+            @partial(jax.jit, static_argnums=(3,), donate_argnums=(2, 5))
+            def _spec_chunk(params, base_key, cache, steps, packed, carry):
                 kv, aux0 = cache
                 n_l = packed.shape[1]
                 use_host = packed[2] != 0
                 tok0 = jnp.where(use_host, packed[0], carry[0])
                 hlen0 = jnp.where(use_host, packed[1], carry[1])
+                temps = jax.lax.bitcast_convert_type(packed[3], jnp.float32)
+                key0 = jax.random.fold_in(base_key, packed[4, 0])
                 idx = jnp.arange(H)
 
                 def outer(loop, _):
-                    tok, hlen, aux, kv = loop
+                    tok, hlen, aux, kv, key = loop
+                    key, kd, ks = jax.random.split(key, 3)
                     pos = hlen - 1
+                    q_logits = None
                     if draft is None:
                         # prompt-lookup draft: continuation after the most
                         # recent EARLIER occurrence of the current token
+                        # (a DETERMINISTIC proposal — one-hot q)
                         match = (aux == tok[:, None]) & (idx[None, :] < pos[:, None])
                         j = jnp.where(match, idx[None, :], -1).max(axis=1)  # -1 = miss
                         take = jnp.clip(j[:, None] + 1 + jnp.arange(g)[None, :], 0, H - 1)
                         drafts = jnp.take_along_axis(aux, take, axis=1)  # [n, g]
                     else:
-                        # draft-model proposal: g+1 autoregressive greedy
-                        # steps of the (tiny) draft, its KV cache riding in
-                        # aux. g+1, not g: the extra step's OUTPUT is
-                        # discarded but its input write puts the g-th
-                        # draft's KV at pos+g — without it, a fully-
-                        # accepted round would leave a PERMANENT hole there
-                        # (the next round starts writing at pos+g+1) and
-                        # acceptance would silently decay with generation
-                        # length, worst in the high-acceptance regime the
-                        # draft exists for. With the write, the draft KV
-                        # covers pos..pos+g like the target's verify write,
-                        # and on partial acceptance the next round's writes
-                        # from the new pos re-cover every stale entry
-                        # before its attention can see it.
+                        # draft-model proposal: g+1 autoregressive steps of
+                        # the (tiny) draft, its KV cache riding in aux,
+                        # SAMPLED at each lane's temperature (greedy rows
+                        # decode greedily — sample_token semantics). g+1,
+                        # not g: the extra step's OUTPUT is discarded but
+                        # its input write puts the g-th draft's KV at
+                        # pos+g — without it, a fully-accepted round would
+                        # leave a PERMANENT hole there (the next round
+                        # starts writing at pos+g+1) and acceptance would
+                        # silently decay with generation length, worst in
+                        # the high-acceptance regime the draft exists for.
                         def dstep(c, _):
-                            dtok, dpos, dkv = c
+                            dtok, dpos, dkv, dkey = c
                             dlogits, dkv = dfamily.decode_step(
                                 dcfg, params["d"], dtok, dpos, dkv)
-                            nxt_d = jnp.argmax(dlogits, -1).astype(jnp.int32)
-                            return (nxt_d, dpos + 1, dkv), nxt_d
+                            dkey, dsub = jax.random.split(dkey)
+                            nxt_d = sample_token(dlogits, dsub, temperature=temps)
+                            return (nxt_d, dpos + 1, dkv, dkey), (nxt_d, dlogits)
 
-                        (_, _, aux), drafts_t = jax.lax.scan(
-                            dstep, (tok, pos, aux), None, length=g + 1)
-                        drafts = drafts_t[:g].T  # [n, g]
+                        (_, _, aux, _), (drafts_t, dlogits_t) = jax.lax.scan(
+                            dstep, (tok, pos, aux, kd), None, length=g + 1)
+                        drafts = drafts_t[:g].T            # [n, g]
+                        q_logits = dlogits_t[:g].swapaxes(0, 1)  # [n, g, V]
                     seq = jnp.concatenate([tok[:, None], drafts], axis=1)
                     logits, kv = family.verify_step(cfg, _tparams(params), seq, pos, kv)
-                    tgt = jnp.argmax(logits, -1).astype(jnp.int32)  # [n, g+1]
-                    ok = jnp.cumprod((drafts == tgt[:, :g]).astype(jnp.int32), axis=1)
-                    acc = ok.sum(axis=1)  # accepted drafts per lane, 0..g
-                    nxt = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
+                    out, acc = speculative_sample(ks, logits, drafts, temps, q_logits)
+                    nxt = jnp.take_along_axis(out, acc[:, None], axis=1)[:, 0]
                     if draft is None:
                         emit = jnp.arange(g + 1)[None, :] <= acc[:, None]
                         wpos = jnp.where(emit, hlen[:, None] + jnp.arange(g + 1)[None, :], H)
                         aux = aux.at[jnp.arange(n_l)[:, None], wpos].set(
-                            tgt, mode="drop")
-                    return (nxt, hlen + acc + 1, aux, kv), (tgt, acc)
+                            out, mode="drop")
+                    return (nxt, hlen + acc + 1, aux, kv, key), (out, acc)
 
-                (tok_f, hlen_f, aux, kv), (toks, accs) = jax.lax.scan(
-                    outer, (tok0, hlen0, aux0, kv), None, length=steps
+                (tok_f, hlen_f, aux, kv, _), (toks, accs) = jax.lax.scan(
+                    outer, (tok0, hlen0, aux0, kv, key0), None, length=steps
                 )
                 # [K, n, g+1], [K, n], cache, next-round (token, hlen) carry
                 return toks, accs, (kv, aux), (tok_f, hlen_f)

@@ -104,10 +104,13 @@ class TestRejectedCombinations:
                            slots=2, max_len=64, kv_layout="paged",
                            spec_tokens=2, spec_draft=(llama, cfg, params))
 
-    def test_spec_rejects_sampling(self, setup):
+    def test_paged_spec_rejects_sampling(self, setup):
+        # slot-layout spec SERVES sampled requests (rejection sampling,
+        # test_spec_decode); the paged layout is greedy-only
         cfg, params, _, _ = setup
         eng = GenerateEngine(llama, cfg, params, new_mock_container(),
-                             slots=2, max_len=64, spec_tokens=2)
+                             slots=2, max_len=64, kv_layout="paged",
+                             page_size=8, spec_tokens=2)
         try:
             with pytest.raises(ValueError, match="greedy-only"):
                 eng.generate([3, 7, 9], max_new_tokens=4, temperature=0.7,

@@ -141,14 +141,30 @@ class TestSpecEngine:
         finally:
             eng.stop()
 
-    def test_sampling_rejected(self, setup):
+    def test_sampled_requests_served_on_slot_rejected_on_paged(self, setup):
+        """Round 5: slot-layout spec serves SAMPLED requests through
+        distribution-exact rejection sampling (speculative_sample); the
+        paged layout stays greedy-only with a clear error."""
         cfg, params, _ = setup
         eng = make_engine(cfg, params)
         try:
-            with pytest.raises(ValueError, match="greedy-only"):
-                eng.generate([5, 3, 9], max_new_tokens=4, temperature=0.8, timeout=120)
+            out = eng.generate([5, 3, 9], max_new_tokens=12, temperature=0.8,
+                               timeout=300)
+            assert len(out["tokens"]) == 12
+            # greedy and sampled requests mix in the same engine
+            out2 = eng.generate([5, 3, 9], max_new_tokens=4, timeout=300)
+            assert len(out2["tokens"]) == 4
         finally:
             eng.stop()
+        engp = make_engine(cfg, params, kv_layout="paged", page_size=8)
+        try:
+            with pytest.raises(ValueError, match="greedy-only"):
+                engp.generate([5, 3, 9], max_new_tokens=4, temperature=0.8,
+                              timeout=120)
+        finally:
+            engp.stop()
+        with pytest.raises(ValueError, match="top_k/top_p"):
+            make_engine(cfg, params, top_k=5)
 
     def test_paged_layout_matches_reference(self, setup):
         """Speculation on the PAGED layout (llama's default): verification
@@ -424,3 +440,74 @@ def test_cancel_and_timeout_mid_pipelined_spec(setup):
         assert out2["tokens"] == ref([2, 4, 6], 8)
     finally:
         eng.stop()
+
+
+class TestSpeculativeSample:
+    """Distribution guarantee of the rejection-sampling core
+    (tpu/programs.speculative_sample): position-0 output must be
+    distributed exactly as the target softmax, for both deterministic
+    (one-hot q) and draft-model proposals — and T<=0 rows must reduce
+    bit-exactly to greedy."""
+
+    V = 11
+
+    def _marginal(self, p_logits, drafts, temps, q_logits, n_keys=20000):
+        from gofr_tpu.tpu.programs import speculative_sample
+
+        keys = jax.random.split(jax.random.key(0), n_keys)
+        outs, _ = jax.vmap(
+            lambda k: speculative_sample(k, p_logits, drafts, temps, q_logits)
+        )(keys)
+        first = np.asarray(outs[:, 0, 0])  # lane 0, position 0
+        return np.bincount(first, minlength=self.V) / n_keys
+
+    def test_lookup_proposal_marginal_matches_target(self):
+        p_logits = jax.random.normal(jax.random.key(3), (1, 3, self.V)) * 2.0
+        drafts = jnp.asarray([[4, 7]], jnp.int32)
+        temps = jnp.asarray([1.0], jnp.float32)
+        want = np.asarray(jax.nn.softmax(p_logits[0, 0]))
+        got = self._marginal(p_logits, drafts, temps, None)
+        assert np.abs(got - want).sum() < 0.05, (got, want)
+
+    def test_draft_model_proposal_marginal_matches_target(self):
+        """The guarantee holds when proposals are SAMPLED from q (as the
+        spec program does) — the combined draw+accept+correct pipeline's
+        output must be distributed as the target softmax even though q is
+        a very different distribution."""
+        from gofr_tpu.tpu.programs import speculative_sample
+
+        p_logits = jax.random.normal(jax.random.key(5), (1, 3, self.V)) * 2.0
+        q_logits = jax.random.normal(jax.random.key(6), (1, 2, self.V)) * 2.0
+        temps = jnp.asarray([0.7], jnp.float32)
+
+        def one(k):
+            kd, ks = jax.random.split(k)
+            drafts = jax.random.categorical(
+                kd, q_logits[0] / 0.7, axis=-1).astype(jnp.int32)[None, :]
+            out, acc = speculative_sample(ks, p_logits, drafts, temps, q_logits)
+            return out
+
+        n_keys = 20000
+        keys = jax.random.split(jax.random.key(0), n_keys)
+        outs = jax.vmap(one)(keys)
+        got = np.bincount(np.asarray(outs[:, 0, 0]), minlength=self.V) / n_keys
+        want = np.asarray(jax.nn.softmax(p_logits[0, 0] / 0.7))
+        assert np.abs(got - want).sum() < 0.05, (got, want)
+
+    def test_greedy_rows_reduce_to_argmax(self):
+        from gofr_tpu.tpu.programs import speculative_sample
+
+        p_logits = jax.random.normal(jax.random.key(9), (2, 4, self.V))
+        am = np.asarray(jnp.argmax(p_logits, -1))  # [2, 4]
+        # lane 0: drafts follow the argmax chain -> all accepted + bonus;
+        # lane 1: first draft wrong -> correction at position 0
+        drafts = jnp.asarray([[am[0, 0], am[0, 1], am[0, 2]],
+                              [(am[1, 0] + 1) % self.V, am[1, 1], am[1, 2]]],
+                             jnp.int32)
+        temps = jnp.zeros((2,), jnp.float32)
+        out, acc = speculative_sample(
+            jax.random.key(1), p_logits, drafts, temps, None)
+        out, acc = np.asarray(out), np.asarray(acc)
+        assert acc.tolist() == [3, 0]
+        assert out[0, :4].tolist() == am[0].tolist()  # drafts + bonus
+        assert out[1, 0] == am[1, 0]  # correction = the argmax
