@@ -89,9 +89,10 @@ def spec_round(eng) -> bool:
             return True  # preemption work happened
         W = eng.pages_per_slot
         H = W * eng.page_size
-        packed = np.zeros((2 + W + H, n), np.int32)
+        packed = np.zeros((4 + W + H, n), np.int32)
         packed[1, :] = H + 1  # inactive lanes: every write lands OOB
-        packed[2:2 + W] = eng._masked_table({i for i, _ in lanes}).T
+        temps = np.zeros((n,), np.float32)
+        packed[4:4 + W] = eng._masked_table({i for i, _ in lanes}).T
         for i, s in lanes:
             hist = np.concatenate([
                 np.asarray(s.prompt_tokens, np.int32),
@@ -99,14 +100,18 @@ def spec_round(eng) -> bool:
             ])
             packed[0, i] = s.last_token
             packed[1, i] = hist.shape[0]  # == s.pos + 1
-            packed[2 + W:2 + W + hist.shape[0], i] = hist
+            packed[4 + W:4 + W + hist.shape[0], i] = hist
+            temps[i] = float(s.request.kw.get("temperature", 0.0))
+        packed[2] = temps.view(np.int32)
+        eng._step_count += 1
+        packed[3, 0] = eng._step_count
         occupancy = len(lanes) / n
         eng._inflight = [s.request for _, s in lanes]
         t0 = time.monotonic()
 
     eng._announce(TAG_SPEC, packed.shape[0], 0, packed)
     toks_dev, accs_dev, eng.cache = eng._spec_chunk_fn(
-        eng.params, eng.cache, k, jnp.asarray(packed))
+        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed))
     toks = np.asarray(toks_dev)  # [k, n, g+1] int32 — tokens, never logits
     accs = np.asarray(accs_dev)  # [k, n]
 

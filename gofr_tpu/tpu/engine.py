@@ -614,17 +614,6 @@ class GenerateEngine(_EngineBase):
                     f"family {getattr(family, '__name__', family)!r} has no {need}; "
                     "speculative decoding needs it"
                 )
-            if kv_layout == "slot" and (top_k or top_p < 1.0):
-                # rejection sampling is distribution-exact w.r.t. the FULL
-                # softmax; composing it with truncation requires truncating
-                # p AND q identically, which the spec program does not do
-                # (plain decode supports top_k/top_p; spec does not yet).
-                # Paged spec is exempt: it stays greedy-only, so the
-                # truncation settings never reach a spec sample.
-                raise ValueError(
-                    "speculative decoding does not compose with top_k/top_p "
-                    "truncation yet: temperature-only sampling (or greedy)"
-                )
         # Draft-model speculative decoding (VERDICT r4 #4): spec_draft is a
         # (family, cfg, params) triple for a small model sharing the target's
         # tokenizer/vocab. Drafts come from g autoregressive draft-model
@@ -917,12 +906,13 @@ class GenerateEngine(_EngineBase):
         if self.spec_tokens:
             if self.kv_layout == "paged":
                 sw, sh = self.pages_per_slot, self.pages_per_slot * self.page_size
-                spec_packed = np.zeros((2 + sw + sh, n), np.int32)
+                spec_packed = np.zeros((4 + sw + sh, n), np.int32)
                 spec_packed[1, :] = sh + 1  # all lanes OOB
-                spec_packed[2:2 + sw] = self.total_pages  # all-OOB tables
-                self._announce(TAG_SPEC, 2 + sw + sh, 0, spec_packed)
+                spec_packed[4:4 + sw] = self.total_pages  # all-OOB tables
+                self._announce(TAG_SPEC, 4 + sw + sh, 0, spec_packed)
                 toks, _, self.cache = self._spec_chunk_fn(
-                    self.params, self.cache, k, jnp.asarray(spec_packed))
+                    self.params, self._base_key, self.cache, k,
+                    jnp.asarray(spec_packed))
             else:
                 # slot layout: all lanes host-arbitrated and OOB, so no
                 # cache/history write survives; the carry is stored (same on
@@ -1334,14 +1324,6 @@ class GenerateEngine(_EngineBase):
                     raise ValueError(f"prompt must be a non-empty 1-D token sequence, got shape {toks.shape}")
                 if toks.shape[0] >= self.max_len:
                     raise ValueError(f"prompt length {toks.shape[0]} ≥ engine max_len {self.max_len}")
-                if (self.spec_tokens and self.kv_layout == "paged"
-                        and float(req.kw.get("temperature", 0.0)) != 0.0):
-                    raise ValueError(
-                        "paged-layout speculative decoding is greedy-only: "
-                        "temperature must be 0 (the slot layout supports "
-                        "sampled requests via distribution-exact rejection "
-                        "sampling — tpu/programs.py speculative_sample)"
-                    )
                 if toks.shape[0] > self.prefill_buckets[-1]:
                     if not self._chunked_ok:
                         raise ValueError(

@@ -217,7 +217,8 @@ class LockstepFollower:
                     else:
                         packed = self._recv((a, n))
                         toks, accs, eng.cache = eng._spec_chunk_fn(
-                            eng.params, eng.cache, k, jnp.asarray(packed))
+                            eng.params, eng._base_key, eng.cache, k,
+                            jnp.asarray(packed))
                     del toks, accs
                 else:  # pragma: no cover - protocol corruption
                     raise RuntimeError(f"lockstep follower: unknown tag {tag}")

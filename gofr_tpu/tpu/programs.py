@@ -32,9 +32,10 @@ block-table columns for paged):
   first token) into ``hist`` rows on device, so the host never re-ships
   O(pos) history per round. Inactive lanes ship use_host=1 with
   hlen = H + 1: every cache/history write lands out of bounds and drops.
-- Spec (paged) ``[2 + Wp + Hcap, n]``: ``[0]`` input token | ``[1]``
-  history length | ``[2:2+Wp]`` table.T | ``[2+Wp:]`` history.T.
-  Inactive lanes ship hlen = Hcap + 1 AND an all-OOB table row.
+- Spec (paged) ``[4 + Wp + Hcap, n]``: ``[0]`` input token | ``[1]``
+  history length | ``[2]`` temps (f32 bitcast) | ``[3, 0]`` rng step
+  | ``[4:4+Wp]`` table.T | ``[4+Wp:]`` history.T. Inactive lanes ship
+  hlen = Hcap + 1 AND an all-OOB table row.
 """
 
 from __future__ import annotations
@@ -46,10 +47,11 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from gofr_tpu.ops.sampling import sample_token
+from gofr_tpu.ops.sampling import sample_token, truncate_logits
 
 
-def speculative_sample(key, p_logits, drafts, temps, q_logits=None):
+def speculative_sample(key, p_logits, drafts, temps, q_logits=None,
+                       top_k=0, top_p=1.0):
     """Distribution-exact speculative sampling for one verify step
     (Leviathan/Chen rejection scheme): accept draft j with probability
     min(1, p_j(d_j)/q_j(d_j)) while the prefix holds, then sample the
@@ -65,6 +67,13 @@ def speculative_sample(key, p_logits, drafts, temps, q_logits=None):
     proposal, so the accept test is u < p(d) and the residual is p with
     the rejected token zeroed).
 
+    ``top_k``/``top_p`` (static) truncate p AND q with the IDENTICAL mask
+    `ops.sampling.truncate_logits` applies in plain decode — making each
+    emitted token exact w.r.t. the truncated target distribution (the
+    same distribution plain truncated sampling serves). The draft's
+    proposals must be sampled with the same truncation (the spec program
+    routes them through sample_token with these settings).
+
     Returns ``(out [n, g+1] int32, acc [n] int32)``: ``out[:, :acc]`` are
     the accepted drafts, ``out[:, acc]`` the correction/bonus; entries
     past ``acc`` are garbage the caller discards. Exposed at module level
@@ -74,7 +83,9 @@ def speculative_sample(key, p_logits, drafts, temps, q_logits=None):
     g = gp1 - 1
     greedy_rows = (temps <= 0)[:, None, None]
     temp = jnp.maximum(temps, 1e-6)[:, None, None]
-    p = jax.nn.softmax(p_logits.astype(jnp.float32) / temp, axis=-1)
+    p = jax.nn.softmax(
+        truncate_logits(p_logits.astype(jnp.float32) / temp, top_k, top_p),
+        axis=-1)
     p = jnp.where(
         greedy_rows,
         jax.nn.one_hot(jnp.argmax(p_logits, -1), vocab, dtype=jnp.float32),
@@ -83,7 +94,9 @@ def speculative_sample(key, p_logits, drafts, temps, q_logits=None):
     if q_logits is None:
         q_d = jnp.ones((n, g), jnp.float32)
     else:
-        q = jax.nn.softmax(q_logits.astype(jnp.float32) / temp, axis=-1)
+        q = jax.nn.softmax(
+            truncate_logits(q_logits.astype(jnp.float32) / temp, top_k, top_p),
+            axis=-1)
         q = jnp.where(
             greedy_rows,
             jax.nn.one_hot(jnp.argmax(q_logits, -1), vocab, dtype=jnp.float32),
@@ -224,17 +237,20 @@ def build_programs(
             Wp = pages_per_slot
             Hcap = Wp * page_size  # logical per-slot capacity
 
-            @partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
-            def _spec_chunk(params, cache, steps, packed):
+            @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
+            def _spec_chunk(params, base_key, cache, steps, packed):
                 n_l = packed.shape[1]
                 tok0 = packed[0]
                 hlen0 = packed[1]
-                table = packed[2:2 + Wp].T      # [n, Wp]
-                hist0 = packed[2 + Wp:].T       # [n, Hcap]
+                temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
+                key0 = jax.random.fold_in(base_key, packed[3, 0])
+                table = packed[4:4 + Wp].T      # [n, Wp]
+                hist0 = packed[4 + Wp:].T       # [n, Hcap]
                 idx = jnp.arange(Hcap)
 
                 def outer(carry, _):
-                    tok, hlen, hist, cache = carry
+                    tok, hlen, hist, cache, key = carry
+                    key, ks = jax.random.split(key)
                     pos = hlen - 1
                     match = (hist == tok[:, None]) & (idx[None, :] < pos[:, None])
                     j = jnp.where(match, idx[None, :], -1).max(axis=1)
@@ -243,17 +259,16 @@ def build_programs(
                     seq = jnp.concatenate([tok[:, None], drafts], axis=1)
                     logits, cache = family.verify_step_paged(
                         cfg, params, seq, pos, cache, table)
-                    tgt = jnp.argmax(logits, -1).astype(jnp.int32)
-                    ok = jnp.cumprod((drafts == tgt[:, :g]).astype(jnp.int32), axis=1)
-                    acc = ok.sum(axis=1)
-                    nxt = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
+                    out, acc = speculative_sample(ks, logits, drafts, temps,
+                                                  None, ts[0], ts[1])
+                    nxt = jnp.take_along_axis(out, acc[:, None], axis=1)[:, 0]
                     emit = jnp.arange(g + 1)[None, :] <= acc[:, None]
                     wpos = jnp.where(emit, hlen[:, None] + jnp.arange(g + 1)[None, :], Hcap)
-                    hist = hist.at[jnp.arange(n_l)[:, None], wpos].set(tgt, mode="drop")
-                    return (nxt, hlen + acc + 1, hist, cache), (tgt, acc)
+                    hist = hist.at[jnp.arange(n_l)[:, None], wpos].set(out, mode="drop")
+                    return (nxt, hlen + acc + 1, hist, cache, key), (out, acc)
 
-                (_, _, _, cache), (toks, accs) = jax.lax.scan(
-                    outer, (tok0, hlen0, hist0, cache), None, length=steps
+                (_, _, _, cache, _), (toks, accs) = jax.lax.scan(
+                    outer, (tok0, hlen0, hist0, cache, key0), None, length=steps
                 )
                 return toks, accs, cache
 
@@ -396,7 +411,8 @@ def build_programs(
                             dlogits, dkv = dfamily.decode_step(
                                 dcfg, params["d"], dtok, dpos, dkv)
                             dkey, dsub = jax.random.split(dkey)
-                            nxt_d = sample_token(dlogits, dsub, temperature=temps)
+                            nxt_d = sample_token(dlogits, dsub, temperature=temps,
+                                                 top_k=ts[0], top_p=ts[1])
                             return (nxt_d, dpos + 1, dkv, dkey), (nxt_d, dlogits)
 
                         (_, _, aux, _), (drafts_t, dlogits_t) = jax.lax.scan(
@@ -405,7 +421,8 @@ def build_programs(
                         q_logits = dlogits_t[:g].swapaxes(0, 1)  # [n, g, V]
                     seq = jnp.concatenate([tok[:, None], drafts], axis=1)
                     logits, kv = family.verify_step(cfg, _tparams(params), seq, pos, kv)
-                    out, acc = speculative_sample(ks, logits, drafts, temps, q_logits)
+                    out, acc = speculative_sample(ks, logits, drafts, temps,
+                                                  q_logits, ts[0], ts[1])
                     nxt = jnp.take_along_axis(out, acc[:, None], axis=1)[:, 0]
                     if draft is None:
                         emit = jnp.arange(g + 1)[None, :] <= acc[:, None]

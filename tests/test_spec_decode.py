@@ -141,30 +141,26 @@ class TestSpecEngine:
         finally:
             eng.stop()
 
-    def test_sampled_requests_served_on_slot_rejected_on_paged(self, setup):
-        """Round 5: slot-layout spec serves SAMPLED requests through
-        distribution-exact rejection sampling (speculative_sample); the
-        paged layout stays greedy-only with a clear error."""
-        cfg, params, _ = setup
-        eng = make_engine(cfg, params)
+    @pytest.mark.parametrize("layout_kw", [
+        {}, {"kv_layout": "paged", "page_size": 8}, {"top_k": 5},
+        {"top_p": 0.9}, {"kv_layout": "paged", "page_size": 8, "top_k": 5},
+    ])
+    def test_sampled_requests_served(self, setup, layout_kw):
+        """Round 5: spec serves SAMPLED requests on BOTH layouts through
+        distribution-exact rejection sampling (speculative_sample),
+        composing with top_k/top_p (p and q truncated identically)."""
+        cfg, params, ref = setup
+        eng = make_engine(cfg, params, **layout_kw)
         try:
             out = eng.generate([5, 3, 9], max_new_tokens=12, temperature=0.8,
                                timeout=300)
             assert len(out["tokens"]) == 12
-            # greedy and sampled requests mix in the same engine
-            out2 = eng.generate([5, 3, 9], max_new_tokens=4, timeout=300)
-            assert len(out2["tokens"]) == 4
+            # greedy and sampled requests mix in the same engine — and
+            # greedy stays BIT-EXACT alongside (truncation keeps top-1)
+            out2 = eng.generate([5, 3, 9], max_new_tokens=6, timeout=300)
+            assert out2["tokens"] == ref([5, 3, 9], 6)
         finally:
             eng.stop()
-        engp = make_engine(cfg, params, kv_layout="paged", page_size=8)
-        try:
-            with pytest.raises(ValueError, match="greedy-only"):
-                engp.generate([5, 3, 9], max_new_tokens=4, temperature=0.8,
-                              timeout=120)
-        finally:
-            engp.stop()
-        with pytest.raises(ValueError, match="top_k/top_p"):
-            make_engine(cfg, params, top_k=5)
 
     def test_paged_layout_matches_reference(self, setup):
         """Speculation on the PAGED layout (llama's default): verification
@@ -511,3 +507,26 @@ class TestSpeculativeSample:
         assert acc.tolist() == [3, 0]
         assert out[0, :4].tolist() == am[0].tolist()  # drafts + bonus
         assert out[1, 0] == am[1, 0]  # correction = the argmax
+
+
+    def test_truncated_marginal_matches_truncated_target(self):
+        """With top_k, the emitted marginal must equal the TRUNCATED
+        target softmax — the same distribution plain top_k sampling
+        serves — for the deterministic-proposal case."""
+        from gofr_tpu.ops.sampling import truncate_logits
+        from gofr_tpu.tpu.programs import speculative_sample
+
+        p_logits = jax.random.normal(jax.random.key(12), (1, 3, self.V)) * 2.0
+        drafts = jnp.asarray([[4, 7]], jnp.int32)
+        temps = jnp.asarray([0.9], jnp.float32)
+        n_keys = 20000
+        keys = jax.random.split(jax.random.key(2), n_keys)
+        outs, _ = jax.vmap(
+            lambda k: speculative_sample(k, p_logits, drafts, temps, None,
+                                         top_k=3)
+        )(keys)
+        got = np.bincount(np.asarray(outs[:, 0, 0]), minlength=self.V) / n_keys
+        want = np.asarray(jax.nn.softmax(
+            truncate_logits(p_logits[0, 0] / 0.9, top_k=3)))
+        assert np.abs(got - want).sum() < 0.05, (got, want)
+        assert (got[want < 1e-6] == 0).all(), "mass outside the top-k set"
