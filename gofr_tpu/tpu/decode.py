@@ -66,12 +66,13 @@ def spec_round(eng) -> bool:
     """One synchronous PAGED-layout speculative round: ``decode_chunk``
     outer steps, each drafting ``spec_tokens`` continuation tokens by
     prompt lookup and verifying them with ONE target forward
-    (family.verify_step_paged). Greedy acceptance makes the emitted
-    stream bit-identical to plain greedy decode; each round trip yields
-    up to decode_chunk*(spec_tokens+1) tokens per slot. Synchronous
-    because the next round's page allocation depends on this round's
-    acceptance counts. (The slot layout pipelines instead —
-    dispatch_spec.)"""
+    (family.verify_step_paged). Acceptance is distribution-exact
+    rejection sampling (programs.speculative_sample) — greedy requests
+    are its temperature-0 case and stay bit-identical to plain greedy
+    decode; each round trip yields up to decode_chunk*(spec_tokens+1)
+    tokens per slot. Synchronous because the next round's page
+    allocation depends on this round's acceptance counts. (The slot
+    layout pipelines instead — dispatch_spec.)"""
     with eng._state_lock:
         lanes = [(i, eng.slots[i]) for i in eng._active()
                  if eng.slots[i].pos < eng.slots[i].max_total]

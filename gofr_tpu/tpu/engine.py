@@ -597,15 +597,16 @@ class GenerateEngine(_EngineBase):
         # loop; it also keeps serving fast over high-latency device links.
         self.decode_chunk = max(1, decode_chunk)
 
-        # Speculative decoding (VERDICT r3 #6): prompt-lookup drafting on
-        # device — each outer decode step proposes spec_tokens continuation
-        # tokens from the slot's own token history (the most recent earlier
-        # occurrence of the current token; "prompt lookup decoding"), then
-        # ONE target forward verifies all of them (family.verify_step).
-        # Greedy acceptance emits the longest agreeing prefix plus the
-        # target's correction token, so outputs are bit-identical to plain
-        # greedy decode — up to spec_tokens+1 tokens per target forward at
-        # the memory-bound occupancies where decode wastes bandwidth.
+        # Speculative decoding (VERDICT r3 #6): each outer decode step
+        # proposes spec_tokens continuation tokens — prompt-lookup from the
+        # slot's own device-resident history, or a draft MODEL (spec_draft)
+        # — then ONE target forward verifies all of them. Acceptance is
+        # distribution-exact rejection sampling (programs.speculative_
+        # sample): sampled requests emit tokens distributed exactly as
+        # plain sampled decode, and greedy requests (temperature 0) are the
+        # special case whose outputs are bit-identical to plain greedy
+        # decode — up to spec_tokens+1 tokens per target forward at the
+        # memory-bound occupancies where decode wastes bandwidth.
         self.spec_tokens = max(0, int(spec_tokens))
         if self.spec_tokens:
             need = "verify_step" if kv_layout == "slot" else "verify_step_paged"

@@ -435,3 +435,71 @@ def test_multipart_malformed_bodies():
     # bind target that is neither dataclass nor dict is a BindError
     with pytest.raises(BindError):
         bind_multipart(b, b"--BB--", object)
+
+
+def test_websocket_edge_cases():
+    """Binary/str/malformed frames, handler errors, hub lifecycle, and
+    server-push broadcast — the reference's websocket tier behaviors
+    (websocket.go:63-137) beyond the happy roundtrip."""
+    app = make_app()
+    seen = []
+
+    def ws_handler(ctx):
+        raw = ctx.bind(str)
+        seen.append(raw)
+        if raw == "boom":
+            raise RuntimeError("handler exploded")
+        if raw == "types":
+            assert isinstance(ctx.bind(bytes), bytes)
+            from gofr_tpu.utils.bind import BindError
+            try:
+                ctx.bind(dict)  # not JSON
+                return {"bound": True}
+            except BindError:
+                return {"bound": False}
+        return {"echo": raw}
+
+    app.websocket("/ws", ws_handler)
+
+    with AppHarness(app) as h:
+        async def talk():
+            import aiohttp
+
+            out = {}
+            async with aiohttp.ClientSession() as session:
+                async with session.ws_connect(f"{h.base}/ws") as ws:
+                    # non-JSON text frame: bind(str/bytes) works, bind(dict) errors cleanly
+                    await ws.send_str("types")
+                    out["types"] = await ws.receive_json(timeout=5)
+                    # hub registered the live connection (checked after the
+                    # first roundtrip — registration happens server-side on
+                    # upgrade, which may trail the client handshake)
+                    out["hub_size_live"] = len(app.ws_hub)
+                    # handler exception must NOT kill the connection loop:
+                    # the client gets an error envelope, then the next
+                    # frame is served normally
+                    await ws.send_str("boom")
+                    out["boom"] = await ws.receive_json(timeout=5)
+                    await ws.send_str("after-boom")
+                    out["after"] = await ws.receive_json(timeout=5)
+                    # server push through the hub reaches the client. The
+                    # broadcast must run ON THE SERVER LOOP (transports are
+                    # not thread-safe; cross-loop awaits raise) — the same
+                    # run_coroutine_threadsafe pattern WSConnection.send uses
+                    import asyncio as aio
+
+                    fut = aio.run_coroutine_threadsafe(
+                        app.ws_hub.broadcast({"push": 1}), h._loop)
+                    await aio.get_event_loop().run_in_executor(
+                        None, fut.result, 5)
+                    out["push"] = await ws.receive_json(timeout=5)
+                return out
+
+        out = asyncio.run(talk())
+        assert out["hub_size_live"] == 1
+        assert out["types"] == {"bound": False}
+        assert "error" in out["boom"]
+        assert out["after"] == {"echo": "after-boom"}
+        assert out["push"] == {"push": 1}
+    # connection unregistered after close
+    assert len(app.ws_hub) == 0
