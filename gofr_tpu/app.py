@@ -68,6 +68,8 @@ class App:
         from gofr_tpu.cron import Crontab
 
         self.cron = Crontab(self.container)
+        if self.config.get_bool("QOS_ENABLED"):
+            self.enable_qos()
         self._shutdown = asyncio.Event()
         self._runners: list[web.AppRunner] = []
         self._sub_threads: list[threading.Thread] = []
@@ -142,6 +144,25 @@ class App:
         secret_b = secret.encode() if isinstance(secret, str) else secret
         self._auth_middlewares.append(oauth_middleware(hs_secret=secret_b, audience=audience, issuer=issuer))
 
+    # -- QoS: admission control / rate limiting / load shedding ----------------
+
+    def enable_qos(self, policy=None, **overrides: Any):
+        """Turn on the QoS subsystem (gofr_tpu.qos; also auto-enabled by
+        ``QOS_ENABLED=true``): rate limits and load shedding at the HTTP
+        middleware (429/503 + ``Retry-After``) and gRPC interceptor
+        (``RESOURCE_EXHAUSTED``/``UNAVAILABLE``), weighted-fair priority
+        scheduling and deadline-aware admission on every served engine.
+        ``policy`` is a prebuilt ``QoSPolicy``; otherwise one is built from
+        ``QOS_*`` config keys with ``overrides`` applied (docs/qos.md).
+        Returns the AdmissionController."""
+        from gofr_tpu.qos import AdmissionController, QoSPolicy
+
+        if policy is None:
+            policy = QoSPolicy.from_config(self.config, **overrides)
+        controller = AdmissionController(policy, self.container.metrics, logger=self.logger)
+        self.container.register_qos(controller)
+        return controller
+
     # -- other entrypoints -----------------------------------------------------
 
     def subscribe(self, topic: str, handler: Handler) -> None:
@@ -215,8 +236,15 @@ class App:
             logging_middleware(self.logger),
             cors_middleware(self.config, self._registered_methods),
             metrics_middleware(self.container.metrics),
-            *self._auth_middlewares,
         ]
+        if self.container.qos is not None:
+            # after metrics (rejections must show in app_http_response),
+            # before auth — admission is cheaper than signature checks, so
+            # shed load never pays the auth path
+            from gofr_tpu.http.middleware import qos_middleware
+
+            middlewares.append(qos_middleware(self.container.qos))
+        middlewares.extend(self._auth_middlewares)
         http_app = web.Application(middlewares=middlewares, client_max_size=64 * 1024 * 1024)
 
         # well-known routes (gofr.go:155-163)
@@ -268,6 +296,11 @@ class App:
         auth = request.get("gofr_auth")
         if auth:
             req.context().update(auth)
+        qos_class = request.get("gofr_qos_class")
+        if qos_class:
+            # resolved by the QoS middleware; ctx.generate/infer pick it up
+            # so handlers need no QoS-awareness to schedule correctly
+            req.context()["qos_class"] = qos_class
         return req
 
     def _wrap(self, handler: Handler):

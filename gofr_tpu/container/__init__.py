@@ -46,6 +46,7 @@ class Container:
         self._tpu_lock = threading.Lock()
         self.services: dict[str, Any] = {}
         self._engines: dict[str, Any] = {}
+        self.qos = None  # AdmissionController once App.enable_qos runs
         self._remote_level_poller = None
 
     # -- boot ------------------------------------------------------------------
@@ -96,6 +97,17 @@ class Container:
         m.new_gauge("app_tpu_prefix_cached_pages", "KV pages held by the prefix cache")
         m.new_counter("app_tpu_spec_proposed", "draft tokens proposed by speculative decoding")
         m.new_counter("app_tpu_spec_accepted", "draft tokens accepted by target verification")
+        # QoS / admission control (gofr_tpu.qos; all zero while QoS is off)
+        m.new_counter("app_qos_admitted_total", "requests admitted by QoS")
+        m.new_counter("app_qos_rejected_total",
+                      "requests rejected by QoS (reason: rate/route_rate/key_rate/"
+                      "tenant_rate/queue/deadline/capacity)")
+        m.new_counter("app_qos_shed_total", "requests shed under overload (503s)")
+        m.new_gauge("app_qos_queue_depth", "queued requests per priority class")
+        m.new_gauge("app_qos_predicted_wait_seconds",
+                    "estimated queue wait per engine (EWMA step x backlog)")
+        m.new_histogram("app_qos_queue_wait_seconds",
+                        "time requests spent queued before reaching the device loop")
 
     def _sample_tpu_metrics(self, _registry=None) -> None:
         """Collect hook: live HBM gauges on every /metrics scrape (the
@@ -196,10 +208,28 @@ class Container:
     def tpu_wired(self) -> bool:
         return self._tpu is not None
 
+    # -- QoS / admission control -----------------------------------------------
+
+    def register_qos(self, controller: Any) -> None:
+        """Install the app-wide AdmissionController (App.enable_qos): binds
+        every already-served engine, exports the per-class gauges on each
+        scrape, and joins health aggregation (DEGRADED while shedding).
+        Re-registering (QOS_ENABLED auto-enable followed by a programmatic
+        enable_qos) replaces the old controller entirely — its scrape hook
+        included, so a stale sampler can't keep writing gauges."""
+        if self.qos is not None:
+            self.metrics.remove_collect_hook(self.qos.sample_gauges)
+        self.qos = controller
+        self.metrics.add_collect_hook(controller.sample_gauges)
+        for name, engine in self._engines.items():
+            controller.bind_engine(name, engine)
+
     # -- model engines ---------------------------------------------------------
 
     def register_engine(self, name: str, engine: Any) -> None:
         self._engines[name] = engine
+        if self.qos is not None:
+            self.qos.bind_engine(name, engine)
 
     def engine(self, name: str):
         try:
@@ -265,6 +295,7 @@ class Container:
         check("cassandra", self.cassandra)
         check("clickhouse", self.clickhouse)
         check("tpu", self._tpu)
+        check("qos", self.qos)
         for name, engine in self._engines.items():
             check(f"model:{name}", engine)
         for name, svc in self.services.items():

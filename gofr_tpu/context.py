@@ -110,14 +110,36 @@ class Context:
 
     # -- model inference (the TPU-native capability) ---------------------------
 
+    def _qos_kw(self, kw: dict[str, Any]) -> dict[str, Any]:
+        """Inject the request's QoS priority class (resolved by the QoS
+        middleware/interceptor from the class header) into engine kwargs,
+        unless the handler set one explicitly — scheduling follows the
+        transport classification with zero handler cooperation."""
+        if "qos_class" in kw or "_qos_class" in kw:
+            return kw
+        req = self.request
+        req_ctx = req.context() if hasattr(req, "context") else {}
+        cls = req_ctx.get("qos_class")
+        if not cls and hasattr(req, "param"):
+            # gRPC metadata fallback — the CONFIGURED class header (gRPC
+            # lowercases metadata keys), not a hardcoded spelling
+            controller = getattr(self.container, "qos", None)
+            header = (controller.policy.class_header if controller is not None
+                      else "X-QoS-Class")
+            cls = req.param(header.lower()) or None
+        if cls:
+            kw["_qos_class"] = cls
+        return kw
+
     def infer(self, model: str, inputs: Any, **kw: Any):
         """Enqueue ``inputs`` on a served model's continuous-batching engine and
         block until the result is ready. Works from sync handlers (the engine
         runs in its own device thread)."""
-        return self.container.infer(model, inputs, **kw)
+        return self.container.infer(model, inputs, **self._qos_kw(kw))
 
     def generate(self, model: str, prompt: Any, max_new_tokens: int = 64, **kw: Any):
-        return self.container.generate(model, prompt, max_new_tokens=max_new_tokens, **kw)
+        return self.container.generate(
+            model, prompt, max_new_tokens=max_new_tokens, **self._qos_kw(kw))
 
     async def agenerate(self, model: str, prompt: Any, max_new_tokens: int = 64, **kw: Any):
         """Async-native generate for ``async def`` handlers: awaits the
@@ -127,6 +149,7 @@ class Context:
         import asyncio
 
         engine = self.container.engine(model)
+        kw = self._qos_kw(kw)
         timeout = kw.get("timeout", None)
         if timeout is None:
             timeout = getattr(engine, "default_timeout", None)

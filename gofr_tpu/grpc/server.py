@@ -118,11 +118,17 @@ class GofrGrpcInterceptor(grpc.ServerInterceptor):
             status = 0
             try:
                 return inner(request, servicer_context)
-            except Exception as e:  # noqa: BLE001 - panic recovery → INTERNAL
-                status = 13  # grpc INTERNAL
+            except Exception as e:  # noqa: BLE001 - panic recovery → typed code or INTERNAL
+                code = _grpc_code_of(e)
+                status = code.value[0]
                 span.set_status("ERROR")
-                container.logger.log_exception(e, f"grpc handler {method}")
-                servicer_context.abort(grpc.StatusCode.INTERNAL, "internal error")
+                if code is grpc.StatusCode.INTERNAL:
+                    container.logger.log_exception(e, f"grpc handler {method}")
+                    servicer_context.abort(grpc.StatusCode.INTERNAL, "internal error")
+                else:
+                    # typed (QoS/timeout) rejection: retryable status + hint,
+                    # no stack spam — rejection under load is not a fault
+                    _abort_typed(servicer_context, e, code)
             finally:
                 self._end(span, token, method, status, start)
 
@@ -146,15 +152,106 @@ class GofrGrpcInterceptor(grpc.ServerInterceptor):
                 status = 1  # grpc CANCELLED
                 span.set_status("CANCELLED")
                 raise
-            except Exception as e:  # noqa: BLE001 - panic recovery → INTERNAL
-                status = 13
+            except Exception as e:  # noqa: BLE001 - panic recovery → typed code or INTERNAL
+                code = _grpc_code_of(e)
+                status = code.value[0]
                 span.set_status("ERROR")
-                container.logger.log_exception(e, f"grpc stream handler {method}")
-                servicer_context.abort(grpc.StatusCode.INTERNAL, "internal error")
+                if code is grpc.StatusCode.INTERNAL:
+                    container.logger.log_exception(e, f"grpc stream handler {method}")
+                    servicer_context.abort(grpc.StatusCode.INTERNAL, "internal error")
+                else:
+                    _abort_typed(servicer_context, e, code)
             finally:
                 self._end(span, token, method, status, start, messages=sent)
 
         return wrapped
+
+
+def _grpc_code_of(e: Exception) -> grpc.StatusCode:
+    """Map typed HTTP errors to gRPC codes so QoS rejections raised inside
+    handlers (engine admission: 429/503) surface as retryable statuses
+    instead of INTERNAL."""
+    sc = getattr(e, "status_code", None)
+    if sc == 429:
+        return grpc.StatusCode.RESOURCE_EXHAUSTED
+    if sc == 503:
+        return grpc.StatusCode.UNAVAILABLE
+    if sc == 408:
+        return grpc.StatusCode.DEADLINE_EXCEEDED
+    return grpc.StatusCode.INTERNAL
+
+
+def _abort_typed(servicer_context, e: Exception, code: grpc.StatusCode) -> None:
+    from gofr_tpu.http.errors import retry_after_hint
+
+    retry_after = getattr(e, "retry_after", None)
+    if retry_after is not None:
+        servicer_context.set_trailing_metadata(
+            (("retry-after", retry_after_hint(retry_after)),))
+    servicer_context.abort(code, str(e) or code.name.lower().replace("_", " "))
+
+
+class QoSGrpcInterceptor(grpc.ServerInterceptor):
+    """Transport-edge admission control for gRPC (the 429/503 analog):
+    over-rate traffic aborts RESOURCE_EXHAUSTED, backlog shedding aborts
+    UNAVAILABLE, both with ``retry-after`` trailing metadata — the request
+    never reaches the servicer or the model engine. Ordered OUTSIDE the
+    Gofr interceptor so a rejection is not re-wrapped into INTERNAL."""
+
+    def __init__(self, container):
+        self._container = container
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        controller = getattr(self._container, "qos", None)
+        if handler is None or controller is None:
+            return handler
+        method = handler_call_details.method
+        metadata = dict(handler_call_details.invocation_metadata or ())
+
+        def check(servicer_context) -> None:
+            decision = controller.admit_transport(
+                route=method,
+                api_key=metadata.get("x-api-key", ""),
+                tenant=metadata.get(controller.policy.tenant_header.lower(), ""),
+                cls_name=metadata.get(controller.policy.class_header.lower()),
+            )
+            if not decision.allowed:
+                from gofr_tpu.http.errors import retry_after_hint
+
+                servicer_context.set_trailing_metadata(
+                    (("retry-after", retry_after_hint(decision.retry_after)),))
+                code = (grpc.StatusCode.RESOURCE_EXHAUSTED if decision.status == 429
+                        else grpc.StatusCode.UNAVAILABLE)
+                servicer_context.abort(code, decision.message)
+
+        def wrap_unary(inner):
+            def wrapped(request, servicer_context):
+                check(servicer_context)
+                return inner(request, servicer_context)
+            return wrapped
+
+        def wrap_stream(inner):
+            def wrapped(request, servicer_context):
+                check(servicer_context)
+                yield from inner(request, servicer_context)
+            return wrapped
+
+        dispatch = (
+            ("unary_unary", wrap_unary, grpc.unary_unary_rpc_method_handler),
+            ("unary_stream", wrap_stream, grpc.unary_stream_rpc_method_handler),
+            ("stream_unary", wrap_unary, grpc.stream_unary_rpc_method_handler),
+            ("stream_stream", wrap_stream, grpc.stream_stream_rpc_method_handler),
+        )
+        for attr, wrap, factory in dispatch:
+            inner = getattr(handler, attr)
+            if inner:
+                return factory(
+                    wrap(inner),
+                    request_deserializer=handler.request_deserializer,
+                    response_serializer=handler.response_serializer,
+                )
+        return handler
 
 
 class _GRPCRequestAdapter:
@@ -198,10 +295,16 @@ class _GRPCRequestAdapter:
 
 
 def start_grpc_server(app) -> grpc.Server:
+    interceptors: list[grpc.ServerInterceptor] = []
+    if getattr(app.container, "qos", None) is not None:
+        # outermost: a QoS rejection aborts before the Gofr wrapper (which
+        # would log it as INTERNAL) or the servicer ever runs
+        interceptors.append(QoSGrpcInterceptor(app.container))
+    interceptors.append(GofrGrpcInterceptor(app.container))
     server = grpc.server(
         ThreadPoolExecutor(max_workers=app.config.get_int("GRPC_THREADS", 16),
                            thread_name_prefix="gofr-grpc"),
-        interceptors=[GofrGrpcInterceptor(app.container)],
+        interceptors=interceptors,
     )
     for adder, servicer in app._grpc_services:
         if servicer is not None:

@@ -12,6 +12,9 @@ from __future__ import annotations
 
 class HTTPError(Exception):
     status_code: int = 500
+    # seconds until a retry is worth attempting; the responder surfaces it
+    # as a Retry-After header (gRPC: retry-after trailing metadata)
+    retry_after: float | None = None
 
     def __init__(self, message: str = ""):
         super().__init__(message)
@@ -93,11 +96,41 @@ class Forbidden(HTTPError):
         return "forbidden"
 
 
+class TooManyRequests(HTTPError):
+    """Rate limit / concurrency cap exceeded (QoS tier 1): the request is
+    well-formed but the caller is over its budget — retryable after
+    ``retry_after`` seconds."""
+
+    status_code = 429
+
+    def __init__(self, message: str = "", retry_after: float | None = None):
+        super().__init__(message)
+        if retry_after is not None:
+            self.retry_after = retry_after
+
+    def default_message(self) -> str:
+        return "too many requests"
+
+
 class ServiceUnavailable(HTTPError):
     status_code = 503
 
+    def __init__(self, message: str = "", retry_after: float | None = None):
+        super().__init__(message)
+        if retry_after is not None:
+            self.retry_after = retry_after
+
     def default_message(self) -> str:
         return "service unavailable"
+
+
+def retry_after_hint(seconds: float) -> str:
+    """One formatting site for every transport's retry hint (HTTP
+    ``Retry-After`` header, gRPC ``retry-after`` trailing metadata):
+    whole seconds, floored at 1 so a sub-second hint never reads as 0."""
+    import math
+
+    return str(max(1, math.ceil(float(seconds))))
 
 
 def status_of(err: BaseException | None, method: str = "GET", has_result: bool = False) -> int:

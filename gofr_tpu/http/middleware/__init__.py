@@ -21,6 +21,7 @@ from gofr_tpu.tracing import Tracer
 CONTAINER_KEY = web.AppKey("gofr_container", object)
 SPAN_KEY = "gofr_span"
 AUTH_KEY = "gofr_auth"
+QOS_KEY = "gofr_qos_class"
 
 
 def tracer_middleware(tracer: Tracer):
@@ -138,6 +139,42 @@ def cors_middleware(config, registered_methods: Callable[[], list[str]]):
             _hdr("ACCESS_CONTROL_ALLOW_HEADERS", "Authorization, Content-Type, x-requested-with, X-API-KEY"),
         )
         return response
+
+    return mw
+
+
+def qos_middleware(controller):
+    """Admission control at the transport edge (QoS tier 1/2 — see
+    gofr_tpu.qos): rate limits and backlog shedding answer 429/503 with a
+    ``Retry-After`` header BEFORE the handler (and therefore the model
+    engine) sees the request. The resolved priority class rides on the
+    request so ``ctx.generate``/``ctx.infer`` schedule it without handler
+    cooperation. Well-known/health routes always pass — a load balancer
+    probing an overloaded instance must still see its health."""
+    from gofr_tpu.http.errors import retry_after_hint
+
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        if request.path.startswith("/.well-known/") or request.path == "/favicon.ico":
+            return await handler(request)
+        cls_name = controller.classify(request.headers)
+        route = request.match_info.route
+        template = (getattr(route.resource, "canonical", request.path)
+                    if route and route.resource else request.path)
+        decision = controller.admit_transport(
+            route=template,
+            api_key=request.headers.get("X-API-KEY", ""),
+            tenant=request.headers.get(controller.policy.tenant_header, ""),
+            cls_name=cls_name,
+        )
+        if not decision.allowed:
+            return web.json_response(
+                {"error": {"message": decision.message}},
+                status=decision.status,
+                headers={"Retry-After": retry_after_hint(decision.retry_after)},
+            )
+        request[QOS_KEY] = cls_name
+        return await handler(request)
 
     return mw
 

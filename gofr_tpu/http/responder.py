@@ -13,7 +13,7 @@ import dataclasses
 import json
 from typing import Any
 
-from gofr_tpu.http.errors import status_of
+from gofr_tpu.http.errors import retry_after_hint, status_of
 from gofr_tpu.http.responses import File, Raw, Redirect, Response
 
 
@@ -52,7 +52,13 @@ def respond(result: Any, err: BaseException | None, method: str = "GET") -> Wire
         if status >= 500 and not getattr(err, "status_code", None):
             # don't leak internals for unexpected exceptions
             message = "some unexpected error has occurred"
-        return WireResponse(status, to_json({"error": {"message": message}}))
+        headers = {}
+        retry_after = getattr(err, "retry_after", None)
+        if retry_after is not None and status in (429, 503):
+            # QoS rejections (429 rate / 503 shed) tell clients WHEN to come
+            # back instead of inviting an immediate retry storm
+            headers["Retry-After"] = retry_after_hint(retry_after)
+        return WireResponse(status, to_json({"error": {"message": message}}), headers=headers)
 
     if isinstance(result, Redirect):
         return WireResponse(result.status_code, b"", headers={"Location": result.url})

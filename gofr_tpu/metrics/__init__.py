@@ -157,8 +157,10 @@ class Histogram(_Metric):
             cum = 0
             for b, c in zip(self.buckets, counts):
                 cum += c
-                yield f"{self.name}_bucket{_fmt_labels(ls, f'le=\"{_fmt_value(b)}\"')} {cum}"
-            yield f"{self.name}_bucket{_fmt_labels(ls, 'le=\"+Inf\"')} {total}"
+                le = 'le="' + _fmt_value(b) + '"'
+                yield f"{self.name}_bucket{_fmt_labels(ls, le)} {cum}"
+            inf = 'le="+Inf"'
+            yield f"{self.name}_bucket{_fmt_labels(ls, inf)} {total}"
             yield f"{self.name}_sum{_fmt_labels(ls)} {_fmt_value(total_sum)}"
             yield f"{self.name}_count{_fmt_labels(ls)} {total}"
 
@@ -258,6 +260,15 @@ class Registry:
     def add_collect_hook(self, hook: Callable[["Registry"], None]) -> None:
         """Hook invoked on every scrape (runtime/HBM gauges sample here)."""
         self._collect_hooks.append(hook)
+
+    def remove_collect_hook(self, hook: Callable[["Registry"], None]) -> None:
+        """Unregister a scrape hook (no-op if absent) — replacing a
+        component (e.g. re-enabling QoS) must not leave its stale sampler
+        writing gauges on every scrape."""
+        try:
+            self._collect_hooks.remove(hook)
+        except ValueError:
+            pass
 
     def expose_text(self) -> str:
         for hook in list(self._collect_hooks):

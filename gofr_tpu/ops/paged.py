@@ -27,6 +27,8 @@ padding rows (engine._admit docstring).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 from dataclasses import dataclass
 
@@ -34,6 +36,38 @@ import jax
 import jax.numpy as jnp
 
 from gofr_tpu.ops.kvcache import quantize_row
+
+# The append-lowering choice (select | scatter | pallas). Engines resolve
+# GOFR_PAGED_KV_WRITE ONCE at construction and pin it here for every trace
+# they drive (engine._trace_scope); the env var is only read as a fallback
+# for direct ops callers (unit tests, notebooks). jit caches traces
+# process-globally, so A/B the lowerings across processes, not by flipping
+# the env between engine builds in one process.
+_WRITE_MODE: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "gofr_paged_kv_write", default=None
+)
+
+
+def resolve_write_mode(explicit: str | None = None) -> str:
+    """The lowering to trace with: explicit arg > engine pin > env."""
+    if explicit:
+        return explicit
+    pinned = _WRITE_MODE.get()
+    if pinned is not None:
+        return pinned
+    return os.environ.get("GOFR_PAGED_KV_WRITE", "select")
+
+
+@contextlib.contextmanager
+def write_mode_scope(mode: str | None):
+    """Pin the paged-append lowering for traces inside the scope — the
+    engine wraps its device loop / warmup / follower loop with this so the
+    choice it resolved at construction is what every trace sees."""
+    tok = _WRITE_MODE.set(mode)
+    try:
+        yield
+    finally:
+        _WRITE_MODE.reset(tok)
 
 
 def _locate(pages: jnp.ndarray, pos: jnp.ndarray, page: int) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -145,16 +179,16 @@ def append_tokens_paged_q(
     new: jnp.ndarray,       # [N, Hkv, D]
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Quantized analog of append_tokens_paged for one k/v plane, honoring
-    the same ``GOFR_PAGED_KV_WRITE`` lowering switch (select default — the
-    measured v5e winner; scatter optional). The one-hot fold runs in f32
-    and casts back: int8 magnitudes <= 127 are exact in f32."""
+    the same write-mode lowering switch (select default — the measured
+    v5e winner; scatter optional). The one-hot fold runs in f32 and casts
+    back: int8 magnitudes <= 127 are exact in f32."""
     n, hkv, d = new.shape
     p_total, _, page, _ = cache_q.shape
     q, sc = quantize_row(new)  # [N,Hkv,D] int8, [N,Hkv] f32
     pp, off = _locate(table, positions[:, None], page)
     pp, off = pp[:, 0], off[:, 0]
 
-    if os.environ.get("GOFR_PAGED_KV_WRITE", "select") != "scatter":
+    if resolve_write_mode() != "scatter":
         flat = pp * page + off  # OOB rows land >= p_total*page
         grid = jnp.arange(p_total * page)
         m = flat[:, None] == grid[None, :]  # [N, P*page]
@@ -231,18 +265,21 @@ def append_tokens_paged(
     scatter ~1.4-2x for the slot cache on v5e (ops/kvcache.append_tokens) —
     while ``scatter`` keeps the advanced-indexing scatter (cheaper
     asymptotically for very large pools, where the one-hot matmul and
-    full-pool rewrite start to dominate). NOTE: the env var is read at
-    TRACE time and jit caches traces process-globally, so the choice is
-    effectively FIXED FOR THE LIFE OF THE PROCESS — A/B the two lowerings
-    across separate processes, not by flipping the var between engine
-    builds. OOB semantics are
+    full-pool rewrite start to dominate). The choice comes from
+    ``resolve_write_mode()``: engines resolve ``GOFR_PAGED_KV_WRITE``
+    once at construction and pin it for their traces (``write_mode_scope``);
+    the env var is only the fallback for direct callers. jit caches traces
+    process-globally, so the choice is effectively FIXED FOR THE LIFE OF
+    THE PROCESS — A/B the two lowerings across separate processes, not by
+    flipping the var between engine builds. OOB semantics are
     preserved either way: OOB rows' flat position falls outside the one-hot
     range, producing an all-false mask row (the scatter path relies on XLA
     dropping OOB updates)."""
     n, hkv, d = k_new.shape
     p_total, _, page, _ = k_layer.shape
 
-    if os.environ.get("GOFR_PAGED_KV_WRITE", "select") == "pallas":
+    mode = resolve_write_mode()
+    if mode == "pallas":
         from gofr_tpu.ops.pallas import interpret_mode, kernel_platform
 
         if kernel_platform():
@@ -256,7 +293,7 @@ def append_tokens_paged(
     pp, off = _locate(table, positions[:, None], page)
     pp, off = pp[:, 0], off[:, 0]  # [N]
 
-    if os.environ.get("GOFR_PAGED_KV_WRITE", "select") != "scatter":
+    if mode != "scatter":
         flat = pp * page + off  # [N]; OOB rows land >= p_total*page
         grid = jnp.arange(p_total * page)
         m = flat[:, None] == grid[None, :]  # [N, P*page]

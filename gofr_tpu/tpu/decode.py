@@ -32,7 +32,7 @@ def _fold_spec(eng, toks, accs, meta, k) -> None:
     """Replay one spec round's device acceptance into slot state. Caller
     holds the state lock. ``toks`` [k, n, g+1], ``accs`` [k, n]."""
     now = time.monotonic()
-    emitted = accepted = 0
+    emitted = accepted = folded = 0
     for i, s in meta:
         if eng.slots[i] is not s:
             continue  # freed/preempted/reassigned while in flight
@@ -41,6 +41,7 @@ def _fold_spec(eng, toks, accs, meta, k) -> None:
             eng._free_slot(i)
             s.request.complete(error=RequestTimeout())
             continue
+        folded += 1
         for kk in range(k):
             a = int(accs[kk, i])
             accepted += a
@@ -57,8 +58,11 @@ def _fold_spec(eng, toks, accs, meta, k) -> None:
             if eng.slots[i] is not s:
                 break
     eng.metrics.increment_counter("app_tpu_tokens_total", emitted)
+    # proposed counts only lanes whose acceptance was folded — a lane
+    # discarded mid-flight (freed/preempted/cancelled) contributes to
+    # neither side, keeping accepted/proposed a true acceptance rate
     eng.metrics.increment_counter(
-        "app_tpu_spec_proposed", k * eng.spec_tokens * len(meta))
+        "app_tpu_spec_proposed", k * eng.spec_tokens * folded)
     eng.metrics.increment_counter("app_tpu_spec_accepted", accepted)
 
 

@@ -33,7 +33,6 @@ from __future__ import annotations
 import collections
 import itertools
 import math
-import os
 import queue
 from functools import partial
 import threading
@@ -45,10 +44,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from gofr_tpu.http.errors import RequestTimeout
+from gofr_tpu.qos.scheduler import QoSQueue
 from gofr_tpu.tpu.lockstep import TAG_CHUNK, TAG_DECODE, TAG_PREFILL, TAG_SPEC
 from gofr_tpu.native import plan_prefill
 from gofr_tpu.models.base import ModelSpec, get_family
-from gofr_tpu.ops.sampling import sample_token
 from gofr_tpu.parallel import shard_pytree
 from gofr_tpu.tpu.decode import (
     dispatch_decode,
@@ -164,7 +163,11 @@ class _EngineBase:
         self.metrics = container.metrics
         self.tpu = container.tpu
         self.default_timeout = default_timeout
-        self._queue: queue.Queue[Request] = queue.Queue()
+        # QoS-capable queue: pure FIFO (byte-for-byte queue.Queue behavior)
+        # until an AdmissionController binds this engine and flips it into
+        # weighted-fair priority mode (gofr_tpu.qos; App.enable_qos).
+        self._queue: QoSQueue = QoSQueue()
+        self.qos = None  # AdmissionController once bound; None = QoS off
         self._thread: threading.Thread | None = None
         # requests currently inside a device call — visible to _fail_all so a
         # wedged step can't strand its batch (their complete is idempotent)
@@ -252,6 +255,19 @@ class _EngineBase:
         return (self._queue.qsize() + len(getattr(self, "_pending", []))
                 + len(getattr(self, "_pending_long", [])))
 
+    def _trace_scope(self):
+        """Context every trace-driving section runs under: paged engines pin
+        the KV append lowering they resolved at construction
+        (ops/paged.write_mode_scope), so no trace re-reads os.environ."""
+        import contextlib
+
+        mode = getattr(self, "paged_kv_write", None)
+        if mode:
+            from gofr_tpu.ops.paged import write_mode_scope
+
+            return write_mode_scope(mode)
+        return contextlib.nullcontext()
+
     def _run(self) -> None:
         from gofr_tpu.ops.pallas import platform_hint
 
@@ -260,7 +276,7 @@ class _EngineBase:
                 # Pin kernel-backend resolution to where this engine's device
                 # actually is (a CPU test mesh under an attached TPU would
                 # otherwise trace Pallas kernels it can't lower).
-                with platform_hint(getattr(self.tpu, "platform", None)):
+                with platform_hint(getattr(self.tpu, "platform", None)), self._trace_scope():
                     self._loop()
                 return  # clean stop
             except Exception as e:  # noqa: BLE001
@@ -301,7 +317,20 @@ class _EngineBase:
             self.start()
         if self._startup_error is not None:
             raise self._startup_error
-        req = Request(inputs, kw, timeout if timeout is not None else self.default_timeout, stream)
+        if "qos_class" in kw:  # public spelling of the internal routing key
+            kw["_qos_class"] = kw.pop("qos_class")
+        eff_timeout = timeout if timeout is not None else self.default_timeout
+        qos, cls = self.qos, None
+        if qos is not None:
+            # admission BEFORE the request exists: backlog cap, per-class
+            # concurrency cap, and the predicted-wait-vs-deadline check —
+            # hopeless work is rejected with 429/503 + Retry-After here
+            # instead of burning a slot and timing out later (docs/qos.md)
+            cls = qos.admit_engine(self, kw.get("_qos_class"), eff_timeout)
+            kw["_qos_class"] = cls.name
+        req = Request(inputs, kw, eff_timeout, stream)
+        if cls is not None:
+            qos.track(req, cls)
         self._queue.put(req)
         self.metrics.set_gauge("app_tpu_queue_depth", self._backlog())
         return req
@@ -309,6 +338,8 @@ class _EngineBase:
     def _record_step(self, kind: str, seconds: float, occupancy: float, signature: tuple) -> None:
         self.metrics.record_histogram("app_tpu_step_seconds", seconds, kind=kind)
         self.metrics.record_histogram("app_tpu_batch_occupancy", occupancy, kind=kind)
+        if self.qos is not None:
+            self.qos.observe_step(seconds)  # feeds the queue-wait estimator
         if signature in self._compiled:
             self.metrics.increment_counter("app_tpu_compile_cache_hits", 1)
         else:
@@ -554,6 +585,7 @@ class GenerateEngine(_EngineBase):
         kv_layout: str = "slot",
         page_size: int = 128,
         total_pages: int | None = None,
+        paged_kv_write: str = "",
         max_restarts: int = 3,
         decode_pipeline: int = 2,
         prefix_cache: bool = True,
@@ -718,12 +750,18 @@ class GenerateEngine(_EngineBase):
             # default pool = same HBM as the slot cache; shrink to
             # oversubscribe, or keep and raise `slots` for more concurrency
             self.total_pages = total_pages if total_pages else slots * self.pages_per_slot
+            # KV append lowering, resolved from GOFR_PAGED_KV_WRITE exactly
+            # ONCE here and pinned for every trace this engine drives
+            # (_trace_scope → ops/paged.write_mode_scope) — ops/paged never
+            # re-reads os.environ at trace time on the engine's behalf.
+            from gofr_tpu.ops.paged import resolve_write_mode
+
+            self.paged_kv_write = resolve_write_mode(paged_kv_write or None)
             # The in-place Pallas page append redirects OOB rows' aliased
             # tile fetch to page 0 (ops/pallas/kv_append.py) — reserve it
             # as a never-allocated sink so an OOB copy-through can never
             # share a tile with a real write in the same call (ADVICE r4)
-            self._page_sink = (1 if os.environ.get("GOFR_PAGED_KV_WRITE",
-                                                   "select") == "pallas" else 0)
+            self._page_sink = 1 if self.paged_kv_write == "pallas" else 0
             if self.total_pages - self._page_sink < self.pages_per_slot:
                 raise ValueError(
                     f"total_pages {self.total_pages} (minus {self._page_sink} "
@@ -852,7 +890,7 @@ class GenerateEngine(_EngineBase):
         # traces on the caller thread could resolve kernels for the wrong
         # backend (e.g. Pallas for a CPU test mesh under an attached TPU),
         # and jit would cache that mis-resolved program per shape
-        with platform_hint(getattr(self.tpu, "platform", None)):
+        with platform_hint(getattr(self.tpu, "platform", None)), self._trace_scope():
             return self._warmup_traced(lbs, bbs)
 
     def _warmup_traced(self, lbs: list[int], bbs: list[int]) -> int:
@@ -894,8 +932,8 @@ class GenerateEngine(_EngineBase):
         else:
             packed[1, :] = self._cache_len  # OOB positions: writes dropped
         if not self.spec_tokens:
-            # spec mode never calls _dispatch_decode — don't compile the
-            # (expensive) plain decode program it would throw away
+            # spec mode never calls decode.dispatch_decode — don't compile
+            # the (expensive) plain decode program it would throw away
             self._announce(TAG_DECODE, 0, 0, packed)  # a=0: warmup, no carry
             out, _, self.cache = self._decode_chunk(
                 self.params, self._base_key, self.cache, k, jnp.asarray(packed),
@@ -916,18 +954,20 @@ class GenerateEngine(_EngineBase):
                     jnp.asarray(spec_packed))
             else:
                 # slot layout: all lanes host-arbitrated and OOB, so no
-                # cache/history write survives; the carry is stored (same on
-                # followers) but any lane later rejoining ships use_host=1
+                # cache/history write survives. Announced with a=0 (warmup,
+                # mirroring the TAG_DECODE convention): both sides feed a
+                # zeros carry and DISCARD the output carry, so leader and
+                # followers stay carry-identical without relying on a
+                # warmup-produced value (ADVICE r5).
                 spec_packed = np.zeros((5, n), np.int32)
                 spec_packed[1, :] = self._cache_len + 1
                 spec_packed[2, :] = 1
-                self._announce(TAG_SPEC, 1, 0, spec_packed)
-                carry = self._spec_carry
-                if carry is None:
-                    carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
-                toks, _, self.cache, self._spec_carry = self._spec_chunk_fn(
+                self._announce(TAG_SPEC, 0, 0, spec_packed)
+                carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
+                toks, _, self.cache, _warm_carry = self._spec_chunk_fn(
                     self.params, self._base_key, self.cache, k,
                     jnp.asarray(spec_packed), carry)
+                del _warm_carry  # never stored: _loop starts from None
             jax.block_until_ready(toks)
             self._compiled.add(("decode_spec", n, k, self.spec_tokens))
             count += 1
@@ -1302,19 +1342,24 @@ class GenerateEngine(_EngineBase):
                     # idle leader: heartbeat so follower watchdogs see
                     # liveness between announcements (LOCKSTEP_DEADLINE_S)
                     self._ls.maybe_heartbeat(self._hb_interval)
-                # idle: block briefly for work
-                try:
-                    req = self._queue.get(timeout=0.2)
-                    self._queue.put(req)  # re-queue; _admit will pick it up
-                except queue.Empty:
-                    pass
+                # idle: block briefly for work without consuming (a get/put
+                # round trip would skew QoS wait metrics and fair credits,
+                # and could reorder same-class FIFO arrivals)
+                self._queue.wait_nonempty(0.2)
 
     # -- admission / prefill ---------------------------------------------------
 
     def _drain_pending(self) -> None:
         """Move queued requests into the encoded pending list (invalid ones
-        complete with their error immediately)."""
-        while True:
+        complete with their error immediately). With QoS on, at most a
+        couple of admission rounds' worth is drained per iteration — a full
+        drain would freeze class priorities at arrival order inside the
+        FIFO ``_pending`` list, while a bounded one keeps late-arriving
+        interactive traffic able to overtake queued batch work."""
+        budget = (2 * self.num_slots + self.max_prefill_batch
+                  if self.qos is not None else -1)
+        while budget != 0:
+            budget -= 1
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
@@ -1770,9 +1815,13 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
     sp_size = (int(mesh.shape["sp"])
                if mesh is not None and "sp" in getattr(mesh, "axis_names", ()) else 1)
 
+    # resolved ONCE: the same seed feeds random weight init AND the engine's
+    # sampling RNG — with checkpoint/HF weights a caller-supplied seed was
+    # previously popped here and silently dropped before it could reach
+    # GenerateEngine's _base_key (ADVICE r5)
+    seed = int(kw.pop("seed", 0))
     cfg, params = _resolve_weights(
-        spec, family, container, seed=int(kw.pop("seed", 0)),
-        rules=rules, mesh=mesh)
+        spec, family, container, seed=seed, rules=rules, mesh=mesh)
 
     quantize_kw = kw.pop("quantize", None)
     quantize = str(quantize_kw if quantize_kw is not None else conf.get_or_default("ENGINE_QUANTIZE", ""))
@@ -1931,6 +1980,9 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             kv_layout=kv_layout,
             page_size=int(kw.pop("page_size", conf.get_int("ENGINE_PAGE_SIZE", 128))),
             total_pages=int(kw.pop("total_pages", conf.get_int("ENGINE_TOTAL_PAGES", 0))) or None,
+            paged_kv_write=str(kw.pop("paged_kv_write",
+                                      conf.get_or_default("ENGINE_PAGED_KV_WRITE", ""))),
+            seed=seed,
             prefix_cache=prefix_cache,
             spec_tokens=spec_tokens,
             kv_quantize=kv_quantize,

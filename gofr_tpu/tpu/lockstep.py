@@ -166,8 +166,9 @@ class LockstepFollower:
         n, k = eng.num_slots, eng.decode_chunk
         # same platform pin as the leader's device thread (engine._run):
         # first-time traces here must resolve kernels for the engine's
-        # actual backend, not whatever jax.default_backend() guesses
-        with platform_hint(getattr(eng.tpu, "platform", None)):
+        # actual backend, not whatever jax.default_backend() guesses —
+        # plus the engine's paged KV write-mode pin (engine._trace_scope)
+        with platform_hint(getattr(eng.tpu, "platform", None)), eng._trace_scope():
             while True:
                 header = np.asarray(_broadcast(np.zeros(_HEADER_LEN, np.int32)))
                 self._progress_at = time.monotonic()
@@ -200,20 +201,25 @@ class LockstepFollower:
                     del out
                 elif tag == TAG_SPEC:
                     if eng.kv_layout == "slot":
-                        # slot spec: a is a live flag, payload is [5, n],
-                        # and the device-resident (token, hlen) carry is
-                        # reproduced because every process executes the
+                        # slot spec: a is a live flag (0 = leader warmup:
+                        # zeros carry in, output carry DISCARDED — the
+                        # TAG_DECODE convention), payload is [5, n]. Live
+                        # rounds reproduce the device-resident (token,
+                        # hlen) carry because every process executes the
                         # same deterministic calls in order (sampled
                         # requests too: the rng step rides the payload and
-                        # folds into the same config-seeded base key)
+                        # folds into the same config-seeded base key).
+                        live = bool(a)
                         packed = self._recv((5, n))
-                        carry = eng._spec_carry
+                        carry = eng._spec_carry if live else None
                         if carry is None:
                             carry = (jnp.zeros((n,), jnp.int32),
                                      jnp.zeros((n,), jnp.int32))
-                        toks, accs, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
+                        toks, accs, eng.cache, carry_out = eng._spec_chunk_fn(
                             eng.params, eng._base_key, eng.cache, k,
                             jnp.asarray(packed), carry)
+                        if live:
+                            eng._spec_carry = carry_out
                     else:
                         packed = self._recv((a, n))
                         toks, accs, eng.cache = eng._spec_chunk_fn(

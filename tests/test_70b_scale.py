@@ -12,6 +12,11 @@ import textwrap
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from jaxpin import child_env  # noqa: E402
+import pytest
+
+# integration tier (CI `integration` job): multi-minute engine/process
+# runs — excluded from the tier-1 gate via -m 'not slow' (docs/testing.md)
+pytestmark = pytest.mark.slow
 
 _WORKER = textwrap.dedent("""
     import os, sys

@@ -1,5 +1,9 @@
 
+import pytest
+
 from gofr_tpu.config import DictConfig, EnvConfig, parse_dotenv
+
+pytestmark = pytest.mark.quick
 
 
 def test_parse_dotenv_basics():
@@ -75,7 +79,7 @@ def test_missing_folder_ok(tmp_path):
 
 
 def test_every_knob_is_documented():
-    """docs/configs.md must cover every ENGINE_*/GOFR_* knob in the source.
+    """docs/configs.md must cover every ENGINE_*/GOFR_*/QOS_* knob in the source.
 
     Generated-from-grep so the catalog can't silently drift as knobs are
     added (the reference ships a complete configs catalog:
@@ -92,7 +96,7 @@ def test_every_knob_is_documented():
         sources.extend(p for p in base.rglob("*.sh"))
     for path in sources:
         text = path.read_text(errors="ignore")
-        knobs.update(re.findall(r"\b(?:ENGINE|GOFR)_[A-Z][A-Z0-9_]+", text))
+        knobs.update(re.findall(r"\b(?:ENGINE|GOFR|QOS)_[A-Z][A-Z0-9_]+", text))
     docs = (root / "docs" / "configs.md").read_text()
     missing = sorted(k for k in knobs if k not in docs)
     assert not missing, f"undocumented knobs (add to docs/configs.md): {missing}"

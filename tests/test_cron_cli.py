@@ -7,6 +7,8 @@ from gofr_tpu.cli import CmdApp, CmdRequest
 from gofr_tpu.container import new_mock_container
 from gofr_tpu.cron import CronParseError, Crontab, Schedule
 
+pytestmark = pytest.mark.quick
+
 
 # -- cron parser (gofr cron.go:86-224 semantics) -------------------------------
 

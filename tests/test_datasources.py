@@ -10,6 +10,8 @@ from gofr_tpu.datasource.kv import KVStore
 from gofr_tpu.datasource.sql import connect_sql, insert_query, update_query
 from gofr_tpu.logging import MockLogger
 from gofr_tpu.metrics import Registry
+
+pytestmark = pytest.mark.quick
 from gofr_tpu.migration import Migration, run_migrations
 from gofr_tpu.pubsub.inmemory import InMemoryBroker
 

@@ -17,6 +17,10 @@ from gofr_tpu.models import LlamaConfig, llama
 from gofr_tpu.testutil import assert_paged_pool_consistent
 from gofr_tpu.tpu.engine import GenerateEngine
 
+# integration tier (CI `integration` job): multi-minute engine/process
+# runs — excluded from the tier-1 gate via -m 'not slow' (docs/testing.md)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def setup():

@@ -11,6 +11,11 @@ import time
 import httpx
 
 from tests.test_http_server import AppHarness
+import pytest
+
+# integration tier (CI `integration` job): multi-minute engine/process
+# runs — excluded from the tier-1 gate via -m 'not slow' (docs/testing.md)
+pytestmark = pytest.mark.slow
 
 EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
 
@@ -146,6 +151,43 @@ def test_serving_llm_websocket_streaming():
         pieces = asyncio.run(drive())
         assert pieces is not None and pieces, pieces
         assert all(isinstance(p, str) for p in pieces), pieces
+
+
+def test_using_qos_example():
+    """QoS example: interactive traffic serves, the batch class hits its
+    concurrency cap under a flood (429 + Retry-After), counters move."""
+    import threading
+
+    app = load_example("using-qos").build_app()
+    assert app.container.qos is not None  # QOS_ENABLED=true from configs/.env
+    statuses = []
+    lock = threading.Lock()
+
+    def flood(i):
+        with httpx.Client(timeout=300) as c:
+            r = c.post(f"http://127.0.0.1:{app.http_port}/generate",
+                       json={"prompt": [i + 1, 2, 3], "max_new_tokens": 24},
+                       headers={"X-QoS-Class": "batch"})
+            with lock:
+                statuses.append(r)
+
+    with AppHarness(app) as h, httpx.Client(base_url=h.base, timeout=300) as c:
+        threads = [threading.Thread(target=flood, args=(i,)) for i in range(10)]
+        for t in threads:
+            t.start()
+        r = c.post("/generate", json={"prompt": "hi", "max_new_tokens": 2,
+                                      "timeout": 120},
+                   headers={"X-QoS-Class": "interactive"})
+        assert r.status_code == 201, r.text
+        for t in threads:
+            t.join(timeout=300)
+        rejected = [r for r in statuses if r.status_code == 429]
+        assert rejected, "batch flood never hit the class concurrency cap"
+        for r in rejected:
+            assert "Retry-After" in r.headers
+        assert all(r.status_code in (201, 429, 503) for r in statuses)
+        m = httpx.get(f"http://127.0.0.1:{app.metrics_port}/metrics").text
+        assert "app_qos_rejected_total{" in m
 
 
 def test_rest_handlers_example():

@@ -1,7 +1,11 @@
 import io
 import json
 
+import pytest
+
 from gofr_tpu.logging import Level, Logger, MockLogger
+
+pytestmark = pytest.mark.quick
 
 
 def test_level_filtering():

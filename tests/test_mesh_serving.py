@@ -16,6 +16,10 @@ import pytest
 from gofr_tpu.container import new_mock_container
 from gofr_tpu.testutil import check_mesh_serving
 
+# integration tier (CI `integration` job): multi-minute engine/process
+# runs — excluded from the tier-1 gate via -m 'not slow' (docs/testing.md)
+pytestmark = pytest.mark.slow
+
 
 @pytest.mark.parametrize("config", [
     {"TPU_MESH": "dp:2,tp:4"},

@@ -1,5 +1,9 @@
+import pytest
+
 from gofr_tpu.logging import MockLogger
 from gofr_tpu.metrics import Registry, sample_runtime_metrics
+
+pytestmark = pytest.mark.quick
 
 
 def test_counter_inc_and_expose():

@@ -29,6 +29,10 @@ from gofr_tpu.train import TrainState, cross_entropy_loss, make_train_step
 from gofr_tpu.train.checkpoint import is_checkpoint_dir, save_params
 from gofr_tpu.utils.tokenizer import ByteTokenizer
 
+# integration tier (CI `integration` job): multi-minute engine/process
+# runs — excluded from the tier-1 gate via -m 'not slow' (docs/testing.md)
+pytestmark = pytest.mark.slow
+
 SEQ = 128
 
 

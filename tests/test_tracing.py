@@ -1,3 +1,5 @@
+import pytest
+
 from gofr_tpu.config import DictConfig
 from gofr_tpu.logging import MockLogger
 from gofr_tpu.tracing import (
@@ -8,6 +10,8 @@ from gofr_tpu.tracing import (
     parse_traceparent,
     tracer_from_config,
 )
+
+pytestmark = pytest.mark.quick
 
 
 def test_span_parenting():
