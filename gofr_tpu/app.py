@@ -254,8 +254,11 @@ class App:
         self._add_openapi_routes(http_app)
         if self._debug_env():
             # profiling tier, gated like the reference's pprof routes
-            # (http_server.go:53-60): trace capture on demand
+            # (http_server.go:53-60): trace capture on demand, plus the
+            # always-recording flight recorder's read endpoints
             http_app.router.add_get("/debug/profile", self._profile_handler)
+            http_app.router.add_get("/debug/requests", self._debug_requests_handler)
+            http_app.router.add_get("/debug/engine", self._debug_engine_handler)
 
         for method, path, handler in self._routes:
             http_app.router.add_route(method, path, self._wrap(handler))
@@ -508,6 +511,32 @@ class App:
             return web.json_response({"error": {"message": str(e)}}, status=500)
         return web.json_response({"data": {"trace_dir": path, "seconds": seconds}})
 
+    @staticmethod
+    def _debug_limit(request: web.Request) -> int | None:
+        try:
+            n = int(request.query.get("n", "0"))
+        except ValueError:
+            n = 0
+        return n if n > 0 else None
+
+    async def _debug_requests_handler(self, request: web.Request) -> web.Response:
+        """GET /debug/requests?n=K → the last K completed request timelines
+        (newest first) from the always-on flight recorder: queue wait, TTFT,
+        TPOT, e2e, slot, preemptions, trace id — incident diagnosis without
+        a trace backend attached (docs/observability.md)."""
+        entries = self.container.flight.requests(limit=self._debug_limit(request))
+        return web.json_response({"data": {"count": len(entries), "requests": entries}})
+
+    async def _debug_engine_handler(self, request: web.Request) -> web.Response:
+        """GET /debug/engine?n=K → the last K device steps (kind, wall time,
+        batch occupancy, compile signature, backlog) plus a health snapshot
+        of every served engine."""
+        steps = self.container.flight.steps(limit=self._debug_limit(request))
+        engines = {name: engine.health_check() if hasattr(engine, "health_check") else {}
+                   for name, engine in self.container.engines.items()}
+        return web.json_response(
+            {"data": {"count": len(steps), "steps": steps, "engines": engines}})
+
     def _add_openapi_routes(self, http_app: web.Application) -> None:
         from gofr_tpu.swagger import openapi_handler, swagger_ui_handler
 
@@ -545,7 +574,11 @@ class App:
             if msg is None:
                 continue
             container.metrics.increment_counter("app_pubsub_subscribe_total_count", 1, topic=topic)
-            span = container.tracer.start_span(f"subscribe {topic}", kind="CONSUMER", set_current=False)
+            # join the publisher's trace when the message carries one
+            # (Context.publish stamps traceparent into the broker headers)
+            span = container.tracer.start_span(
+                f"subscribe {topic}", kind="CONSUMER", set_current=False,
+                traceparent=msg.param("traceparent") or None)
             ctx = Context(msg, container, span=span)
             try:
                 result = handler(ctx)

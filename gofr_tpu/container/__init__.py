@@ -19,6 +19,7 @@ from typing import Any
 from gofr_tpu.config import DictConfig
 from gofr_tpu.logging import Level, Logger, MockLogger, new_logger
 from gofr_tpu.metrics import Registry, sample_runtime_metrics
+from gofr_tpu.metrics.flight import FlightRecorder
 from gofr_tpu.tracing import Tracer, tracer_from_config
 from gofr_tpu import version
 
@@ -32,6 +33,12 @@ class Container:
         self.logger: Logger = logger or new_logger(config.get_or_default("LOG_LEVEL", "INFO"))
         self.metrics: Registry = Registry(logger=self.logger)
         self.tracer: Tracer = Tracer()
+        # always-on ring of recent request timelines + engine steps
+        # (docs/observability.md; served at /debug/requests, /debug/engine)
+        self.flight = FlightRecorder(
+            max_requests=config.get_int("FLIGHT_REQUESTS", 256),
+            max_steps=config.get_int("FLIGHT_STEPS", 512),
+        )
 
         # datasource slots (None = not wired; config decides)
         self.sql = None
@@ -48,6 +55,7 @@ class Container:
         self._engines: dict[str, Any] = {}
         self.qos = None  # AdmissionController once App.enable_qos runs
         self._remote_level_poller = None
+        self._pubsub_hdr_support: tuple[Any, bool] | None = None  # per-broker probe cache
 
     # -- boot ------------------------------------------------------------------
 
@@ -97,6 +105,17 @@ class Container:
         m.new_gauge("app_tpu_prefix_cached_pages", "KV pages held by the prefix cache")
         m.new_counter("app_tpu_spec_proposed", "draft tokens proposed by speculative decoding")
         m.new_counter("app_tpu_spec_accepted", "draft tokens accepted by target verification")
+        # SLO latency family (docs/observability.md): recorded by the engine
+        # device loop / completion path regardless of QoS or tracing state
+        m.new_histogram("app_tpu_queue_wait_seconds",
+                        "enqueue-to-admission wait before the device loop picked the request")
+        m.new_histogram("app_tpu_ttft_seconds", "time to first token (s)")
+        m.new_histogram("app_tpu_tpot_seconds",
+                        "time per output token after the first (s)",
+                        buckets=[0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0])
+        m.new_histogram("app_tpu_e2e_seconds",
+                        "end-to-end request latency, submit to completion (by qos_class)")
+        m.new_gauge("app_tpu_inflight_requests", "requests submitted but not yet complete")
         # QoS / admission control (gofr_tpu.qos; all zero while QoS is off)
         m.new_counter("app_qos_admitted_total", "requests admitted by QoS")
         m.new_counter("app_qos_rejected_total",
@@ -120,6 +139,11 @@ class Container:
                 tpu._push_memory_gauges()
             except Exception:  # noqa: BLE001 - scrape must not fail on device hiccup
                 pass
+        # summed HERE rather than set by each engine: a per-engine write to
+        # the shared gauge would report whichever engine completed last
+        self.metrics.set_gauge(
+            "app_tpu_inflight_requests",
+            sum(getattr(e, "_inflight_requests", 0) for e in self._engines.values()))
 
     def _maybe_remote_log_level(self) -> None:
         url = self.config.get("REMOTE_LOG_URL")
@@ -262,11 +286,34 @@ class Container:
 
     # -- pubsub convenience ----------------------------------------------------
 
-    def publish(self, topic: str, payload: Any) -> None:
+    def _pubsub_supports_headers(self) -> bool:
+        """Signature-probed once per broker object (NOT try/except TypeError
+        around the send — that would conflate 'no headers parameter' with a
+        genuine TypeError inside a headers-capable broker and re-publish)."""
+        ps = self.pubsub
+        cached = self._pubsub_hdr_support
+        if cached is not None and cached[0] is ps:
+            return cached[1]
+        import inspect
+
+        try:
+            ok = "headers" in inspect.signature(ps.publish).parameters
+        except (TypeError, ValueError):  # builtins/C extensions: no signature
+            ok = False
+        self._pubsub_hdr_support = (ps, ok)
+        return ok
+
+    def publish(self, topic: str, payload: Any, headers: dict[str, str] | None = None) -> None:
         if self.pubsub is None:
             raise RuntimeError("no pubsub backend configured (set PUBSUB_BACKEND)")
         self.metrics.increment_counter("app_pubsub_publish_total_count", 1, topic=topic)
-        self.pubsub.publish(topic, payload)
+        if headers and self._pubsub_supports_headers():
+            # trace context (W3C traceparent) rides as message headers so
+            # subscribe handlers join the publisher's trace; an external
+            # plugin broker without header support still gets the message
+            self.pubsub.publish(topic, payload, headers=headers)
+        else:
+            self.pubsub.publish(topic, payload)
         self.metrics.increment_counter("app_pubsub_publish_success_count", 1, topic=topic)
 
     # -- health aggregation (gofr `container/health.go`) -----------------------

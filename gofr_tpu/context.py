@@ -106,7 +106,12 @@ class Context:
         return self.container.http_service(name)
 
     def publish(self, topic: str, payload: Any) -> None:
-        self.container.publish(topic, payload)
+        headers = None
+        if self.span is not None and self.span.sampled:
+            # trace context rides the message, so the subscriber's span
+            # joins this trace instead of starting a fresh one
+            headers = {"traceparent": self.span.traceparent()}
+        self.container.publish(topic, payload, headers=headers)
 
     # -- model inference (the TPU-native capability) ---------------------------
 
@@ -114,7 +119,13 @@ class Context:
         """Inject the request's QoS priority class (resolved by the QoS
         middleware/interceptor from the class header) into engine kwargs,
         unless the handler set one explicitly — scheduling follows the
-        transport classification with zero handler cooperation."""
+        transport classification with zero handler cooperation. Also carries
+        the request's server span to the engine (``_parent_span``): the
+        engine device loop runs on another thread, where contextvars can't
+        reach, so the span travels explicitly and the engine stitches its
+        queue_wait/prefill/decode children under it."""
+        if self.span is not None and "_parent_span" not in kw:
+            kw["_parent_span"] = self.span
         if "qos_class" in kw or "_qos_class" in kw:
             return kw
         req = self.request

@@ -90,6 +90,7 @@ class GofrGrpcInterceptor(grpc.ServerInterceptor):
             f"grpc {method}", traceparent=metadata.get("traceparent"), kind="SERVER",
             set_current=False,
         )
+        span.set_attribute("rpc.method", method)
         ctx = Context(_GRPCRequestAdapter(request, metadata), container, span=span)
         token = _grpc_ctx.set(ctx)
         return span, token
@@ -103,6 +104,9 @@ class GofrGrpcInterceptor(grpc.ServerInterceptor):
             # different thread; the token belongs to the serving thread's
             # context then. The span/log below must still run.
             pass
+        span.set_attribute("rpc.status_code", status)
+        if messages is not None:
+            span.set_attribute("rpc.messages", messages)
         span.finish()
         self._container.logger.info(
             RPCLog(method, status, int((time.perf_counter() - start) * 1e6),

@@ -38,6 +38,10 @@ def tracer_middleware(tracer: Tracer):
         try:
             response = await handler(request)
             span.set_attribute("http.status_code", getattr(response, "status", 0))
+            if hasattr(response, "headers"):
+                # clients (and support tickets) can quote the trace without
+                # a propagation-aware client library
+                response.headers.setdefault("X-Trace-Id", span.trace_id)
             return response
         except Exception:
             span.set_status("ERROR")
@@ -158,6 +162,11 @@ def qos_middleware(controller):
         if request.path.startswith("/.well-known/") or request.path == "/favicon.ico":
             return await handler(request)
         cls_name = controller.classify(request.headers)
+        span = request.get(SPAN_KEY)
+        if span is not None:
+            # the admission verdict belongs on the request's trace: a shed
+            # request's span shows WHY it never reached the engine
+            span.set_attribute("qos.class", cls_name)
         route = request.match_info.route
         template = (getattr(route.resource, "canonical", request.path)
                     if route and route.resource else request.path)
@@ -168,6 +177,8 @@ def qos_middleware(controller):
             cls_name=cls_name,
         )
         if not decision.allowed:
+            if span is not None:
+                span.set_attribute("qos.rejected", decision.reason)
             return web.json_response(
                 {"error": {"message": decision.message}},
                 status=decision.status,

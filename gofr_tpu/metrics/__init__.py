@@ -8,7 +8,11 @@ a dedicated port (`exporters/exporter.go:14-29`) that also samples process runti
 gauges per scrape (`handler.go:22-35`).
 
 TPU-first additions: the device datasource registers ``app_tpu_hbm_bytes``,
-``app_compile_cache_*`` and batch-occupancy histograms on this same registry.
+``app_compile_cache_*`` and batch-occupancy histograms on this same registry;
+the engines record the SLO latency family (``app_tpu_{queue_wait,ttft,tpot,
+e2e}_seconds``, ``app_tpu_inflight_requests``) here, and the sibling
+``metrics.flight`` module keeps the always-on ring of recent request
+timelines and device steps behind ``/debug/requests`` / ``/debug/engine``.
 """
 
 from __future__ import annotations

@@ -1,0 +1,64 @@
+"""Flight recorder: always-on ring buffers of recent serving activity.
+
+Metrics aggregate and traces need a backend attached *before* the incident
+— this is the third leg: the engines append every completed request's
+timeline (queue wait, TTFT, TPOT, e2e, slot, preemptions, trace id) and
+every device step (kind, wall time, occupancy, signature) into two bounded
+deques, so ``GET /debug/requests`` / ``GET /debug/engine`` can answer
+"what just happened" on a production box with nothing but curl.
+
+Cost discipline: one uncontended lock acquisition + a dict append per
+completed request / device step — never per token. The lock exists only
+because ``list(deque)`` raises if another thread appends mid-iteration;
+appends themselves are O(1) with bounded memory (maxlen evicts oldest).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any
+
+
+class FlightRecorder:
+    def __init__(self, max_requests: int = 256, max_steps: int = 512):
+        self._requests: collections.deque[dict[str, Any]] = collections.deque(
+            maxlen=max(1, int(max_requests)))
+        self._steps: collections.deque[dict[str, Any]] = collections.deque(
+            maxlen=max(1, int(max_steps)))
+        self._lock = threading.Lock()
+
+    # -- recording (engine side) -----------------------------------------------
+
+    def record_request(self, entry: dict[str, Any]) -> None:
+        with self._lock:
+            self._requests.append(entry)
+
+    def record_step(self, kind: str, seconds: float, occupancy: float,
+                    signature: Any, backlog: int = 0) -> None:
+        with self._lock:
+            self._steps.append({
+                "at": time.time(),
+                "kind": kind,
+                "seconds": round(float(seconds), 6),
+                "occupancy": round(float(occupancy), 4),
+                "signature": str(signature),
+                "backlog": int(backlog),
+            })
+
+    # -- inspection (debug endpoints / tests) ----------------------------------
+
+    def requests(self, limit: int | None = None) -> list[dict[str, Any]]:
+        """Completed request timelines, newest first."""
+        with self._lock:
+            out = list(self._requests)
+        out.reverse()
+        return out[:limit] if limit else out
+
+    def steps(self, limit: int | None = None) -> list[dict[str, Any]]:
+        """Device steps, newest first."""
+        with self._lock:
+            out = list(self._steps)
+        out.reverse()
+        return out[:limit] if limit else out

@@ -72,7 +72,11 @@ class Message:
 
 
 class PubSub(Protocol):
-    def publish(self, topic: str, payload: Any) -> None: ...
+    def publish(self, topic: str, payload: Any, headers: dict | None = None) -> None:
+        """Publish; ``headers`` (optional, in-tree brokers support it) carry
+        cross-cutting metadata like the W3C traceparent and surface on the
+        consumer side through ``Message.param``."""
+        ...
 
     def subscribe(self, topic: str, group: str = "") -> Message | None:
         """Block until the next message for ``topic`` (None on shutdown)."""

@@ -17,7 +17,9 @@ from gofr_tpu.pubsub import Message, encode_payload
 
 class InMemoryBroker:
     def __init__(self):
-        self._logs: dict[str, list[bytes]] = {}
+        # log entries are (payload bytes, headers-or-None): headers carry
+        # cross-cutting metadata like the W3C traceparent alongside the value
+        self._logs: dict[str, list[tuple[bytes, dict | None]]] = {}
         self._offsets: dict[tuple[str, str], int] = {}  # committed offset
         self._cursor: dict[tuple[str, str], int] = {}  # next delivery position
         # out-of-order commits (concurrent consumer workers): positions
@@ -26,12 +28,12 @@ class InMemoryBroker:
         self._cond = threading.Condition()
         self._closed = False
 
-    def publish(self, topic: str, payload: Any) -> None:
+    def publish(self, topic: str, payload: Any, headers: dict | None = None) -> None:
         data = encode_payload(payload)
         with self._cond:
             if self._closed:
                 raise RuntimeError("broker closed")
-            self._logs.setdefault(topic, []).append(data)
+            self._logs.setdefault(topic, []).append((data, dict(headers) if headers else None))
             self._cond.notify_all()
 
     def subscribe(self, topic: str, group: str = "default", timeout: float | None = None) -> Message | None:
@@ -44,11 +46,15 @@ class InMemoryBroker:
                 pos = self._cursor.get(key, self._offsets.get(key, 0))
                 if pos < len(log):
                     self._cursor[key] = pos + 1
-                    value = log[pos]
+                    value, headers = log[pos]
+                    # reserved delivery keys win over publisher headers — a
+                    # hostile 'offset'/'group' header must not clobber them
+                    metadata = dict(headers) if headers else {}
+                    metadata.update({"offset": pos, "group": group})
                     return Message(
                         topic,
                         value,
-                        metadata={"offset": pos, "group": group},
+                        metadata=metadata,
                         committer=lambda p=pos: self._commit(key, p),
                     )
                 if not self._cond.wait(timeout=timeout):

@@ -30,8 +30,11 @@ class KafkaBroker:
         self._consumers: dict[tuple[str, str], Any] = {}
         self._lock = threading.Lock()
 
-    def publish(self, topic: str, payload: Any) -> None:
-        self._producer.send(topic, encode_payload(payload)).get(timeout=30)
+    def publish(self, topic: str, payload: Any, headers: dict | None = None) -> None:
+        # Kafka record headers carry cross-cutting metadata (traceparent);
+        # the wire type is (str, bytes) pairs
+        hdrs = [(str(k), str(v).encode()) for k, v in headers.items()] if headers else None
+        self._producer.send(topic, encode_payload(payload), headers=hdrs).get(timeout=30)
 
     def _consumer(self, topic: str, group: str):
         # Keyed by calling THREAD as well: KafkaConsumer is not thread-safe,
@@ -65,10 +68,13 @@ class KafkaBroker:
             for record in batch:
                 # max_records=1 ⇒ this consumer's position only covers the
                 # one in-flight record, so commit() acknowledges exactly it
+                metadata = {"offset": record.offset, "partition": record.partition, "group": group}
+                for k, v in (getattr(record, "headers", None) or ()):
+                    metadata.setdefault(k, v.decode(errors="replace") if isinstance(v, bytes) else v)
                 return Message(
                     topic,
                     record.value,
-                    metadata={"offset": record.offset, "partition": record.partition, "group": group},
+                    metadata=metadata,
                     committer=consumer.commit,
                 )
         return None

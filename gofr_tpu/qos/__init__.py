@@ -26,7 +26,10 @@ middleware and gRPC interceptor, and binds every served engine
 wait estimator). Observability: ``app_qos_admitted_total``,
 ``app_qos_rejected_total`` (by reason/class), ``app_qos_shed_total``,
 per-class ``app_qos_queue_depth`` gauges, ``app_qos_queue_wait_seconds``,
-and per-engine ``app_qos_predicted_wait_seconds``.
+and per-engine ``app_qos_predicted_wait_seconds``; per-request, the
+admission verdict rides the trace (``qos.class`` / ``qos.rejected`` span
+attributes) and the class labels ``app_tpu_e2e_seconds`` plus the flight
+recorder's ``/debug/requests`` timelines (docs/observability.md).
 """
 
 from __future__ import annotations

@@ -42,9 +42,18 @@ def _fold_spec(eng, toks, accs, meta, k) -> None:
             s.request.complete(error=RequestTimeout())
             continue
         folded += 1
+        # per-request acceptance, mirroring the aggregate convention
+        # (full-round proposed even when EOS cuts the fold short; accepted
+        # credited per round BEFORE its tokens emit, so _maybe_finish —
+        # which may complete the request mid-loop — reads counters that
+        # include the finishing round). Surfaces as the spec.accept_rate
+        # span attribute and flight-recorder field.
+        kw = s.request.kw
+        kw["_spec_proposed"] = kw.get("_spec_proposed", 0) + k * eng.spec_tokens
         for kk in range(k):
             a = int(accs[kk, i])
             accepted += a
+            kw["_spec_accepted"] = kw.get("_spec_accepted", 0) + a
             for j in range(a + 1):
                 tok = int(toks[kk, i, j])
                 s.pos += 1

@@ -45,6 +45,7 @@ import numpy as np
 
 from gofr_tpu.http.errors import RequestTimeout
 from gofr_tpu.qos.scheduler import QoSQueue
+from gofr_tpu.tracing import RequestTrace, current_span
 from gofr_tpu.tpu.lockstep import TAG_CHUNK, TAG_DECODE, TAG_PREFILL, TAG_SPEC
 from gofr_tpu.native import plan_prefill
 from gofr_tpu.models.base import ModelSpec, get_family
@@ -163,6 +164,15 @@ class _EngineBase:
         self.metrics = container.metrics
         self.tpu = container.tpu
         self.default_timeout = default_timeout
+        # observability plumbing (docs/observability.md): the tracer drives
+        # the engine span timeline ONLY while a real exporter is configured
+        # (Tracer.enabled guards every span construction); the flight
+        # recorder is always on — a bounded ring of completed request
+        # timelines + device steps served at /debug/requests, /debug/engine
+        self.tracer = getattr(container, "tracer", None)
+        self.flight = getattr(container, "flight", None)
+        self._obs_lock = threading.Lock()
+        self._inflight_requests = 0
         # QoS-capable queue: pure FIFO (byte-for-byte queue.Queue behavior)
         # until an AdmissionController binds this engine and flips it into
         # weighted-fair priority mode (gofr_tpu.qos; App.enable_qos).
@@ -319,6 +329,10 @@ class _EngineBase:
             raise self._startup_error
         if "qos_class" in kw:  # public spelling of the internal routing key
             kw["_qos_class"] = kw.pop("qos_class")
+        # the inbound server span, carried EXPLICITLY (contextvars don't
+        # cross the submit-thread → device-loop boundary); popped even when
+        # tracing is off so a span object never lingers in request kw
+        parent_span = kw.pop("_parent_span", None)
         eff_timeout = timeout if timeout is not None else self.default_timeout
         qos, cls = self.qos, None
         if qos is not None:
@@ -331,13 +345,108 @@ class _EngineBase:
         req = Request(inputs, kw, eff_timeout, stream)
         if cls is not None:
             qos.track(req, cls)
+        self._observe_submit(req, parent_span)
         self._queue.put(req)
         self.metrics.set_gauge("app_tpu_queue_depth", self._backlog())
         return req
 
+    # -- request-lifecycle observability ---------------------------------------
+
+    def _observe_submit(self, req: Request, parent_span) -> None:
+        """Open the request's observability lifecycle: span timeline (only
+        behind ``Tracer.enabled`` — with ``TRACE_EXPORTER=none`` this whole
+        path costs one branch and allocates nothing), the in-flight gauge,
+        and the completion hook that records SLO metrics + the flight
+        timeline however the request ends (result, error, timeout, stop)."""
+        tracer = self.tracer
+        if tracer is not None and tracer.enabled:
+            if parent_span is None:
+                parent_span = current_span()
+            if parent_span is None or parent_span.sampled:
+                rt = RequestTrace(tracer, parent_span)
+                req.kw["_rt"] = rt
+                rt.begin("engine.queue_wait",
+                         **{"qos.class": req.kw.get("_qos_class") or "none",
+                            "queue.depth": self._backlog()})
+        with self._obs_lock:
+            # per-engine counter; the app_tpu_inflight_requests gauge is
+            # summed across registered engines at scrape time (container
+            # collect hook) — an engine-side set here would flap the global
+            # gauge between per-engine values when several engines serve
+            self._inflight_requests += 1
+        req.add_done_callback(self._observe_done)
+
+    def _observe_done(self, req: Request) -> None:
+        now = time.monotonic()
+        with self._obs_lock:
+            self._inflight_requests -= 1
+        result, error = req.outcome()
+        kw = req.kw
+        rt = kw.pop("_rt", None)
+        if rt is not None:
+            rt.close_all(error)
+        e2e = now - req.enqueued_at
+        if error is None:
+            # completed work only: a timeout/shed storm must not drag the
+            # served-latency SLO histogram toward its own failure mode
+            self.metrics.record_histogram(
+                "app_tpu_e2e_seconds", e2e, qos_class=kw.get("_qos_class") or "none")
+        if self.flight is None:
+            return
+        admitted = kw.get("_admitted_at")
+        first = kw.get("_first_token_at")
+        entry: dict[str, Any] = {
+            "id": req.id,
+            "completed_at": time.time(),
+            "qos_class": kw.get("_qos_class"),
+            "e2e_s": round(e2e, 6),
+            "queue_wait_s": round(admitted - req.enqueued_at, 6) if admitted is not None else None,
+            "ttft_s": round(first - req.enqueued_at, 6) if first is not None else None,
+            "slot": kw.get("_slot"),
+            "prompt_len": kw.get("_prompt_len"),
+            "preemptions": kw.get("_preemptions", 0),
+            "trace_id": rt.trace_id if rt is not None else None,
+        }
+        proposed = kw.get("_spec_proposed")
+        if proposed:
+            entry["spec_accept_rate"] = round(
+                kw.get("_spec_accepted", 0) / proposed, 4)
+        if error is not None:
+            entry["error"] = type(error).__name__
+        elif isinstance(result, dict) and "finish_reason" in result:
+            entry["finish_reason"] = result.get("finish_reason")
+            toks = result.get("tokens")
+            if toks is not None:
+                entry["new_tokens"] = len(toks)
+                if first is not None and len(toks) > 1:
+                    entry["tpot_s"] = round((now - first) / (len(toks) - 1), 6)
+        self.flight.record_request(entry)
+
+    def _mark_admitted(self, req: Request, now: float) -> None:
+        """First pick-up by the device loop: close the queue-wait phase.
+        Guarded so preemption-by-recompute re-admissions don't double-count
+        the SLO histogram."""
+        if "_admitted_at" not in req.kw:
+            req.kw["_admitted_at"] = now
+            self.metrics.record_histogram(
+                "app_tpu_queue_wait_seconds", now - req.enqueued_at)
+        rt = req.kw.get("_rt")
+        if rt is not None:
+            rt.end("engine.queue_wait")
+
+    def _mark_first_token(self, req: Request) -> None:
+        """Stamp TTFT exactly once (preemption preserves the original)."""
+        if "_first_token_at" not in req.kw:
+            ft = time.monotonic()
+            req.kw["_first_token_at"] = ft
+            self.metrics.record_histogram(
+                "app_tpu_ttft_seconds", ft - req.enqueued_at)
+
     def _record_step(self, kind: str, seconds: float, occupancy: float, signature: tuple) -> None:
         self.metrics.record_histogram("app_tpu_step_seconds", seconds, kind=kind)
         self.metrics.record_histogram("app_tpu_batch_occupancy", occupancy, kind=kind)
+        if self.flight is not None:
+            self.flight.record_step(kind, seconds, occupancy, signature, self._backlog())
         if self.qos is not None:
             self.qos.observe_step(seconds)  # feeds the queue-wait estimator
         if signature in self._compiled:
@@ -472,6 +581,12 @@ class BatchEngine(_EngineBase):
         arrays = [np.asarray(self.encode_fn(r.inputs)) for r in batch]
         n = len(arrays)
         nb = next_bucket(n, self.batch_buckets)
+        now = time.monotonic()
+        for r in batch:
+            self._mark_admitted(r, now)
+            rt = r.kw.get("_rt")
+            if rt is not None:
+                rt.begin("engine.infer", **{"batch.size": n, "batch.bucket": nb})
         self._inflight = list(batch)
         t0 = time.monotonic()
 
@@ -498,6 +613,9 @@ class BatchEngine(_EngineBase):
         self._record_step("batch", time.monotonic() - t0, n / nb, signature)
         self.metrics.increment_counter("app_tpu_tokens_total", int(n))
         for i, r in enumerate(batch):
+            rt = r.kw.get("_rt")
+            if rt is not None:
+                rt.end("engine.infer", **{"batch.occupancy": n / nb})
             r.complete(result=self.decode_fn(out[i]))  # idempotent: no-op if already failed
 
 
@@ -1286,6 +1404,15 @@ class GenerateEngine(_EngineBase):
         s = self.slots[idx]
         self._free_slot(idx)
         req = s.request
+        req.kw["_preemptions"] = req.kw.get("_preemptions", 0) + 1
+        rt = req.kw.get("_rt")
+        if rt is not None:
+            # whichever phase the slot was in ends here (a slot still mid-
+            # chunked-prefill has no decode span yet; end() no-ops on the
+            # other); re-admission opens a fresh engine.prefill span, so the
+            # trace shows the recompute round-trip
+            rt.end("engine.prefill", preempted=True)
+            rt.end("engine.decode", preempted=True)
         req.kw["_prior_tokens"] = list(req.kw.get("_prior_tokens", [])) + list(s.generated)
         req.kw["max_new_tokens"] = max(
             1, int(req.kw.get("max_new_tokens", 64)) - len(s.generated)
@@ -1406,6 +1533,14 @@ class GenerateEngine(_EngineBase):
             )
             self._admit_seq += 1
             self.slots[idx] = slot
+            self._mark_admitted(req, time.monotonic())
+            req.kw["_slot"] = idx
+            req.kw["_prompt_len"] = slot.prompt_len
+            rt = req.kw.get("_rt")
+            if rt is not None:
+                rt.begin("engine.prefill",
+                         **{"slot": idx, "prompt.tokens": slot.prompt_len,
+                            "prefill.chunked": True})
             self._prefix_hit(idx, slot, toks)
 
     def _advance_chunked(self) -> bool:
@@ -1468,10 +1603,17 @@ class GenerateEngine(_EngineBase):
                               chunk / lb, ("prefill_chunk", lb, 1))
             self.metrics.increment_counter("app_tpu_tokens_total", chunk)
             s.written += chunk
+            rt = s.request.kw.get("_rt")
+            if rt is not None:
+                rt.event("engine.prefill", "chunk",
+                         offset=s.written - chunk, tokens=chunk, bucket=lb)
             if last:
                 self._prefix_insert(idx)
                 tok = int(first[0])
-                s.request.kw.setdefault("_first_token_at", time.monotonic())
+                self._mark_first_token(s.request)
+                if rt is not None:
+                    rt.end("engine.prefill")
+                    rt.begin("engine.decode", **{"slot": idx})
                 s.generated = [tok]
                 s.last_token = tok
                 s.pos = s.prompt_len
@@ -1545,6 +1687,15 @@ class GenerateEngine(_EngineBase):
                         )
                         self._admit_seq += 1
                         self.slots[idx] = slot
+                        self._mark_admitted(req, time.monotonic())
+                        req.kw["_slot"] = idx
+                        req.kw["_prompt_len"] = slot.prompt_len
+                        rt = req.kw.get("_rt")
+                        if rt is not None:
+                            rt.begin("engine.prefill",
+                                     **{"slot": idx, "prompt.tokens": slot.prompt_len,
+                                        "prefill.chunked": True,
+                                        "prefix.hit_pages": len(pages)})
                         self._prefix_hit(idx, slot, toks)
                         chunk_claimed = True
                     else:
@@ -1600,6 +1751,12 @@ class GenerateEngine(_EngineBase):
             lengths = packed[:, lb].copy()
 
             t0 = time.monotonic()
+            for req, _ in ready:
+                self._mark_admitted(req, t0)
+                rt = req.kw.get("_rt")
+                if rt is not None:
+                    rt.begin("engine.prefill",
+                             **{"prefill.len_bucket": lb, "prefill.batch": nb})
             self._inflight = [req for req, _ in ready]
 
         self._announce(TAG_PREFILL, lb, nb, packed)
@@ -1626,7 +1783,14 @@ class GenerateEngine(_EngineBase):
 
             for i, (req, toks) in enumerate(ready):
                 tok = int(first[i])
-                req.kw.setdefault("_first_token_at", time.monotonic())
+                self._mark_first_token(req)
+                req.kw["_slot"] = free[i]
+                req.kw["_prompt_len"] = int(lengths[i])
+                rt = req.kw.get("_rt")
+                if rt is not None:
+                    rt.end("engine.prefill",
+                           **{"slot": free[i], "batch.occupancy": n / nb})
+                    rt.begin("engine.decode", **{"slot": free[i]})
                 slot = _Slot(
                     req,
                     prompt_len=int(lengths[i]),
@@ -1709,12 +1873,29 @@ class GenerateEngine(_EngineBase):
             if text:
                 s.request.stream_q.put(text)
             tail.clear()
+        now = time.monotonic()
+        ft = s.request.kw.get("_first_token_at", s.first_token_at)
+        if len(tokens) > 1:
+            # steady-state decode pace: first token excluded (that's TTFT's
+            # job), so tpot isolates the per-token device-loop cost
+            self.metrics.record_histogram(
+                "app_tpu_tpot_seconds", (now - ft) / (len(tokens) - 1))
+        rt = s.request.kw.get("_rt")
+        if rt is not None:
+            attrs: dict[str, Any] = {"tokens": len(tokens), "finish.reason": finish}
+            proposed = s.request.kw.get("_spec_proposed", 0)
+            if proposed:
+                attrs["spec.accept_rate"] = round(
+                    s.request.kw.get("_spec_accepted", 0) / proposed, 4)
+            rt.end("engine.decode", **attrs)
+            # covers detokenization + completion bookkeeping; closed by the
+            # done callback's close_all right after complete() below
+            rt.begin("engine.finish")
         result = {
             "tokens": tokens,
             "text": self.tokenizer.decode(tokens) if self.tokenizer is not None else None,
             "finish_reason": finish,
-            "ttft_s": s.request.kw.get("_first_token_at", s.first_token_at)
-            - s.request.enqueued_at,
+            "ttft_s": ft - s.request.enqueued_at,
         }
         self._free_slot(slot_idx)
         s.request.complete(result=result)

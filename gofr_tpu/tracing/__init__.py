@@ -2,22 +2,28 @@
 
 Capability parity with the reference's tracing (gofr `pkg/gofr/gofr.go:307-422`,
 `pkg/gofr/exporter.go`): a process-global tracer initialized from config
-(``TRACE_EXPORTER`` = none|console|zipkin|otlp), per-request server spans with
-traceparent extraction, child spans per datasource call and per user
-``ctx.trace(name)``, and a background-batched HTTP span exporter (Zipkin JSON v2
-— the format the reference's custom exporter also emits, `exporter.go:49-125`).
+(``TRACE_EXPORTER`` = none|console|zipkin|otlp|memory), per-request server spans
+with traceparent extraction, child spans per datasource call and per user
+``ctx.trace(name)``, and background-batched HTTP span exporters — Zipkin JSON v2
+(the format the reference's custom exporter also emits, `exporter.go:49-125`)
+and OTLP/HTTP JSON for OpenTelemetry collectors.
 
 Self-contained by design: spans are plain objects + contextvars, so tracing adds
-no hot-path dependency; the TPU engine reuses the same spans to stitch
-enqueue → batch → device-step timelines.
+no hot-path dependency. The TPU engine reuses the same spans to stitch
+enqueue → batch → device-step timelines: ``RequestTrace`` carries the inbound
+server span across the submit-thread → device-loop boundary (contextvars don't
+cross threads) and hangs ``engine.queue_wait``/``engine.prefill``/
+``engine.decode``/``engine.finish`` children under it, guarded by
+``Tracer.enabled`` so ``TRACE_EXPORTER=none`` costs the serving loop one branch
+(docs/observability.md).
 """
 
 from __future__ import annotations
 
 import contextvars
 import json
+import os
 import queue
-import random
 import threading
 import time
 import urllib.request
@@ -30,13 +36,16 @@ _current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
 
 
 def _rand_hex(nbytes: int) -> str:
-    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+    # os.urandom: fork-safe and never seed-correlated — the global `random`
+    # module would hand every pre-forked worker (and every process sharing a
+    # seeded RNG) colliding trace/span ids
+    return os.urandom(nbytes).hex()
 
 
 class Span:
     __slots__ = (
         "name", "trace_id", "span_id", "parent_id", "start", "end",
-        "attributes", "status", "kind", "sampled", "_tracer", "_token",
+        "attributes", "status", "kind", "sampled", "events", "_tracer", "_token",
     )
 
     def __init__(self, name: str, trace_id: str, span_id: str, parent_id: str | None,
@@ -51,11 +60,20 @@ class Span:
         self.attributes: dict[str, Any] = {}
         self.status: str = "OK"
         self.kind = kind
+        self.events: list[dict[str, Any]] | None = None  # lazily allocated
         self._tracer = tracer
         self._token: contextvars.Token | None = None
 
     def set_attribute(self, key: str, value: Any) -> "Span":
         self.attributes[key] = value
+        return self
+
+    def add_event(self, name: str, **attributes: Any) -> "Span":
+        """Attach a timestamped point event (e.g. one chunked-prefill chunk)
+        — cheaper than a child span for things with no meaningful duration."""
+        if self.events is None:
+            self.events = []
+        self.events.append({"name": name, "ts": time.time(), "attributes": attributes})
         return self
 
     def set_status(self, status: str) -> "Span":
@@ -157,17 +175,100 @@ class ZipkinExporter(SpanExporter):
             pass
 
     def _to_zipkin(self, s: Span) -> dict[str, Any]:
-        return {
+        out = {
             "id": s.span_id,
             "traceId": s.trace_id,
-            "parentId": s.parent_id,
             "name": s.name,
             "timestamp": int(s.start * 1e6),
             "duration": s.duration_us,
-            "kind": "SERVER" if s.kind == "SERVER" else "CLIENT" if s.kind == "CLIENT" else None,
             "localEndpoint": {"serviceName": self.service_name},
             "tags": {str(k): str(v) for k, v in s.attributes.items()},
         }
+        # absent fields are OMITTED, not null: strict Zipkin collectors
+        # reject literal `"kind": null` / `"parentId": null` payloads
+        if s.parent_id:
+            out["parentId"] = s.parent_id
+        if s.kind in ("SERVER", "CLIENT", "PRODUCER", "CONSUMER"):
+            out["kind"] = s.kind
+        if s.events:
+            out["annotations"] = [
+                {"timestamp": int(e["ts"] * 1e6), "value": e["name"]} for e in s.events
+            ]
+        return out
+
+
+# OTLP SpanKind enum (trace.proto): engine/user spans are INTERNAL
+_OTLP_KIND = {"INTERNAL": 1, "SERVER": 2, "CLIENT": 3, "PRODUCER": 4, "CONSUMER": 5}
+
+
+def _otlp_value(v: Any) -> dict[str, Any]:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # proto3 JSON: int64 as string
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _otlp_attrs(attrs: dict[str, Any]) -> list[dict[str, Any]]:
+    return [{"key": str(k), "value": _otlp_value(v)} for k, v in attrs.items()]
+
+
+class OTLPExporter(SpanExporter):
+    """OTLP/HTTP JSON exporter: POSTs an ``ExportTraceServiceRequest`` to a
+    collector's ``/v1/traces`` endpoint (proto3 JSON mapping of
+    opentelemetry/proto/trace/v1 — the wire format every OTel collector
+    accepts on :4318). Closes the documented ``TRACE_EXPORTER=otlp`` gap."""
+
+    def __init__(self, endpoint: str, service_name: str, timeout: float = 5.0):
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.timeout = timeout
+
+    def export(self, spans: list[Span]) -> None:
+        body = json.dumps(self.to_payload(spans)).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body, headers={"Content-Type": "application/json"}, method="POST"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout).close()
+        except Exception:  # noqa: BLE001 - tracing must never break serving
+            pass
+
+    def to_payload(self, spans: list[Span]) -> dict[str, Any]:
+        return {
+            "resourceSpans": [{
+                "resource": {"attributes": _otlp_attrs({"service.name": self.service_name})},
+                "scopeSpans": [{
+                    "scope": {"name": "gofr_tpu"},
+                    "spans": [self._to_otlp(s) for s in spans],
+                }],
+            }]
+        }
+
+    def _to_otlp(self, s: Span) -> dict[str, Any]:
+        out = {
+            "traceId": s.trace_id,
+            "spanId": s.span_id,
+            "name": s.name,
+            "kind": _OTLP_KIND.get(s.kind, 1),
+            "startTimeUnixNano": str(int(s.start * 1e9)),
+            "endTimeUnixNano": str(int((s.end if s.end is not None else time.time()) * 1e9)),
+            "attributes": _otlp_attrs(s.attributes),
+            # STATUS_CODE_ERROR=2; finished-OK spans report UNSET (0), the
+            # OTel default for spans nobody explicitly marked
+            "status": {"code": 2, "message": "error"} if s.status == "ERROR" else {},
+        }
+        if s.parent_id:
+            out["parentSpanId"] = s.parent_id
+        if s.events:
+            out["events"] = [
+                {"timeUnixNano": str(int(e["ts"] * 1e9)), "name": e["name"],
+                 "attributes": _otlp_attrs(e["attributes"])}
+                for e in s.events
+            ]
+        return out
 
 
 class Tracer:
@@ -184,6 +285,13 @@ class Tracer:
         if not isinstance(self._exporter, (NoopExporter, MemoryExporter, ConsoleExporter)):
             self._worker = threading.Thread(target=self._run, name="gofr-span-export", daemon=True)
             self._worker.start()
+
+    @property
+    def enabled(self) -> bool:
+        """False when spans go nowhere (``TRACE_EXPORTER=none``) — the hot
+        path's guard: callers skip span construction entirely, so disabled
+        tracing costs one attribute read and an isinstance check."""
+        return not isinstance(self._exporter, NoopExporter)
 
     def start_span(self, name: str, parent: Span | None = None,
                    traceparent: str | None = None, kind: str = "INTERNAL",
@@ -266,6 +374,69 @@ def current_span() -> Span | None:
     return _current_span.get()
 
 
+class RequestTrace:
+    """Per-request engine span bundle, carried across the submit-thread →
+    device-loop boundary on the request's kw context.
+
+    contextvars do NOT cross threads — the HTTP/gRPC/pubsub server span is
+    therefore propagated *explicitly* as ``parent`` and every engine child
+    (``engine.queue_wait`` → ``engine.prefill`` → ``engine.decode`` →
+    ``engine.finish``) starts with ``set_current=False``, so the device
+    thread's contextvar state is never touched. Without an inbound parent a
+    synthetic ``engine.request`` root is opened so direct ``engine.generate``
+    callers still get a stitched timeline. Construct only behind
+    ``Tracer.enabled`` — this object existing *is* the per-request cost."""
+
+    __slots__ = ("tracer", "parent", "trace_id", "spans", "_root")
+
+    def __init__(self, tracer: "Tracer", parent: Span | None = None):
+        self.tracer = tracer
+        if parent is None:
+            parent = tracer.start_span("engine.request", set_current=False)
+            self._root: Span | None = parent
+        else:
+            self._root = None
+        self.parent = parent
+        self.trace_id = parent.trace_id
+        self.spans: dict[str, Span] = {}
+
+    def begin(self, name: str, **attrs: Any) -> Span:
+        span = self.tracer.start_span(name, parent=self.parent, set_current=False)
+        if attrs:
+            span.attributes.update(attrs)
+        self.spans[name] = span
+        return span
+
+    def end(self, name: str, **attrs: Any) -> None:
+        """Finish the named phase span; no-op when it was never begun or
+        already ended (re-admission after preemption re-begins phases)."""
+        span = self.spans.pop(name, None)
+        if span is not None:
+            if attrs:
+                span.attributes.update(attrs)
+            span.finish()
+
+    def event(self, within: str, name: str, **attrs: Any) -> None:
+        span = self.spans.get(within)
+        if span is not None:
+            span.add_event(name, **attrs)
+
+    def close_all(self, error: Exception | None = None) -> None:
+        """Finish every still-open span (and the synthetic root) — the
+        request's done callback calls this so cancelled/timed-out/failed
+        requests never leak open spans."""
+        spans, self.spans = self.spans, {}
+        for span in spans.values():
+            if error is not None:
+                span.status = "ERROR"
+                span.attributes.setdefault("error", repr(error))
+            span.finish()
+        if self._root is not None:
+            if error is not None:
+                self._root.status = "ERROR"
+            self._root.finish()
+
+
 def parse_traceparent(header: str) -> tuple[str, str, bool] | None:
     """Parse a W3C traceparent ``00-<32hex traceid>-<16hex spanid>-<flags>``.
 
@@ -293,11 +464,21 @@ def tracer_from_config(config, logger, service_name: str) -> Tracer:
         return Tracer(NoopExporter())
     if exporter_name == "console":
         return Tracer(ConsoleExporter(logger))
+    if exporter_name == "memory":
+        # in-process collection for tests/debugging: assert on
+        # container.tracer._exporter.spans with no network in the loop
+        return Tracer(MemoryExporter())
     if exporter_name == "otlp":
-        # OTLP/HTTP is a distinct wire format; silently POSTing Zipkin JSON at an
-        # OTLP collector would drop every span with zero diagnostics.
-        logger.warn("TRACE_EXPORTER=otlp is not implemented yet; use zipkin. Tracing disabled")
-        return Tracer(NoopExporter())
+        url = config.get("TRACER_URL") or config.get("TRACER_HOST")
+        if not url:
+            logger.warn("TRACE_EXPORTER=otlp but TRACER_URL missing; tracing disabled")
+            return Tracer(NoopExporter())
+        if not url.startswith("http"):
+            port = config.get_or_default("TRACER_PORT", "4318") if hasattr(config, "get_or_default") else "4318"
+            url = f"http://{url}:{port}"
+        if "/v1/traces" not in url:
+            url = url.rstrip("/") + "/v1/traces"
+        return Tracer(OTLPExporter(url, service_name))
     if exporter_name in ("zipkin", "gofr"):
         url = config.get("TRACER_URL") or config.get("TRACER_HOST")
         if not url:

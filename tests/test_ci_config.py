@@ -120,6 +120,29 @@ def test_quick_tier_marker_coverage():
     assert len(marked) >= 5, f"quick tier shrank to {marked}"
 
 
+def test_ci_has_py310_compat_gate():
+    """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
+    syntax (same-quote nested f-strings) passes every 3.12 job silently and
+    then breaks collection for anyone on the oldest supported interpreter
+    (PR 1 lost most of the suite to exactly this)."""
+    ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+    gates = [
+        name for name, job in ci["jobs"].items()
+        if any("compileall" in step.get("run", "") for step in job.get("steps", []))
+        and any(str(step.get("with", {}).get("python-version", "")) == "3.10"
+                for step in job.get("steps", []))
+    ]
+    assert gates, (
+        "ci.yml has no job compiling the tree under python 3.10 "
+        "(compileall on a setup-python 3.10 runner)"
+    )
+    # the gate must cover the package AND the test tree — a 3.12-only
+    # f-string in tests/ is how the original regression landed
+    for name in gates:
+        runs = " ".join(s.get("run", "") for s in ci["jobs"][name]["steps"])
+        assert "gofr_tpu" in runs and "tests" in runs
+
+
 def test_ci_runs_the_quick_tier():
     ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
     quick_runs = [

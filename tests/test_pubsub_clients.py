@@ -21,10 +21,11 @@ from gofr_tpu.logging import MockLogger
 
 
 class _FakeRecord:
-    def __init__(self, value, offset, partition=0):
+    def __init__(self, value, offset, partition=0, headers=None):
         self.value = value
         self.offset = offset
         self.partition = partition
+        self.headers = headers  # (str, bytes) pairs, like kafka-python
 
 
 class _FakeKafkaState:
@@ -48,8 +49,8 @@ def _install_fake_kafka(state: _FakeKafkaState):
         def __init__(self, bootstrap_servers=None, **kw):
             self.kw = kw
 
-        def send(self, topic, value):
-            state.topics.setdefault(topic, []).append(value)
+        def send(self, topic, value, headers=None):
+            state.topics.setdefault(topic, []).append((value, headers))
             return _Future()
 
         def bootstrap_connected(self):
@@ -72,7 +73,8 @@ def _install_fake_kafka(state: _FakeKafkaState):
             if cur >= len(log):
                 return {}
             state.cursors[self.id] = cur + 1
-            return {("tp", 0): [_FakeRecord(log[cur], cur)]}
+            value, headers = log[cur]
+            return {("tp", 0): [_FakeRecord(value, cur, headers=headers)]}
 
         def commit(self):
             state.commits.append((self.id, self.topic))
@@ -117,6 +119,18 @@ def test_kafka_publish_subscribe_commit(kafka_broker):
 
     assert broker.subscribe("orders", group="g1") is None  # log drained
     assert broker.health_check()["status"] == "UP"
+
+
+def test_kafka_headers_round_trip(kafka_broker):
+    """Trace context (traceparent) rides Kafka record headers and surfaces
+    on the consumer Message's metadata (docs/observability.md)."""
+    broker, state = kafka_broker
+    broker.publish("traced", {"n": 2}, headers={"traceparent": "00-abc"})
+    msg = broker.subscribe("traced", group="g1")
+    assert msg is not None
+    assert msg.param("traceparent") == "00-abc"
+    # reserved metadata keys are never clobbered by a hostile header
+    assert msg.metadata["offset"] == 0
 
 
 def test_kafka_consumers_keyed_per_thread(kafka_broker):

@@ -84,6 +84,62 @@ def test_embed_over_http():
         assert "app_tpu_device_count" in m.text
 
 
+def test_http_trace_stitches_engine_timeline_and_debug_endpoints():
+    """Acceptance: a traced generate over HTTP yields ONE trace (server span
+    + engine children), non-empty SLO histograms on /metrics, and the flight
+    recorder's /debug endpoints serve the request's timeline."""
+    from gofr_tpu.tracing import MemoryExporter, Tracer
+
+    app = make_app({"APP_ENV": "DEBUG"})
+    app.container.tracer = Tracer(MemoryExporter())
+    spec = ModelSpec("llama", LlamaConfig.tiny(), task="generate", dtype=jnp.float32)
+    app.serve_model("lm", spec, slots=2, max_len=32)
+    app.post("/generate", lambda ctx: ctx.generate(
+        "lm", ctx.bind(dict)["prompt"], max_new_tokens=3, timeout=120))
+
+    inbound = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+    with AppHarness(app) as h, httpx.Client(base_url=h.base, timeout=180) as client:
+        r = client.post("/generate", json={"prompt": [1, 2, 3]},
+                        headers={"traceparent": inbound})
+        assert r.status_code == 201, r.text
+        assert r.headers["X-Trace-Id"] == "a" * 32
+
+        spans = app.container.tracer._exporter.spans
+        by_name = {s.name: s for s in spans}
+        server = by_name["POST /generate"]
+        assert server.trace_id == "a" * 32
+        assert server.parent_id == "b" * 16
+        for name in ("engine.queue_wait", "engine.prefill", "engine.decode"):
+            assert by_name[name].trace_id == server.trace_id, name
+            assert by_name[name].parent_id == server.span_id, name
+
+        m = httpx.get(f"http://127.0.0.1:{app.metrics_port}/metrics").text
+        for metric in ("app_tpu_ttft_seconds", "app_tpu_tpot_seconds",
+                       "app_tpu_e2e_seconds"):
+            counts = [line for line in m.splitlines()
+                      if line.startswith(f"{metric}_count") and not line.endswith(" 0")]
+            assert counts, f"{metric} empty in exposition"
+
+        r = client.get("/debug/requests")
+        assert r.status_code == 200
+        reqs = r.json()["data"]["requests"]
+        assert reqs and reqs[0]["finish_reason"] == "length"
+        assert reqs[0]["trace_id"] == "a" * 32
+        assert reqs[0]["ttft_s"] is not None
+
+        r = client.get("/debug/engine")
+        assert r.status_code == 200
+        data = r.json()["data"]
+        assert data["steps"], "no engine steps recorded"
+        assert data["engines"]["lm"]["status"] in ("UP", "DEGRADED")
+
+
+def test_debug_endpoints_gated_outside_debug_env(lm_app):
+    with AppHarness(lm_app) as h, httpx.Client(base_url=h.base, timeout=60) as client:
+        assert client.get("/debug/requests").status_code == 404
+        assert client.get("/debug/engine").status_code == 404
+
+
 def test_unknown_model_is_client_error(lm_app):
     def bad(ctx):
         return ctx.generate("nope", [1], timeout=5)
