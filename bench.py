@@ -31,7 +31,13 @@ Env knobs:
     GOFR_BENCH_SPEC           N>0 = speculative decoding with N lookup drafts
     GOFR_BENCH_PREFIX         1 = also measure the shared-prefix workload on the
                               paged engine (prefix cache on vs off)
-    GOFR_BENCH_PIPELINE       decode dispatch pipelining depth (default 2; 1 = sync)
+    GOFR_BENCH_PIPELINE       device pipeline depth (default 2; 1 = sync, up to 4)
+    GOFR_BENCH_OVERLAP_AB     1 = also measure the mixed-arrival workload (paced
+                              arrivals of short + chunked-long prompts) with the
+                              unified async pipeline on (depth>=2) vs off (1),
+                              recording req/s and TTFT for each
+    GOFR_BENCH_ARRIVAL_MS     mixed-arrival inter-arrival gap in ms (default
+                              adaptive: headline elapsed / requests / 2)
     GOFR_BENCH_LATENCY        1 = also measure sequential single-request latency
     GOFR_BENCH_SWEEP          1 = sweep slots x decode_chunk, keep best
     GOFR_BENCH_PALLAS_AB      1 = record kernel-on/off engine A/B
@@ -205,6 +211,39 @@ def _run_once(engine_kw: dict, cfg, params, container, family, prompts,
     return out
 
 
+def _run_mixed(engine_kw: dict, cfg, params, container, family, prompts,
+               max_new: int, timeout: float, arrival_s: float) -> dict:
+    """Serve ``prompts`` with PACED arrivals (one submit per ``arrival_s``,
+    not an up-front burst): the workload where synchronous prefill stalls
+    every decoding slot for a full device round trip per arrival, and the
+    unified async pipeline keeps them stepping. Returns raw measurements."""
+    from gofr_tpu.tpu.engine import GenerateEngine
+
+    engine = GenerateEngine(family, cfg, params, container, **engine_kw)
+    try:
+        engine.warmup()
+        engine.start()
+        engine.generate(prompts[-1], max_new_tokens=2, timeout=timeout)
+
+        t0 = time.monotonic()
+        reqs = []
+        for i, p in enumerate(prompts):
+            target = t0 + i * arrival_s
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            reqs.append(engine.submit(p, max_new_tokens=max_new, timeout=timeout))
+        results = [r.result(timeout) for r in reqs]
+        elapsed = time.monotonic() - t0
+    finally:
+        engine.stop()
+    return {
+        "elapsed": elapsed,
+        "new_tokens": sum(len(r["tokens"]) for r in results),
+        "ttfts": [r["ttft_s"] for r in results],
+    }
+
+
 def main() -> None:
     platform, backend_diag = acquire_backend()
 
@@ -271,13 +310,14 @@ def main() -> None:
         # a typo'd layout must not silently bench slot while REPORTING the typo
         raise SystemExit(f"GOFR_BENCH_KV={kv_layout!r}: use 'slot' or 'paged'")
 
-    # dispatch pipelining (engine default 2): chunk t+1 is dispatched before
-    # chunk t is read back, hiding the per-step readback RTT. 1 = synchronous.
-    # Validate here: the engine clamps silently, and the report must never
-    # state a depth that was not actually benched (same rule as GOFR_BENCH_KV).
+    # unified device pipeline (engine default 2): call t+1 — decode chunk OR
+    # prefill — is dispatched before call t is read back, hiding the per-step
+    # readback RTT. 1 = synchronous. Validate here: the engine clamps
+    # silently, and the report must never state a depth that was not actually
+    # benched (same rule as GOFR_BENCH_KV).
     pipeline_env = os.environ.get("GOFR_BENCH_PIPELINE", "2")
-    if pipeline_env not in ("1", "2"):
-        raise SystemExit(f"GOFR_BENCH_PIPELINE={pipeline_env!r}: use 1 (sync) or 2 (pipelined)")
+    if pipeline_env not in ("1", "2", "3", "4"):
+        raise SystemExit(f"GOFR_BENCH_PIPELINE={pipeline_env!r}: use 1 (sync) .. 4")
     pipeline = int(pipeline_env)
 
     kv_quantize = os.environ.get("GOFR_BENCH_KV_QUANTIZE", "")
@@ -483,6 +523,67 @@ def main() -> None:
         pref_ab["hit_tokens"] = int(
             _counter_total(container, "app_tpu_prefix_hit_tokens") - hits0)
         extra["prefix_ab"] = pref_ab
+
+    # NB: on the CPU fallback the "device" compute runs on the same host
+    # cores as the packing/readback, so overlap has nothing to hide behind
+    # and "off" can win; the A/B is meaningful on a real accelerator link
+    # (the round-3 tunnel measured ~100ms RTT per sync — the thing depth>=2
+    # removes from the critical path).
+    # mixed-arrival overlap A/B: paced arrivals of short prompts plus
+    # chunked-long prompts (every 4th is ~2x the bucket, taking the chunked
+    # prefill path) against active decode slots. "on" = the unified async
+    # pipeline (depth >= 2: prefill futures ride the in-flight queue and
+    # read back overlapped with decode dispatch); "off" = depth 1 (every
+    # dispatch drains synchronously — the pre-unification stall-per-arrival
+    # behavior). Decode throughput collapse under prefill traffic is what
+    # this measures; TTFT is recorded so the overlap win is shown not to
+    # come at first-token latency's expense.
+    if os.environ.get("GOFR_BENCH_OVERLAP_AB") == "1":
+        n_mix = max(8, n_requests // 4)
+        # long prompts must clear the bucket ladder but leave decode+chunk
+        # headroom inside cfg.max_seq_len (tiny CPU configs are tight); if
+        # the config can't fit any, the A/B degenerates to all-short — run
+        # it anyway but REPORT the degeneration instead of implying the
+        # chunked path was exercised
+        long_len = min(2 * prompt_len, cfg.max_seq_len - max_new - 4 * best[1] - 8)
+        use_long = long_len > prompt_len
+        mix = []
+        n_long = 0
+        for i in range(n_mix):
+            if i % 4 == 3 and use_long:
+                size = long_len
+                n_long += 1
+            else:
+                size = prompt_len
+            mix.append(rng.randint(1, cfg.vocab_size, size=size).tolist())
+        arrival_env = os.environ.get("GOFR_BENCH_ARRIVAL_MS")
+        arrival_s = (float(arrival_env) / 1000.0 if arrival_env
+                     else max(0.001, elapsed / n_requests / 2))
+        overlap_ab: dict = {}
+        for mode, depth_ab in (("on", max(2, pipeline)), ("off", 1)):
+            okw = dict(engine_kw(*best))
+            okw.update(decode_pipeline=depth_ab,
+                       max_len=max(long_len, prompt_len) + max_new + 8,
+                       prefill_buckets=[prompt_len])
+            try:
+                mm = _run_mixed(okw, cfg, params, container, llama, mix,
+                                max_new, timeout, arrival_s)
+                overlap_ab[mode] = {
+                    "req_per_s": round(len(mix) / mm["elapsed"], 3),
+                    "decode_tokens_per_s": round(mm["new_tokens"] / mm["elapsed"], 1),
+                    "ttft_p50_s": round(_percentile(mm["ttfts"], 50), 4),
+                    "ttft_p99_s": round(_percentile(mm["ttfts"], 99), 4),
+                }
+            except Exception as e:  # noqa: BLE001
+                overlap_ab[mode] = f"error: {e}"[:160]
+        overlap_ab["arrival_ms"] = round(arrival_s * 1000, 2)
+        overlap_ab["long_prompts"] = n_long
+        overlap_ab["long_prompt_len"] = int(long_len) if use_long else None
+        if (isinstance(overlap_ab.get("on"), dict)
+                and isinstance(overlap_ab.get("off"), dict)):
+            overlap_ab["speedup"] = round(
+                overlap_ab["on"]["req_per_s"] / max(overlap_ab["off"]["req_per_s"], 1e-9), 3)
+        extra["overlap_ab"] = overlap_ab
 
     # kernel A/B on the chip: engine throughput with the Pallas kernels
     # forced on vs off (fresh engines retrace under the env toggle)

@@ -36,7 +36,11 @@ class FlightRecorder:
             self._requests.append(entry)
 
     def record_step(self, kind: str, seconds: float, occupancy: float,
-                    signature: Any, backlog: int = 0) -> None:
+                    signature: Any, backlog: int = 0, inflight: int = 0) -> None:
+        # With the unified async pipeline, steps are recorded at COMPLETION
+        # (dequeue) time; `seconds` spans dispatch→fold and `inflight` is
+        # the in-flight queue depth left after this entry was dequeued —
+        # 0 on every step means the pipeline is running synchronously.
         with self._lock:
             self._steps.append({
                 "at": time.time(),
@@ -45,6 +49,7 @@ class FlightRecorder:
                 "occupancy": round(float(occupancy), 4),
                 "signature": str(signature),
                 "backlog": int(backlog),
+                "inflight": int(inflight),
             })
 
     # -- inspection (debug endpoints / tests) ----------------------------------

@@ -207,7 +207,10 @@ class AdmissionController:
     def observe_step(self, seconds: float) -> None:
         """EWMA of device-step wall time, fed by ``_record_step`` on every
         bound engine (one estimator app-wide: steps across engines in one
-        process contend for the same host/device anyway)."""
+        process contend for the same host/device anyway). Under the unified
+        async pipeline steps are observed at COMPLETION (dequeue) time, so
+        a sample spans dispatch→fold — slightly pessimistic while calls
+        overlap, which is the right bias for shedding hopeless work."""
         with self._lock:
             self._ewma_step = (seconds if self._ewma_step == 0.0
                                else 0.2 * seconds + 0.8 * self._ewma_step)

@@ -118,3 +118,19 @@ def check_mesh_serving(config: dict[str, str], *, n_requests: int = 6,
             )
     finally:
         eng.stop()
+
+
+def assert_lane_sets_consistent(engine) -> None:
+    """The incrementally-maintained lane sets (engine._free_lanes /
+    _prefill_lanes / _decode_lanes) must always agree with a fresh rescan
+    of ``engine.slots`` — they replace the per-iteration O(num_slots)
+    sweeps, so drift would silently corrupt admission/decode masking."""
+    with engine._state_lock:
+        free = {i for i, s in enumerate(engine.slots) if s is None}
+        prefill = {i for i, s in enumerate(engine.slots)
+                   if s is not None and s.last_token is None}
+        decode = {i for i, s in enumerate(engine.slots)
+                  if s is not None and s.last_token is not None}
+        assert engine._free_lanes == free, (engine._free_lanes, free)
+        assert engine._prefill_lanes == prefill, (engine._prefill_lanes, prefill)
+        assert engine._decode_lanes == decode, (engine._decode_lanes, decode)

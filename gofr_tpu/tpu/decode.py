@@ -1,20 +1,24 @@
-"""Decode dispatch paths for ``GenerateEngine``.
+"""Decode dispatch + unified pipeline processing for ``GenerateEngine``.
 
 Split out of tpu/engine.py (the engine's device thread calls these once
 per loop iteration). The interface to the engine is its documented state:
 slot table + page bookkeeping under ``eng._state_lock``, the compiled
-program handles from tpu/programs.py, the pipelined-dispatch queue
+program handles from tpu/programs.py, the UNIFIED in-flight device queue
 ``eng._dq`` with the device-resident carries (``eng._prev_last`` for
 plain decode, ``eng._spec_carry`` for speculative rounds), and the
 emit/finish callbacks.
 
-Plain decode AND slot-layout speculative rounds are PIPELINED: dispatch
-chunk t, then block on chunk t-1 so readback + host bookkeeping overlap
-chunk t's compute. Spec rounds can pipeline because the data-dependent
-state (token, hlen, token history) is device-resident — the host never
-needs chunk t-1's acceptance counts to assemble chunk t. Paged-layout
-spec is synchronous: page allocation depends on data-dependent position
-advance the host only learns at readback.
+Every asynchronous device call rides ``eng._dq``: plain decode chunks and
+slot-layout speculative rounds (dispatched here), plus batched and
+chunked prefills (dispatched by ``engine._admit``/``_advance_chunked``).
+``process_decode`` dequeues the OLDEST entry, blocks on its readback —
+overlapping every younger dispatch's compute — and folds the result into
+slot state. Decode can pipeline because the data-dependent state (token,
+hlen, token history) is device-resident — the host never needs chunk
+t-1's output to assemble chunk t; prefill can because the prompt is
+host-known. Paged-layout spec is the one synchronous discipline left:
+page allocation depends on data-dependent position advance the host only
+learns at readback.
 """
 
 from __future__ import annotations
@@ -103,7 +107,7 @@ def spec_round(eng) -> bool:
             return True  # preemption work happened
         W = eng.pages_per_slot
         H = W * eng.page_size
-        packed = np.zeros((4 + W + H, n), np.int32)
+        packed = eng._staging("spec_round", (4 + W + H, n))
         packed[1, :] = H + 1  # inactive lanes: every write lands OOB
         temps = np.zeros((n,), np.float32)
         packed[4:4 + W] = eng._masked_table({i for i, _ in lanes}).T
@@ -163,7 +167,7 @@ def dispatch_spec(eng) -> bool:
             lanes.append((i, s))
         if not lanes:
             return False
-        packed = np.zeros((5, n), np.int32)
+        packed = eng._staging("spec", (5, n))
         packed[1, :] = eng._cache_len + 1  # inactive: every write lands OOB
         packed[2, :] = 1                   # inactive lanes are host-arbitrated
         temps = np.zeros((n,), np.float32)
@@ -191,7 +195,7 @@ def dispatch_spec(eng) -> bool:
     toks_dev, accs_dev, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
         eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), carry)
     eng._dq.append(("spec", (toks_dev, accs_dev), [(i, s) for i, s in lanes],
-                    t0, occupancy, (n, k)))
+                    t0, occupancy, ("decode_spec", n, k, eng.spec_tokens)))
     return True
 
 
@@ -235,7 +239,7 @@ def dispatch_decode(eng) -> bool:
         # slots' tables carry the same slack via pages_per_slot). All host
         # inputs ride ONE packed array (layout at the jit definitions).
         wt = eng.pages_per_slot if eng.kv_layout == "paged" else 0
-        packed = np.zeros((5 + wt, n), np.int32)
+        packed = eng._staging("decode", (5 + wt, n))
         temps = np.zeros((n,), np.float32)
         if eng.kv_layout != "paged":
             # non-decoding rows (empty, chunk-prefilling, or dead-lane-
@@ -273,29 +277,36 @@ def dispatch_decode(eng) -> bool:
     )
     eng._prev_last = last_dev
     eng._dq.append(("plain", chunk_dev, [(i, s) for i, s, _ in lanes],
-                    t0, occupancy, (n, k)))
+                    t0, occupancy, ("decode", n, k)))
     return True
 
 
 def process_decode(eng) -> bool:
-    """Block on the OLDEST dispatched chunk's tokens (overlapping any
-    younger chunk's compute) and fold them into slot state. Lanes whose
+    """Block on the OLDEST dispatched entry's readback (overlapping any
+    younger dispatch's compute) and fold it into slot state. Lanes whose
     slot object changed since dispatch (freed, preempted, reassigned)
     have their results discarded — the identity check is what makes
-    speculative dispatch safe. Handles both plain and spec entries on
-    ``eng._dq``."""
+    dispatch-time claiming safe. Handles every entry kind on ``eng._dq``:
+    plain decode, spec rounds, batched prefill, and prefill chunks."""
     if not eng._dq:
         return False
-    kind, dev, meta, t0, occupancy, (n, k) = eng._dq.popleft()
+    kind, dev, meta, t0, occupancy, sig = eng._dq.popleft()
     if kind == "spec":
         toks = np.asarray(dev[0])  # [k, n, g+1] int32 — tokens, never logits
         accs = np.asarray(dev[1])  # [k, n]
     else:
-        chunk = np.asarray(dev)  # [slots, k] int32 — tokens, never logits
+        chunk = np.asarray(dev)  # int32 tokens, never logits
     if eng._poisoned:
         # stop() declared this thread wedged and already failed/cleared
         # everything; the slot/page state now belongs to the caller.
         return False
+    if kind == "prefill":
+        eng._fold_prefill(chunk, meta, t0, occupancy, sig)
+        return True
+    if kind == "chunk":
+        eng._fold_chunk(chunk, meta, t0, occupancy, sig)
+        return True
+    n, k = sig[1], sig[2]
     with eng._state_lock:
         if kind == "spec":
             eng._record_step("decode_spec", time.monotonic() - t0, occupancy,

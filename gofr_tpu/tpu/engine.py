@@ -21,11 +21,22 @@ Shape discipline: every compiled signature is (batch_bucket, len_bucket)
 with power-of-two buckets, so the compile-cache population is tiny and
 steady-state serving is 100% cache hits (tracked in app_tpu_* metrics).
 
+Dispatch discipline (round-6 unification): every asynchronous device
+call — batched prefill, chunked prefill, decode chunk, slot-layout spec
+round — goes through ONE bounded in-flight queue (``_dq``, depth
+``pipeline_depth``). Dispatch claims slot/page state and enqueues the
+device futures; readback + slot bookkeeping happen at dequeue,
+overlapped with younger dispatches, so arriving prompts no longer stall
+decoding slots for a prefill round trip (the mixed-arrival device-idle
+bubble). Results are folded only if the lane's slot object is unchanged
+since dispatch — preemption, cancel, stop(), and crash recovery all ride
+that identity check.
+
 Module layout (round-5 split): tpu/programs.py builds the jitted packed
 programs and documents every packed layout; tpu/decode.py holds the
-decode dispatch paths (plain + speculative, pipelined + synchronous);
-this file keeps engine state, admission/prefill, streaming, supervision,
-and the build_engine factory.
+decode dispatch paths and the unified queue processing; this file keeps
+engine state, admission/prefill, streaming, supervision, and the
+build_engine factory.
 """
 
 from __future__ import annotations
@@ -443,10 +454,14 @@ class _EngineBase:
                 "app_tpu_ttft_seconds", ft - req.enqueued_at)
 
     def _record_step(self, kind: str, seconds: float, occupancy: float, signature: tuple) -> None:
+        # called at COMPLETION (dequeue) time under the unified pipeline:
+        # `seconds` spans dispatch→fold, so it includes the overlapped
+        # in-flight wait, not just device compute
         self.metrics.record_histogram("app_tpu_step_seconds", seconds, kind=kind)
         self.metrics.record_histogram("app_tpu_batch_occupancy", occupancy, kind=kind)
         if self.flight is not None:
-            self.flight.record_step(kind, seconds, occupancy, signature, self._backlog())
+            self.flight.record_step(kind, seconds, occupancy, signature,
+                                    self._backlog(), len(getattr(self, "_dq", ())))
         if self.qos is not None:
             self.qos.observe_step(seconds)  # feeds the queue-wait estimator
         if signature in self._compiled:
@@ -628,16 +643,20 @@ class _Slot:
     position the last token will be written to on the next decode step,
     i.e. ``prompt_len + len(generated) - 1``.
 
-    A slot admitted with ``first_token=None`` is in the *chunked-prefill*
-    stage: ``written`` counts prompt tokens already in the cache; the slot
-    joins decode only once the final chunk samples its first token
-    (SURVEY §7 hard parts (a)/(b): long prompts stream into the cache in
-    bucket-sized chunks between decode steps instead of inflating one
-    batch's padding or being rejected)."""
+    A slot admitted with ``first_token=None`` is in the *prefill* stage —
+    its lane is claimed (reserved against decode, admission, and page
+    reuse) while the prefill device work is in flight. Batched prefills
+    dispatch the whole prompt at once (``dispatched == prompt_len``) and
+    activate at dequeue; chunked prefills stream the prompt in
+    bucket-sized chunks (``written`` counts tokens whose write was read
+    back), joining decode once the final chunk's dequeue samples the
+    first token (SURVEY §7 hard parts (a)/(b): long prompts stream into
+    the cache between decode steps instead of inflating one batch's
+    padding or being rejected)."""
 
     __slots__ = ("request", "prompt_len", "pos", "generated", "max_total", "eos",
                  "last_token", "first_token_at", "admit_seq", "prompt_tokens",
-                 "written", "inflight")
+                 "written", "dispatched", "inflight")
 
     def __init__(self, request: Request, prompt_len: int, max_total: int, eos: int | None,
                  first_token: int | None, admit_seq: int = 0, prompt_tokens: Any = None):
@@ -652,11 +671,20 @@ class _Slot:
         self.admit_seq = admit_seq       # preemption order (paged layout)
         self.prompt_tokens = prompt_tokens  # kept for preemption re-prefill
         self.written = prompt_len if first_token is not None else 0
+        # prompt tokens whose device write is DISPATCHED (>= written, which
+        # counts tokens whose write was read back): the chunked path advances
+        # `dispatched` at dispatch and `written` at dequeue, so several
+        # chunks of one prompt can ride the in-flight queue at once
+        self.dispatched = self.written
         self.inflight = 0  # decode chunks dispatched but not yet processed
 
     @property
     def prefilling(self) -> bool:
-        return self.written < self.prompt_len
+        # the lane-set stage predicate (engine._claim_slot / testutil.
+        # assert_lane_sets_consistent): a batched-prefill slot has
+        # written == 0 but leaves the prefill stage only when its fold
+        # delivers the first token
+        return self.last_token is None
 
 
 class _StreamIterator:
@@ -713,6 +741,7 @@ class GenerateEngine(_EngineBase):
         prefill_attn_divisor: int = 1,
         lockstep_role: str | None = None,
         spec_draft: tuple | None = None,
+        pipeline_depth: int | None = None,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -798,16 +827,24 @@ class GenerateEngine(_EngineBase):
                     "family with SLOT_CHUNKED_PREFILL"
                 )
         self._draft = None  # (family, cfg) once validated (slot branch below)
-        # Pipelined decode (depth 2 = one chunk in flight): chunk t+1 is
-        # dispatched BEFORE chunk t's tokens are read back, so the ~RTT of
-        # device→host readback + host bookkeeping overlaps the next chunk's
-        # compute. The data dependency (t+1's input token = t's last output)
-        # stays ON DEVICE via the `prev_last` carry — or, for speculative
-        # rounds on the slot layout, the (token, hlen) spec carry plus the
-        # device-resident history (tpu/programs.py). Depth 1 is the fully
-        # synchronous path. Over the round-3 tunnel (~100ms/sync) this is
-        # the difference between RTT-bound and compute-bound decode.
-        self.decode_pipeline = max(1, min(2, int(decode_pipeline)))
+        # Unified device pipeline (depth 2 = one call in flight): EVERY
+        # device call — batched prefill, chunked prefill, decode chunk,
+        # slot-layout spec round — is dispatched onto one bounded in-flight
+        # queue (self._dq) and its readback + host bookkeeping happen at
+        # DEQUEUE, overlapped with the next dispatch. The decode data
+        # dependency (t+1's input token = t's last output) stays ON DEVICE
+        # via the `prev_last` carry — or, for speculative rounds on the
+        # slot layout, the (token, hlen) spec carry plus the device-resident
+        # history (tpu/programs.py); prefill has no such dependency (the
+        # prompt is host-known), so its futures simply ride the queue.
+        # Depth 1 drains the queue every iteration (the synchronous path,
+        # token-identical). Over the round-3 tunnel (~100ms/sync) this is
+        # the difference between RTT-bound and compute-bound serving.
+        # `pipeline_depth` is the canonical knob (ENGINE_PIPELINE);
+        # `decode_pipeline` (ENGINE_DECODE_PIPELINE) is the legacy alias.
+        depth = pipeline_depth if pipeline_depth is not None else decode_pipeline
+        self.pipeline_depth = max(1, min(4, int(depth)))
+        self.decode_pipeline = self.pipeline_depth  # legacy alias (bench/tests)
         # cache slack one chunk can write past max_len: each spec round
         # writes up to spec_tokens+1 positions plus spec_tokens draft slots.
         chunk_span = (self.decode_chunk * (self.spec_tokens + 1) + self.spec_tokens
@@ -952,6 +989,22 @@ class GenerateEngine(_EngineBase):
             self.cache = jax.device_put(
                 self.cache, NamedSharding(self.tpu.mesh, _P()))
         self.slots: list[_Slot | None] = [None] * slots
+        # Lane sets, maintained INCREMENTALLY at claim/free/stage-transition
+        # time: the device loop consults free/decoding/prefilling lanes
+        # several times per iteration, and rescanning self.slots was three
+        # O(num_slots) attribute-chasing sweeps per step (hot at slots≥128).
+        # Invariant: the three sets partition range(num_slots); a lane is in
+        # _prefill_lanes iff its slot exists and has no first token yet.
+        self._free_lanes: set[int] = set(range(slots))
+        self._decode_lanes: set[int] = set()
+        self._prefill_lanes: set[int] = set()
+        # Reusable packed staging buffers keyed by (kind, shape): a steady-
+        # state step re-zeroes one preallocated int32 buffer per signature
+        # instead of paying an np.zeros allocation per device call. Safe to
+        # reuse because jnp.asarray/broadcast copy the host buffer before
+        # the dispatching call returns, and all packing runs on the device
+        # thread. The population is bounded like _compiled (bucket ladder).
+        self._staging_bufs: dict[tuple, np.ndarray] = {}
         self._pending: list[tuple[Request, np.ndarray]] = []
         # prompts longer than the largest prefill bucket: admitted one at a
         # time and streamed into the cache chunk-by-chunk. Paged always
@@ -1259,11 +1312,43 @@ class GenerateEngine(_EngineBase):
         if self._page_refs[p] == 0:
             self._free_pages.append(p)
 
+    def _staging(self, kind: str, shape: tuple[int, ...]) -> np.ndarray:
+        """A zeroed int32 staging buffer for one packed dispatch, reused
+        across steps per (kind, shape) signature. Device-thread only."""
+        key = (kind, shape)
+        buf = self._staging_bufs.get(key)
+        if buf is None:
+            buf = np.zeros(shape, np.int32)
+            self._staging_bufs[key] = buf
+        else:
+            buf.fill(0)
+        return buf
+
+    def _claim_slot(self, idx: int, slot: _Slot) -> None:
+        """Occupy lane ``idx`` (caller holds the state lock). The lane is
+        reserved from this moment — admission skips it, decode masks it,
+        and its pages stay held — until _free_slot or the prefill fold
+        moves it to the decode stage."""
+        self.slots[idx] = slot
+        self._free_lanes.discard(idx)
+        if slot.last_token is None:
+            self._prefill_lanes.add(idx)
+        else:
+            self._decode_lanes.add(idx)
+
+    def _lane_to_decode(self, idx: int) -> None:
+        """Prefill fold completed: the lane starts decoding next dispatch."""
+        self._prefill_lanes.discard(idx)
+        self._decode_lanes.add(idx)
+
     def _free_slot(self, idx: int) -> None:
         """Vacate a slot; in the paged layout its share of each page is
         released (pages also held by the prefix cache or other slots stay
         allocated — refcount zero is what returns a page to the pool)."""
         self.slots[idx] = None
+        self._decode_lanes.discard(idx)
+        self._prefill_lanes.discard(idx)
+        self._free_lanes.add(idx)
         if self.kv_layout == "paged":
             pages = self._slot_pages[idx]
             if pages:
@@ -1338,6 +1423,7 @@ class GenerateEngine(_EngineBase):
         self._slot_pages[idx] = list(pages)
         self._table[idx, :len(pages)] = pages
         slot.written = len(pages) * self.page_size
+        slot.dispatched = slot.written  # cached tokens need no device write
         self.metrics.increment_counter("app_tpu_prefix_hit_tokens", slot.written)
 
     def _prefix_insert(self, idx: int) -> None:
@@ -1395,8 +1481,9 @@ class GenerateEngine(_EngineBase):
         recompute. Greedy decode continues bit-identically; sampled decode
         resumes from a fresh RNG fold (documented engine semantics)."""
         candidates = [
-            (s.admit_seq, i) for i, s in enumerate(self.slots)
-            if s is not None and i != except_slot
+            (self.slots[i].admit_seq, i)
+            for i in self._decode_lanes | self._prefill_lanes
+            if i != except_slot
         ]
         if not candidates:
             return False
@@ -1430,41 +1517,76 @@ class GenerateEngine(_EngineBase):
         self.metrics.increment_counter("app_tpu_preemptions", 1)
         return True
 
+    # The accessors sort for determinism (lowest-lane-first claiming, and
+    # lockstep leaders must pack lanes identically run-to-run); membership
+    # itself is maintained incrementally, never by rescanning self.slots.
+
     def _free_slots(self) -> list[int]:
-        return [i for i, s in enumerate(self.slots) if s is None]
+        return sorted(self._free_lanes)
 
     def _active(self) -> list[int]:
-        """Slots in the decode stage (chunk-prefilling slots excluded)."""
-        return [i for i, s in enumerate(self.slots) if s is not None and not s.prefilling]
+        """Slots in the decode stage (prefill-stage slots excluded)."""
+        return sorted(self._decode_lanes)
 
-    def _prefilling(self) -> list[int]:
-        return [i for i, s in enumerate(self.slots) if s is not None and s.prefilling]
+    def _activate_lane(self, idx: int, s: _Slot, tok: int, now: float) -> None:
+        """Shared tail of both prefill folds: give the slot its sampled
+        first token and move it into the decode stage (caller holds the
+        state lock and has already verified slot identity/liveness)."""
+        self._mark_first_token(s.request)
+        s.written = s.prompt_len
+        s.generated = [tok]
+        s.last_token = tok
+        s.pos = s.prompt_len
+        s.first_token_at = now
+        self._lane_to_decode(idx)
+        self._prefix_insert(idx)
+        self._emit(s, tok)
+        self._maybe_finish(idx)
 
     def _loop(self) -> None:
         self._dq.clear()  # a restarted loop must not read a dead life's futures
         self._prev_last = None
         self._spec_carry = None
+        depth = self.pipeline_depth
         while not self._stop.is_set() and not self._poisoned:
+            # One bounded in-flight device queue (self._dq): batched
+            # prefill, chunked prefill, and decode/spec chunks all DISPATCH
+            # here (enqueueing their device futures) and are read back +
+            # folded into slot state at DEQUEUE below — so every readback's
+            # device→host round trip and host bookkeeping overlap the
+            # compute of whatever was dispatched after it. Paged-layout
+            # spec is the one synchronous discipline left: its next round's
+            # page allocation depends on data the host only learns at
+            # readback (decode.spec_round).
+            processed = False
             admitted = self._admit()
+            if depth == 1:
+                # TRULY synchronous at depth 1: each dispatch is read back
+                # before the next phase dispatches (the pre-unification
+                # behavior, and what "fully synchronous" promises operators
+                # debugging with ENGINE_PIPELINE=1 — also the honest "off"
+                # arm of the bench's overlap A/B)
+                while self._dq:
+                    processed = process_decode(self) or processed
             # one chunk of ONE long prompt per iteration, so decode of the
             # other slots keeps stepping between chunks (TTFT fairness)
             chunked = self._advance_chunked()
-            # pipelined decode: dispatch chunk t, then block on chunk t-1 —
-            # its readback + host bookkeeping overlap chunk t's compute.
-            # Slot-layout spec rounds pipeline the same way (the data-
-            # dependent positions live in the device-resident spec carry);
-            # paged spec is synchronous — no chunk can be dispatched before
-            # the previous one is read back.
+            if depth == 1:
+                while self._dq:
+                    processed = process_decode(self) or processed
             if not self.spec_tokens:
                 dispatched = dispatch_decode(self)
             elif self.kv_layout == "slot":
                 dispatched = dispatch_spec(self)
             else:
                 dispatched = spec_round(self)
-            processed = False
-            while len(self._dq) > (self.decode_pipeline - 1 if dispatched else 0):
+            busy = admitted or chunked or dispatched
+            # drain to depth-1 in-flight entries while work keeps arriving
+            # (each blocking readback overlaps every younger dispatch);
+            # drain fully when the engine goes quiet so no future lingers
+            while len(self._dq) > (depth - 1 if busy else 0):
                 processed = process_decode(self) or processed
-            if not admitted and not chunked and not dispatched and not processed:
+            if not busy and not processed:
                 if self._ls is not None and self._hb_interval:
                     # idle leader: heartbeat so follower watchdogs see
                     # liveness between announcements (LOCKSTEP_DEADLINE_S)
@@ -1532,7 +1654,7 @@ class GenerateEngine(_EngineBase):
                 prompt_tokens=toks,
             )
             self._admit_seq += 1
-            self.slots[idx] = slot
+            self._claim_slot(idx, slot)
             self._mark_admitted(req, time.monotonic())
             req.kw["_slot"] = idx
             req.kw["_prompt_len"] = slot.prompt_len
@@ -1544,14 +1666,19 @@ class GenerateEngine(_EngineBase):
             self._prefix_hit(idx, slot, toks)
 
     def _advance_chunked(self) -> bool:
-        """Write the next chunk of the OLDEST-admitted prefilling slot; the
-        final chunk samples the request's first token and flips the slot to
-        the decode stage. One chunk per loop iteration keeps decode stepping
-        between chunks. Returns True when device work happened."""
+        """DISPATCH the next chunk of the OLDEST-admitted prefilling slot
+        onto the in-flight queue; readback + slot bookkeeping happen at
+        dequeue (_fold_chunk), overlapped with later dispatches — the final
+        chunk's dequeue samples the request's first token and flips the
+        slot to the decode stage. One chunk dispatched per loop iteration
+        keeps decode stepping between chunks; successive iterations can
+        keep several chunks of one prompt in flight (``dispatched`` tracks
+        the frontier). Returns True when device work was dispatched."""
         if not self._chunked_ok:
             return False
         with self._state_lock:
-            pre = self._prefilling()
+            pre = [i for i in self._prefill_lanes
+                   if self.slots[i].dispatched < self.slots[i].prompt_len]
             if not pre:
                 return False
             idx = min(pre, key=lambda i: self.slots[i].admit_seq)
@@ -1560,11 +1687,14 @@ class GenerateEngine(_EngineBase):
                 self._free_slot(idx)
                 s.request.complete(error=RequestTimeout())
                 return True  # state changed; re-loop without idling
-            chunk = min(s.prompt_len - s.written, self.prefill_buckets[-1])
+            offset = s.dispatched
+            chunk = min(s.prompt_len - offset, self.prefill_buckets[-1])
             lb = next_bucket(chunk, self.prefill_buckets)
+            table_row = None
             if self.kv_layout == "paged":
-                # pages must cover this chunk's writes before the table snapshot
-                while not self._ensure_pages(idx, s.written + chunk - 1):
+                # pages must cover this chunk's writes before the table
+                # snapshot; they stay reserved until the fold (or _free_slot)
+                while not self._ensure_pages(idx, offset + chunk - 1):
                     if not self._preempt_newest(except_slot=idx):
                         self._free_slot(idx)
                         s.request.complete(error=RuntimeError(
@@ -1572,60 +1702,75 @@ class GenerateEngine(_EngineBase):
                         return True  # state changed; re-loop without idling
                 if self.slots[idx] is None:  # preemption pressure evicted US
                     return True
-            last = s.written + chunk == s.prompt_len
-            w = self.pages_per_slot if self.kv_layout == "paged" else 1
-            packed = np.zeros((1, lb + w + 4), np.int32)
-            packed[0, :chunk] = s.prompt_tokens[s.written:s.written + chunk]
-            packed[0, lb] = chunk
-            if self.kv_layout == "paged":
-                packed[0, lb + 1:lb + 1 + w] = self._table[idx]
-            else:
-                packed[0, lb + 1] = idx
-            packed[0, lb + 1 + w] = s.written  # chunk offset
-            packed[0, lb + 2 + w] = np.float32(
-                s.request.kw.get("temperature", 0.0)).view(np.int32)
+                table_row = self._table[idx].copy()
+            last = offset + chunk == s.prompt_len
+            s.dispatched = offset + chunk
             self._step_count += 1
-            packed[0, lb + 3 + w] = self._step_count
-            self._inflight = [s.request]
+            step = self._step_count
+            temp = float(s.request.kw.get("temperature", 0.0))
             t0 = time.monotonic()
+
+        # pure-numpy packing OUTSIDE the state lock: everything below is
+        # immutable (prompt_tokens) or snapshotted above (table row, step)
+        w = self.pages_per_slot if self.kv_layout == "paged" else 1
+        packed = self._staging("chunk", (1, lb + w + 4))
+        packed[0, :chunk] = s.prompt_tokens[offset:offset + chunk]
+        packed[0, lb] = chunk
+        if self.kv_layout == "paged":
+            packed[0, lb + 1:lb + 1 + w] = table_row
+        else:
+            packed[0, lb + 1] = idx
+        packed[0, lb + 1 + w] = offset  # chunk offset
+        packed[0, lb + 2 + w] = np.float32(temp).view(np.int32)
+        packed[0, lb + 3 + w] = step
 
         self._announce(TAG_CHUNK, lb, 1, packed)
         first_dev, self.cache = self._chunk_prefill(
             self.params, self._base_key, self.cache, jnp.asarray(packed)
         )
-        first = np.asarray(first_dev)
+        self._dq.append(("chunk", first_dev, (idx, s, chunk, offset, last),
+                         t0, chunk / lb, ("prefill_chunk", lb, 1)))
+        return True
 
+    def _fold_chunk(self, first: np.ndarray, meta, t0: float,
+                    occupancy: float, sig: tuple) -> None:
+        """Dequeue side of one prefill chunk (called by process_decode with
+        the tokens already read back). Lanes freed/preempted since dispatch
+        are discarded by identity — the same discipline decode uses."""
+        idx, s, chunk, offset, last = meta
+        lb = sig[1]
         with self._state_lock:
-            self._inflight = []
-            if self._poisoned or self._stop.is_set() or self.slots[idx] is not s:
-                return True  # stop()/crash/preemption took over while in flight
             self._record_step("prefill_chunk", time.monotonic() - t0,
-                              chunk / lb, ("prefill_chunk", lb, 1))
+                              occupancy, sig)
+            if self.slots[idx] is not s:
+                return  # stop()/preemption/cancel took over while in flight
+            if s.request.cancelled or s.request.expired(time.monotonic()):
+                self._free_slot(idx)
+                s.request.complete(error=RequestTimeout())
+                return
             self.metrics.increment_counter("app_tpu_tokens_total", chunk)
             s.written += chunk
             rt = s.request.kw.get("_rt")
             if rt is not None:
                 rt.event("engine.prefill", "chunk",
-                         offset=s.written - chunk, tokens=chunk, bucket=lb)
+                         offset=offset, tokens=chunk, bucket=lb)
             if last:
-                self._prefix_insert(idx)
-                tok = int(first[0])
-                self._mark_first_token(s.request)
                 if rt is not None:
                     rt.end("engine.prefill")
                     rt.begin("engine.decode", **{"slot": idx})
-                s.generated = [tok]
-                s.last_token = tok
-                s.pos = s.prompt_len
-                s.first_token_at = time.monotonic()
-                self._emit(s, tok)
-                self._maybe_finish(idx)
-            return True
+                self._activate_lane(idx, s, int(first[0]), time.monotonic())
 
     def _admit(self) -> bool:
-        # Planning/bookkeeping under the state lock; the device call outside
-        # it (a wedged device call must never hold the lock, or stop()'s
-        # _fail_all would deadlock behind it).
+        # Plan + claim under the state lock; token packing and the device
+        # call OUTSIDE it (a wedged device call must never hold the lock,
+        # or stop()'s _fail_all would deadlock behind it — and the pure-
+        # numpy packing doesn't need it either). The dispatched prefill's
+        # future rides the in-flight queue; readback + slot activation
+        # happen at dequeue (_fold_prefill), overlapped with later
+        # dispatches. Slots (and their pages) are CLAIMED here at dispatch
+        # so the lane stays reserved until the matching dequeue — visible
+        # to preemption, _fail_all, and crash recovery like any other
+        # occupied lane.
         with self._state_lock:
             self._drain_pending()
             self.metrics.set_gauge("app_tpu_queue_depth", self._backlog())
@@ -1686,7 +1831,7 @@ class GenerateEngine(_EngineBase):
                             prompt_tokens=toks,
                         )
                         self._admit_seq += 1
-                        self.slots[idx] = slot
+                        self._claim_slot(idx, slot)
                         self._mark_admitted(req, time.monotonic())
                         req.kw["_slot"] = idx
                         req.kw["_prompt_len"] = slot.prompt_len
@@ -1730,82 +1875,94 @@ class GenerateEngine(_EngineBase):
             nb = plan.batch_bucket
             lb = plan.len_bucket
             w = self.pages_per_slot if self.kv_layout == "paged" else 1
-            packed = np.zeros((nb, lb + w + 3), np.int32)
-            packed[:, lb] = 1  # padding rows: length 1
-            temps = np.zeros((nb,), np.float32)
-            if self.kv_layout == "paged":
-                packed[:, lb + 1:lb + 1 + w] = self.total_pages
-            else:
-                packed[:, lb + 1] = self.num_slots
-            for i, (req, toks) in enumerate(ready):
-                packed[i, : toks.shape[0]] = toks
-                packed[i, lb] = toks.shape[0]
-                if self.kv_layout == "paged":
-                    packed[i, lb + 1:lb + 1 + w] = self._table[free[i]]
-                else:
-                    packed[i, lb + 1] = free[i]
-                temps[i] = float(req.kw.get("temperature", 0.0))
-            packed[:, lb + 1 + w] = temps.view(np.int32)
-            self._step_count += 1
-            packed[0, lb + 2 + w] = self._step_count
-            lengths = packed[:, lb].copy()
-
+            rows = free[:n]
+            table_rows = (self._table[rows].copy()
+                          if self.kv_layout == "paged" else None)
             t0 = time.monotonic()
-            for req, _ in ready:
+            meta: list[tuple[int, _Slot]] = []
+            for i, (req, toks) in enumerate(ready):
                 self._mark_admitted(req, t0)
+                req.kw["_slot"] = rows[i]
+                req.kw["_prompt_len"] = int(toks.shape[0])
                 rt = req.kw.get("_rt")
                 if rt is not None:
                     rt.begin("engine.prefill",
                              **{"prefill.len_bucket": lb, "prefill.batch": nb})
-            self._inflight = [req for req, _ in ready]
+                slot = _Slot(
+                    req,
+                    prompt_len=int(toks.shape[0]),
+                    max_total=min(int(toks.shape[0]) + int(req.kw.get("max_new_tokens", 64)),
+                                  self.max_len),
+                    eos=req.kw.get("eos_token_id", self.eos_token_id),
+                    first_token=None,
+                    admit_seq=self._admit_seq,
+                    prompt_tokens=toks,
+                )
+                slot.dispatched = slot.prompt_len  # whole prompt in this call
+                self._admit_seq += 1
+                self._claim_slot(rows[i], slot)
+                meta.append((rows[i], slot))
+            self._step_count += 1
+            step = self._step_count
+
+        # pure-numpy packing OUTSIDE the state lock: token/temp data rides
+        # the immutable `ready` list, lanes and table rows were snapshotted
+        # under the lock above
+        packed = self._staging("prefill", (nb, lb + w + 3))
+        packed[:, lb] = 1  # padding rows: length 1
+        temps = np.zeros((nb,), np.float32)
+        if self.kv_layout == "paged":
+            packed[:, lb + 1:lb + 1 + w] = self.total_pages
+        else:
+            packed[:, lb + 1] = self.num_slots
+        for i, (req, toks) in enumerate(ready):
+            packed[i, : toks.shape[0]] = toks
+            packed[i, lb] = toks.shape[0]
+            if self.kv_layout == "paged":
+                packed[i, lb + 1:lb + 1 + w] = table_rows[i]
+            else:
+                packed[i, lb + 1] = rows[i]
+            temps[i] = float(req.kw.get("temperature", 0.0))
+        packed[:, lb + 1 + w] = temps.view(np.int32)
+        packed[0, lb + 2 + w] = step
 
         self._announce(TAG_PREFILL, lb, nb, packed)
         first_dev, self.cache = self._prefill_sample(
             self.params, self._base_key, self.cache, jnp.asarray(packed)
         )
-        first = np.asarray(first_dev)  # [nb] int32 — tokens, never logits
+        # tokens, never logits — and NEVER read back here: the future rides
+        # the in-flight queue; _fold_prefill activates the claimed slots at
+        # dequeue, overlapped with whatever dispatches after this call
+        self._dq.append(("prefill", first_dev, meta, t0, n / nb,
+                         ("prefill", lb, nb)))
+        return True
 
+    def _fold_prefill(self, first: np.ndarray, meta, t0: float,
+                      occupancy: float, sig: tuple) -> None:
+        """Dequeue side of a batched prefill: activate each slot claimed at
+        dispatch with its sampled first token. Lanes whose slot object
+        changed since dispatch (stop()'s _fail_all, preemption, cancel)
+        are discarded by identity — their requests were already completed
+        and their pages returned by _free_slot."""
         with self._state_lock:
-            self._inflight = []
-            if self._stop.is_set():
-                # stop() raced a wedged/slow prefill and already failed this batch
-                # (via _inflight); don't resurrect it into slots — and return the
-                # pages reserved for them at admission, or they'd be stranded on
-                # never-occupied slots (found by the stop-mid-traffic stress test)
-                if self.kv_layout == "paged":
-                    for i in range(len(ready)):
-                        self._free_slot(free[i])
-                for req, _ in ready:
-                    req.complete(error=EngineClosed("engine stopped"))
-                return True
-            self._record_step("prefill", time.monotonic() - t0, n / nb, ("prefill", lb, nb))
-            self.metrics.increment_counter("app_tpu_tokens_total", int(lengths[:n].sum()) + n)
-
-            for i, (req, toks) in enumerate(ready):
-                tok = int(first[i])
-                self._mark_first_token(req)
-                req.kw["_slot"] = free[i]
-                req.kw["_prompt_len"] = int(lengths[i])
-                rt = req.kw.get("_rt")
+            self._record_step("prefill", time.monotonic() - t0, occupancy, sig)
+            now = time.monotonic()
+            tokens = 0
+            for row, (idx, s) in enumerate(meta):
+                if self.slots[idx] is not s:
+                    continue  # freed/preempted/failed while in flight
+                if s.request.cancelled or s.request.expired(now):
+                    self._free_slot(idx)
+                    s.request.complete(error=RequestTimeout())
+                    continue
+                tokens += s.prompt_len + 1
+                rt = s.request.kw.get("_rt")
                 if rt is not None:
                     rt.end("engine.prefill",
-                           **{"slot": free[i], "batch.occupancy": n / nb})
-                    rt.begin("engine.decode", **{"slot": free[i]})
-                slot = _Slot(
-                    req,
-                    prompt_len=int(lengths[i]),
-                    max_total=min(int(lengths[i]) + int(req.kw.get("max_new_tokens", 64)), self.max_len),
-                    eos=req.kw.get("eos_token_id", self.eos_token_id),
-                    first_token=tok,
-                    admit_seq=self._admit_seq,
-                    prompt_tokens=toks,
-                )
-                self._admit_seq += 1
-                self.slots[free[i]] = slot
-                self._prefix_insert(free[i])
-                self._emit(slot, tok)
-                self._maybe_finish(free[i])
-            return True
+                           **{"slot": idx, "batch.occupancy": occupancy})
+                    rt.begin("engine.decode", **{"slot": idx})
+                self._activate_lane(idx, s, int(first[row]), now)
+            self.metrics.increment_counter("app_tpu_tokens_total", tokens)
 
     # -- completion ------------------------------------------------------------
 
@@ -2170,7 +2327,13 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             prefill_attn_fn=prefill_attn,
             prefill_attn_divisor=sp_size if prefill_attn is not None else 1,
             lockstep_role=lockstep_role,
-            decode_pipeline=int(kw.pop("decode_pipeline", conf.get_int("ENGINE_DECODE_PIPELINE", 2))),
+            # unified pipeline depth: ENGINE_PIPELINE is canonical; the
+            # pre-unification ENGINE_DECODE_PIPELINE spelling (and the
+            # decode_pipeline kwarg) keep working as aliases
+            pipeline_depth=int(kw.pop("pipeline_depth", kw.pop(
+                "decode_pipeline",
+                conf.get_int("ENGINE_PIPELINE", 0)
+                or conf.get_int("ENGINE_DECODE_PIPELINE", 2)))),
             eos_token_id=eos,
             tokenizer=tokenizer,
             default_timeout=default_timeout,

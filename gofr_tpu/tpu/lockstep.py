@@ -22,6 +22,11 @@ rides inside the packed inputs, decode-chunk length is static, and the
 device-resident ``prev_last`` carry is reproduced on every process because
 each executes the same calls in the same order (warmup decode announces a
 live=0 flag so followers mirror the leader's no-carry warmup exactly).
+The leader's unified async pipeline (engine ``_dq``) preserves this: it
+announces immediately before each DISPATCH on the device thread, so the
+broadcast stream is the dispatch order even while older calls' readbacks
+are still in flight — followers execute synchronously and replay
+identically (tests/test_async_pipeline.py records and replays a stream).
 
 Failure semantics: the leader broadcasts the STOP tag on ``stop()`` AND
 from the device loop's terminal crash path, so follower processes never

@@ -373,6 +373,29 @@ class TestBuildEngine:
         finally:
             eng.stop()
 
+    def test_weights_path_does_not_swallow_seed(self, monkeypatch):
+        """ADVICE r5 regression: with ``spec.weights`` set (checkpoint/HF),
+        a caller-supplied seed used to be popped for the random-init branch
+        and silently dropped before reaching GenerateEngine — the engine's
+        sampling RNG fell back to seed 0. The popped seed must be passed
+        explicitly to ``GenerateEngine(seed=...)``."""
+        from gofr_tpu.models import convert
+
+        cfg = LlamaConfig.tiny()
+        params = llama.init(cfg, jax.random.key(0))
+        monkeypatch.setattr(convert, "llama_from_hf",
+                            lambda path, dtype=None: (cfg, params),
+                            raising=False)
+        spec = ModelSpec("llama", task="generate", weights="hf-stub/tiny")
+        eng = build_engine(spec, make_container(), seed=11, slots=2, max_len=32)
+        try:
+            assert (jax.random.key_data(eng._base_key)
+                    == jax.random.key_data(jax.random.key(11))).all(), (
+                "seed was dropped on the weights path before reaching the engine"
+            )
+        finally:
+            eng.stop()
+
     def test_build_rejects_unknown_task(self):
         spec = ModelSpec("llama", LlamaConfig.tiny(), task="nonsense")
         with pytest.raises(ValueError, match="unknown task"):
