@@ -29,8 +29,10 @@ Env knobs:
     GOFR_BENCH_KV             'slot' (default) | 'paged' engine KV layout
     GOFR_BENCH_KV_QUANTIZE    'int8' = int8 KV cache (slot and paged layouts)
     GOFR_BENCH_SPEC           N>0 = speculative decoding with N lookup drafts
-    GOFR_BENCH_PREFIX         1 = also measure the shared-prefix workload on the
-                              paged engine (prefix cache on vs off)
+    GOFR_BENCH_PREFIX         1 = also measure the forced-spill shared-prefix
+                              workload on the paged engine, three-way: cache
+                              off / HBM-only / HBM+host spill tier (cold and
+                              warm TTFT p50, per-tier hit tokens)
     GOFR_BENCH_PIPELINE       device pipeline depth (default 2; 1 = sync, up to 4)
     GOFR_BENCH_OVERLAP_AB     1 = also measure the mixed-arrival workload (paced
                               arrivals of short + chunked-long prompts) with the
@@ -491,37 +493,117 @@ def main() -> None:
     if sweep_log:
         extra["sweep"] = sweep_log
 
-    # shared-prefix workload on the paged engine: every prompt shares a
-    # 2-page (256-token) prefix; prefix caching serves it from cached KV
-    # pages after the first request (tpu/prefix.py). A/B on vs off.
+    # shared-prefix workload on the paged engine, THREE-way A/B (ISSUE 4):
+    # cache off / HBM-only / HBM + host-DRAM spill tier. Several groups of
+    # prompts each share a 2-page prefix; the page pool is sized so the
+    # groups cannot all stay cached in HBM — mid-run pool pressure evicts
+    # (HBM-only) or spills to host (HBM+host) the colder groups' pages.
+    # Each arm runs one concurrent COLD wave over every prompt (throughput
+    # + cold TTFT), then sequential WARM PROBES re-issuing one prompt for
+    # each of the oldest groups — the HBM-only arm must re-prefill their
+    # evicted prefixes while the host arm swaps them back in over the
+    # device pipeline, which is exactly the warm-TTFT gap reported.
     if os.environ.get("GOFR_BENCH_PREFIX") == "1":
-        n_pref = max(8, n_requests // 4)
-        # 2 shared pages + a half-page unique tail, scaled down for tiny
-        # configs so the CPU fallback still smoke-tests the path
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        def _tier_totals(name) -> dict:
+            mm = container.metrics.get(name)
+            out: dict = {}
+            if mm is not None:
+                for ls, v in mm._values.items():
+                    tier = dict(ls).get("tier", "")
+                    out[tier] = out.get(tier, 0.0) + v
+            return out
+
+        groups = 6
+        n_per = max(2, n_requests // 32)
+        # a LONG shared prefix (several pages) + a half-page unique tail per
+        # prompt: re-prefilling the prefix costs real compute while a host
+        # swap-in is one upload, so the tiers separate even on the CPU
+        # fallback; scaled down for tiny configs
         ppage = 128 if cfg.max_seq_len >= 512 else 16
-        shared = rng.randint(1, cfg.vocab_size, size=2 * ppage).tolist()
+        shared_pages = 6
         tail = ppage // 2
-        pprompts = [shared + rng.randint(1, cfg.vocab_size, size=tail).tolist()
-                    for _ in range(n_pref)]
+        shared = [rng.randint(1, cfg.vocab_size, size=shared_pages * ppage).tolist()
+                  for _ in range(groups)]
+        pprompts = [s + rng.randint(1, cfg.vocab_size, size=tail).tolist()
+                    for s in shared for _ in range(n_per)]
+        pref_new = min(max_new, 8)  # decode is not what this A/B measures
+        p_slots = max(2, min(best[0], 4))
+        p_max_len = shared_pages * ppage + tail + pref_new + 8
+        pages_per_slot = -(-(p_max_len + best[1]) // ppage)
+        # pool covers the active slots plus ONE group's prefix of spare:
+        # the cached corpus (groups * shared_pages) cannot stay resident, so
+        # pressure comes from cache RETENTION, not slot demand — the forced-
+        # spill condition the A/B exists to measure, without allocation
+        # thrash between concurrent slots
+        p_pages = p_slots * pages_per_slot + shared_pages
+        # generous fixed host budget: every group's pages fit with room to
+        # spare on any preset (host DRAM is the cheap tier by construction)
+        host_mb = 256.0
         pref_ab: dict = {}
-        hits0 = _counter_total(container, "app_tpu_prefix_hit_tokens")
-        for mode, on in (("on", True), ("off", False)):
-            pkw = dict(slots=best[0], max_len=2 * ppage + tail + max_new + 8,
+        for mode, on, hmb in (("off", False, 0.0), ("hbm", True, 0.0),
+                              ("hbm_host", True, host_mb)):
+            pkw = dict(slots=p_slots, max_len=p_max_len,
                        max_prefill_batch=prefill_batch, decode_chunk=best[1],
-                       prefill_buckets=[tail, 2 * ppage + tail],
-                       decode_pipeline=pipeline,
-                       kv_layout="paged", page_size=ppage, prefix_cache=on)
+                       prefill_buckets=[tail, shared_pages * ppage + tail],
+                       decode_pipeline=pipeline, kv_layout="paged",
+                       page_size=ppage, total_pages=p_pages,
+                       prefix_cache=on, prefix_host_mb=hmb)
+            hits0 = _tier_totals("app_tpu_prefix_hit_tokens")
+            swap0 = _counter_total(container, "app_tpu_prefix_swapin_pages_total")
             try:
-                m2 = _run_once(pkw, cfg, params, container, llama, pprompts,
-                               max_new, timeout)
-                pref_ab[mode] = {
-                    "req_per_s": round(len(pprompts) / m2["elapsed"], 2),
-                    "ttft_p50_s": round(_percentile(m2["ttfts"], 50), 4),
+                engine = GenerateEngine(llama, cfg, params, container, **pkw)
+                try:
+                    engine.warmup()
+                    engine.start()
+                    # cold wave: concurrent fill — populates (and, via pool
+                    # pressure, spills) the group prefixes; throughput number
+                    t0 = time.monotonic()
+                    reqs = [engine.submit(p, max_new_tokens=pref_new,
+                                          timeout=timeout) for p in pprompts]
+                    rr = [r.result(timeout) for r in reqs]
+                    cold_elapsed = time.monotonic() - t0
+                    cold_ttfts = [r["ttft_s"] for r in rr]
+                    # warm probes: SEQUENTIAL re-issue of one prompt per
+                    # group among the OLDEST half — the groups LRU pressure
+                    # aged out of HBM, i.e. the population the spill tier
+                    # exists to serve. Per-request TTFT with no queueing
+                    # confound, which is the latency the tiers actually
+                    # differ on: full re-prefill (off / evicted) vs
+                    # swap-in + tail-chunk (host tier). Still-resident
+                    # groups behave identically in both cached arms and
+                    # would only dilute the p50.
+                    warm_ttfts = [
+                        engine.generate(pprompts[g * n_per], max_new_tokens=pref_new,
+                                        timeout=timeout)["ttft_s"]
+                        for g in range(max(1, groups // 2))
+                    ]
+                finally:
+                    engine.stop()
+                hits1 = _tier_totals("app_tpu_prefix_hit_tokens")
+                arm = {
+                    "req_per_s": round(len(pprompts) / cold_elapsed, 2),
+                    "cold_ttft_p50_s": round(_percentile(cold_ttfts, 50), 4),
+                    "warm_ttft_p50_s": round(_percentile(warm_ttfts, 50), 4),
+                    "hit_tokens": {t: int(hits1.get(t, 0) - hits0.get(t, 0))
+                                   for t in ("hbm", "host")},
                 }
+                if hmb:
+                    arm["swapin_pages"] = int(_counter_total(
+                        container, "app_tpu_prefix_swapin_pages_total") - swap0)
+                pref_ab[mode] = arm
             except Exception as e:  # noqa: BLE001
                 pref_ab[mode] = f"error: {e}"[:160]
-        pref_ab["hit_tokens"] = int(
-            _counter_total(container, "app_tpu_prefix_hit_tokens") - hits0)
+        pref_ab["groups"] = groups
+        pref_ab["cold_prompts"] = len(pprompts)
+        pref_ab["warm_probes"] = max(1, groups // 2)
+        pref_ab["total_pages"] = p_pages
+        if (isinstance(pref_ab.get("hbm"), dict)
+                and isinstance(pref_ab.get("hbm_host"), dict)):
+            pref_ab["warm_ttft_speedup"] = round(
+                pref_ab["hbm"]["warm_ttft_p50_s"]
+                / max(pref_ab["hbm_host"]["warm_ttft_p50_s"], 1e-9), 3)
         extra["prefix_ab"] = pref_ab
 
     # NB: on the CPU fallback the "device" compute runs on the same host

@@ -101,8 +101,24 @@ class Container:
         m.new_gauge("app_tpu_kv_pages_free", "free pages in the paged KV pool")
         m.new_counter("app_tpu_preemptions", "slots preempted under KV pool pressure")
         m.new_counter("app_tpu_engine_restarts", "engine device-thread restarts")
-        m.new_counter("app_tpu_prefix_hit_tokens", "prompt tokens served from the prefix cache")
-        m.new_gauge("app_tpu_prefix_cached_pages", "KV pages held by the prefix cache")
+        # hierarchical prefix cache (tpu/prefix.py, docs/serving.md): hit
+        # tokens carry a tier label (hbm = pages already in the pool,
+        # host = pages swapped back in from the host-DRAM spill tier)
+        m.new_counter("app_tpu_prefix_hit_tokens", "prompt tokens served from the prefix cache (by tier)")
+        m.new_counter("app_tpu_prefix_lookup_total", "prefix-cache lookups at admission")
+        m.new_counter("app_tpu_prefix_miss_total", "prefix-cache lookups that hit nothing")
+        m.new_gauge("app_tpu_prefix_cached_pages", "KV pages held by the prefix cache in HBM")
+        m.new_gauge("app_tpu_prefix_host_pages", "KV pages held by the host-DRAM cache tier")
+        m.new_gauge("app_tpu_prefix_host_bytes", "bytes held by the host-DRAM cache tier")
+        m.new_counter("app_tpu_prefix_evicted_pages_total",
+                      "prefix-cache pages evicted (tier: hbm = left the pool, host = dropped from host DRAM)")
+        m.new_counter("app_tpu_prefix_swapin_pages_total",
+                      "host-tier pages swapped back into the device pool")
+        m.new_histogram("app_tpu_prefix_swapin_seconds",
+                        "host->device page swap-in latency, dispatch to fold (s)")
+        m.new_histogram("app_tpu_prefix_swapin_bytes",
+                        "bytes uploaded per host->device swap-in",
+                        buckets=[2 ** 14, 2 ** 17, 2 ** 20, 2 ** 23, 2 ** 26, 2 ** 29])
         m.new_counter("app_tpu_spec_proposed", "draft tokens proposed by speculative decoding")
         m.new_counter("app_tpu_spec_accepted", "draft tokens accepted by target verification")
         # SLO latency family (docs/observability.md): recorded by the engine

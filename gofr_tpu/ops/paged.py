@@ -31,6 +31,7 @@ import contextlib
 import contextvars
 import os
 from dataclasses import dataclass
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -309,6 +310,44 @@ def append_tokens_paged(
     k_layer = k_layer.at[rows, heads, off[:, None]].set(k_new.astype(k_layer.dtype))
     v_layer = v_layer.at[rows, heads, off[:, None]].set(v_new.astype(v_layer.dtype))
     return k_layer, v_layer
+
+
+# -- hierarchical prefix cache: per-page host spill / swap-in -------------------
+#
+# The engine's host-DRAM cache tier (tpu/prefix.py, docs/serving.md) moves
+# whole pages between the pool and host memory. Both helpers work on the
+# cache PYTREE (PagedKVCache or QPagedKVCache), so one definition covers the
+# bf16 layout (k/v planes) and the int8 layout (k/v int8 + ks/vs scale
+# planes) — every plane is [L, P, ...page-slice dims...] and the page axis
+# is always axis 1.
+
+
+@jax.jit
+def gather_page(cache, page_id):
+    """Slice ONE page's content out of every plane of a paged cache pytree:
+    each [L, P, ...] plane yields [L, ...]. ``page_id`` is a traced scalar,
+    so one compiled program per cache type serves every spill. The engine
+    reads the result back to host (``np.asarray``) at spill time — the page
+    is an immutable cache leaf, so the latest ``engine.cache`` value is its
+    authoritative content."""
+    return jax.tree.map(lambda a: a[:, page_id], cache)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def swap_in_pages(cache, page_ids, payload):
+    """Write host-staged page payloads back into the pool. ``page_ids`` [W]
+    (padded with an out-of-bounds id — pool size — whose scatter writes XLA
+    DROPS, the same convention block tables use); ``payload`` mirrors the
+    cache pytree with per-plane [L, W, ...page-slice dims...] stacks.
+    Returns ``(new_cache, marker)`` — the marker is a tiny output of the
+    same executable, so reading it back (the unified pipeline's fold)
+    blocks until the whole upload has landed without pulling the pool to
+    host. The cache argument is donated, matching every other engine
+    program that rewrites it (tpu/programs.py)."""
+    new = jax.tree.map(
+        lambda a, p: a.at[:, page_ids].set(p.astype(a.dtype)), cache, payload
+    )
+    return new, jnp.sum(page_ids)
 
 
 def gather_kv(

@@ -46,10 +46,11 @@ def greedy_reference(cfg, params) -> Callable[[list[int], int], list[int]]:
 
 def assert_paged_pool_consistent(engine, slots_empty: bool = False) -> None:
     """Paged-pool accounting invariant: every page is free XOR held, and
-    ``_page_refs`` equals the true holder count (slot block tables + prefix
-    cache). With ``slots_empty`` (end-of-test quiescence) additionally
-    require that only the prefix cache still holds pages — the old
-    "everything is free" assertion generalized for prefix retention."""
+    ``_page_refs`` equals the true holder count (slot block tables + the
+    prefix cache's DEVICE tier — host-resident nodes hold no pool pages).
+    With ``slots_empty`` (end-of-test quiescence) additionally require that
+    only the prefix cache still holds pages — the old "everything is free"
+    assertion generalized for prefix retention."""
     import numpy as np
 
     refs = np.zeros(engine.total_pages, np.int64)
@@ -60,12 +61,71 @@ def assert_paged_pool_consistent(engine, slots_empty: bool = False) -> None:
         assert not refs.any(), "a vacated slot still holds pages"
     if engine._prefix is not None:
         for node in engine._prefix._nodes.values():
-            refs[node.page_id] += 1
+            if node.page_id >= 0:
+                refs[node.page_id] += 1
     assert (refs == engine._page_refs).all(), "refcounts diverge from holders"
     free = set(engine._free_pages)
     assert len(free) == len(engine._free_pages), "free list holds duplicates"
-    for p in range(engine.total_pages):
+    for p in range(getattr(engine, "_page_sink", 0), engine.total_pages):
         assert (p in free) == (refs[p] == 0), f"page {p}: free/held mismatch"
+
+
+def assert_page_refs_consistent(engine) -> None:
+    """Full paged-cache accounting cross-check, safe to call at any point
+    (takes the engine state lock): ``_page_refs`` vs the true holders (slot
+    page lists + device-tier prefix nodes), free-list/refcount duality,
+    block-table rows vs ``_slot_pages``, and both prefix-cache tiers'
+    internal invariants (host nodes carry payloads and no page; device
+    nodes carry a page and no payload; host byte/page accounting matches
+    the stored payloads). No-op on slot-layout engines — used as a shared
+    teardown by tests/test_prefix.py and tests/test_async_pipeline.py."""
+    if getattr(engine, "kv_layout", "slot") != "paged":
+        return
+    import numpy as np
+
+    with engine._state_lock:
+        assert_paged_pool_consistent(engine)
+        for i, pages in enumerate(engine._slot_pages):
+            row = engine._table[i]
+            assert list(row[: len(pages)]) == list(pages), (
+                f"slot {i}: block table row diverges from _slot_pages")
+            assert (row[len(pages):] == engine.total_pages).all(), (
+                f"slot {i}: table rows past the owned pages must be OOB")
+            if engine.slots[i] is None:
+                assert not pages, f"empty lane {i} still owns pages"
+        cache = engine._prefix
+        if cache is None:
+            return
+        dev = host = 0
+        host_bytes = 0
+        for key, node in cache._nodes.items():
+            if node.page_id >= 0:
+                dev += 1
+                assert node.host is None and node.host_nbytes == 0, (
+                    "device-tier node still holds a host payload")
+            else:
+                host += 1
+                assert node.host is not None, "host-tier node lost its payload"
+                assert not node.pending, "host-tier node marked upload-pending"
+                host_bytes += node.host_nbytes
+        # child counters: recompute from parent links across both tiers
+        children = {k: [0, 0] for k in cache._nodes}
+        for node in cache._nodes.values():
+            ent = children.get(node.parent_key)
+            if ent is not None:
+                ent[0] += 1
+                if node.page_id >= 0:
+                    ent[1] += 1
+        for key, node in cache._nodes.items():
+            want_all, want_dev = children[key]
+            assert node.children == want_all, (
+                f"node {key}: children counter {node.children} != {want_all}")
+            assert node.dev_children == want_dev, (
+                f"node {key}: dev_children counter {node.dev_children} != {want_dev}")
+        assert len(cache) == dev, "device-tier count diverges"
+        assert cache.host_pages == host, "host-tier count diverges"
+        assert cache.host_bytes == host_bytes, "host byte accounting diverges"
+        assert np.all(engine._page_refs >= 0), "negative page refcount"
 
 
 def check_mesh_serving(config: dict[str, str], *, n_requests: int = 6,

@@ -287,7 +287,8 @@ def process_decode(eng) -> bool:
     slot object changed since dispatch (freed, preempted, reassigned)
     have their results discarded — the identity check is what makes
     dispatch-time claiming safe. Handles every entry kind on ``eng._dq``:
-    plain decode, spec rounds, batched prefill, and prefill chunks."""
+    plain decode, spec rounds, batched prefill, prefill chunks, and
+    prefix-cache host→device page swap-ins."""
     if not eng._dq:
         return False
     kind, dev, meta, t0, occupancy, sig = eng._dq.popleft()
@@ -300,6 +301,11 @@ def process_decode(eng) -> bool:
         # stop() declared this thread wedged and already failed/cleared
         # everything; the slot/page state now belongs to the caller.
         return False
+    if kind == "swapin":
+        # chunk is the upload's completion marker (already read back above,
+        # i.e. the host→device page copy has landed); fold is bookkeeping
+        eng._fold_swapin(meta, t0, occupancy, sig)
+        return True
     if kind == "prefill":
         eng._fold_prefill(chunk, meta, t0, occupancy, sig)
         return True

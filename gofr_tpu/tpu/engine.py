@@ -422,6 +422,11 @@ class _EngineBase:
         if proposed:
             entry["spec_accept_rate"] = round(
                 kw.get("_spec_accepted", 0) / proposed, 4)
+        prefix = kw.get("_prefix")
+        if prefix:
+            # per-tier prefix-cache hit breakdown (hbm/host tokens + pages
+            # swapped in from host DRAM) — docs/observability.md
+            entry["prefix"] = prefix
         if error is not None:
             entry["error"] = type(error).__name__
         elif isinstance(result, dict) and "finish_reason" in result:
@@ -735,6 +740,7 @@ class GenerateEngine(_EngineBase):
         max_restarts: int = 3,
         decode_pipeline: int = 2,
         prefix_cache: bool = True,
+        prefix_host_mb: float = 0.0,
         spec_tokens: int = 0,
         kv_quantize: str = "",
         prefill_attn_fn: Any = None,
@@ -935,7 +941,60 @@ class GenerateEngine(_EngineBase):
             self._page_refs = np.zeros(self.total_pages, np.int64)
             from gofr_tpu.tpu.prefix import PrefixCache
 
-            self._prefix = PrefixCache(page_size) if prefix_cache else None
+            # Hierarchical cache host tier (ENGINE_PREFIX_HOST_MB): pages the
+            # LRU eviction would drop are spilled to a bounded host-DRAM
+            # buffer instead and swapped back in asynchronously over the
+            # unified pipeline on a later hit (docs/serving.md). 0 keeps the
+            # single-tier behavior bit-for-bit. Not wired under lockstep:
+            # swap-in payloads are host-resident K/V that followers never
+            # saw, so announcing the upload cannot reproduce it.
+            host_mb = max(0.0, float(prefix_host_mb))
+            if host_mb and lockstep_role:
+                container.logger.warn(
+                    "ENGINE_PREFIX_HOST_MB ignored under lockstep (swap-in "
+                    "payloads cannot be announced to followers)"
+                )
+                host_mb = 0.0
+            # per-page host-copy footprint across every cache plane (k/v for
+            # bf16; k/v/ks/vs for int8) — the page axis is always axis 1
+            self._page_bytes = sum(
+                leaf.nbytes // self.total_pages for leaf in jax.tree.leaves(self.cache)
+            )
+            host_budget = int(host_mb * (1 << 20))
+            if host_budget and host_budget < self._page_bytes:
+                # a budget that cannot hold even one page would turn every
+                # pool-pressure eviction into a gather+copy that is then
+                # immediately dropped — pure overhead, no caching
+                container.logger.warn(
+                    f"ENGINE_PREFIX_HOST_MB={host_mb:g} is below one page's "
+                    f"footprint ({self._page_bytes} bytes); host tier disabled"
+                )
+                host_budget = 0
+            self._prefix = (PrefixCache(page_size, host_budget_bytes=host_budget)
+                            if prefix_cache else None)
+            self._cache_treedef = jax.tree.structure(self.cache)
+            # swap-in upload widths: a power-of-two bucket ladder like the
+            # prefill buckets — one compiled upload program per bucket, and
+            # a 1-page hit never ships pages_per_slot pages of zero padding
+            self._swapin_buckets = _pow2_buckets(1, self.pages_per_slot)
+            # swap-ins staged by _prefix_hit under the state lock, dispatched
+            # by _admit right after releasing it; spills staged by
+            # _evict_prefix_page, materialized to host by _materialize_spills
+            # (both device-thread only)
+            self._pending_swapins: list = []
+            self._pending_spills: list = []
+            if self._prefix is not None and self._prefix.host_budget:
+                # compile the spill gather EAGERLY: it is the one program
+                # dispatched while the state lock is held (_evict_prefix_
+                # page), and warmup() is optional — a first-spill JIT
+                # compile under the lock would stall submit()/stop() for
+                # the compile duration. The swap-in upload programs compile
+                # in warmup() or lazily at dispatch, which runs unlocked.
+                from gofr_tpu.ops.paged import gather_page
+
+                jax.block_until_ready(
+                    jax.tree.leaves(gather_page(self.cache, jnp.int32(0)))[0])
+            self._set_prefix_gauges()  # authoritative from construction on
         else:
             # cache headroom so a chunk never writes past Smax; round to a
             # kernel-friendly multiple of 128 when the model allows it
@@ -1142,6 +1201,27 @@ class GenerateEngine(_EngineBase):
             jax.block_until_ready(toks)
             self._compiled.add(("decode_spec", n, k, self.spec_tokens))
             count += 1
+        if (self.kv_layout == "paged" and self._prefix is not None
+                and self._prefix.host_budget):
+            # host-tier spill/swap-in programs: a first spill or swap-in
+            # mid-serving would otherwise pay its XLA compile inside the
+            # latency window the tier exists to shrink. The swap-in warmup
+            # uses an all-OOB id vector, so every upload write is dropped.
+            from gofr_tpu.ops.paged import gather_page, swap_in_pages
+
+            jax.block_until_ready(
+                jax.tree.leaves(gather_page(self.cache, jnp.int32(0)))[0])
+            count += 1
+            for wb in self._swapin_buckets:
+                ids = np.full((wb,), self.total_pages, np.int32)
+                payload = jax.tree.unflatten(self._cache_treedef, [
+                    np.zeros((leaf.shape[0], wb) + tuple(leaf.shape[2:]), leaf.dtype)
+                    for leaf in jax.tree.leaves(self.cache)])
+                self.cache, marker = swap_in_pages(
+                    self.cache, jnp.asarray(ids), payload)
+                jax.block_until_ready(marker)
+                self._compiled.add(("swapin", wb))
+                count += 1
         return count
 
     def submit(
@@ -1271,10 +1351,15 @@ class GenerateEngine(_EngineBase):
                     (self.num_slots, self.pages_per_slot), self.total_pages, np.int32
                 )
                 self._page_refs[:] = 0
+                self._pending_swapins = []
+                self._pending_spills = []
                 if self._prefix is not None:
-                    # cached pages rode the same suspect device state
+                    # cached pages (both tiers) rode the same suspect device
+                    # state; the gauges must say so (a stale cached_pages /
+                    # host_pages reading after a restart would misreport
+                    # capacity until the next eviction touched them)
                     self._prefix.clear()
-                    self.metrics.set_gauge("app_tpu_prefix_cached_pages", 0)
+                    self._set_prefix_gauges()
             else:
                 self.cache = self._build_slot_cache()
             self._spec_carry = None  # rode the same suspect device state
@@ -1358,21 +1443,56 @@ class GenerateEngine(_EngineBase):
                     self._unref_page(p)
             self.metrics.set_gauge("app_tpu_kv_pages_free", len(self._free_pages))
 
+    def _set_prefix_gauges(self) -> None:
+        """One authoritative write of every prefix-cache occupancy gauge —
+        eviction, insertion, swap-in, clear(), and crash-restart all funnel
+        here so no path can leave a stale reading behind."""
+        if self._prefix is None:
+            return
+        self.metrics.set_gauge("app_tpu_prefix_cached_pages", len(self._prefix))
+        self.metrics.set_gauge("app_tpu_prefix_host_pages", self._prefix.host_pages)
+        self.metrics.set_gauge("app_tpu_prefix_host_bytes", self._prefix.host_bytes)
+
     def _evict_prefix_page(self) -> bool:
         """Release LRU prefix-cache leaves until a page actually lands in
         the free pool (an evicted page still shared with a live slot frees
-        nothing — keep going). False when the cache has nothing left."""
+        nothing — keep going). With the host tier enabled the page's K/V is
+        spilled instead of dropped: the per-page gather is DISPATCHED here
+        (asynchronous — no device round trip ever blocks under the state
+        lock, or a wedged device call would deadlock stop()'s _fail_all
+        behind it) and the node temporarily holds the small gathered device
+        buffers; _materialize_spills completes the device→host read outside
+        the lock on the next loop iteration. False when the cache has
+        nothing left."""
         if self._prefix is None:
             return False
         freed = False
         while not self._free_pages:
-            p = self._prefix.evict_lru()
-            if p is None:
-                break
+            if self._prefix.host_budget:
+                ent = self._prefix.spill_lru()
+                if ent is None:
+                    break
+                key, p = ent
+                from gofr_tpu.ops.paged import gather_page
+
+                payload = tuple(
+                    jax.tree.leaves(gather_page(self.cache, jnp.int32(p)))
+                )
+                dropped = self._prefix.commit_spill(key, payload, self._page_bytes)
+                self._pending_spills.append((key, payload))
+                if dropped:
+                    self.metrics.increment_counter(
+                        "app_tpu_prefix_evicted_pages_total", dropped, tier="host")
+            else:
+                p = self._prefix.evict_lru()
+                if p is None:
+                    break
+            self.metrics.increment_counter(
+                "app_tpu_prefix_evicted_pages_total", 1, tier="hbm")
             self._unref_page(p)
             freed = True
         if freed:
-            self.metrics.set_gauge("app_tpu_prefix_cached_pages", len(self._prefix))
+            self._set_prefix_gauges()
         return bool(self._free_pages)
 
     def _ensure_pages(self, slot_idx: int, upto_pos: int) -> bool:
@@ -1398,33 +1518,94 @@ class GenerateEngine(_EngineBase):
             added += 1
         return True
 
-    def _usable_hit(self, toks: np.ndarray) -> list[int]:
-        """Cached pages covering a prefix of ``toks``, capped below the
-        prompt length so the final prompt token's logits — and therefore
-        the first sampled token — are always recomputed (tpu/prefix.py
-        invariants). The single source of truth for both admission routing
-        and slot claim. Touches cache LRU clocks; takes no references."""
+    def _usable_hit(self, toks: np.ndarray) -> list:
+        """``(key, node)`` chain entries (tpu/prefix.py, both tiers)
+        covering a prefix of ``toks``, capped below the prompt length so
+        the final prompt token's logits — and therefore the first sampled
+        token — are always recomputed. The single source of truth for both
+        admission routing and slot claim. Touches cache LRU clocks; takes
+        no references. Deliberately NOT the lookup/miss counting point:
+        admission planning may re-run for a request bounced by pool
+        exhaustion, and per-round counting would drown the hit-rate ratio
+        in retry noise — counting happens once per claim/admission
+        (_prefix_hit and the batched-path admission loop)."""
         if self._prefix is None:
             return []
-        hit = self._prefix.lookup(toks)
-        n_hit = min(len(hit), (int(toks.shape[0]) - 1) // self.page_size)
-        return hit[:n_hit]
+        chain = self._prefix.lookup_tiered(toks)
+        n_hit = min(len(chain), (int(toks.shape[0]) - 1) // self.page_size)
+        return chain[:n_hit]
 
-    def _prefix_hit(self, idx: int, slot: _Slot, toks: np.ndarray) -> None:
+    def _prefix_hit(self, idx: int, slot: _Slot, toks: np.ndarray,
+                    chain: list | None = None) -> None:
         """Splice the longest cached full-page prefix of ``toks`` into a
         freshly claimed slot's block table (caller holds the state lock;
         the slot owns no pages yet); chunked prefill then starts at
-        ``slot.written``."""
-        pages = self._usable_hit(toks)
-        if not pages:
+        ``slot.written``. Device-resident chain nodes splice directly;
+        host-resident nodes claim a FREE device page each (stopping the
+        chain where none is available — table rows must stay contiguous),
+        are promoted back to the device tier, and their payload upload is
+        staged on ``_pending_swapins`` — ``_admit`` dispatches it onto the
+        unified in-flight queue right after releasing the lock, before any
+        chunk of this prompt's tail can dispatch, so the cache data
+        dependency orders the upload ahead of every read of those pages."""
+        if self._prefix is None:
             return
-        for p in pages:
-            self._ref_page(p)
+        # lookup/miss accounting at CLAIM time, once per request — never in
+        # _usable_hit, whose planning caller can re-run for a pool-bounced
+        # request (hit rate = 1 - miss_total / lookup_total)
+        self.metrics.increment_counter("app_tpu_prefix_lookup_total", 1)
+        if chain is None:
+            chain = self._usable_hit(toks)
+        if not chain:
+            self.metrics.increment_counter("app_tpu_prefix_miss_total", 1)
+            return
+        pages: list[int] = []
+        swap_keys: list[int] = []
+        swap_pids: list[int] = []
+        swap_payloads: list = []
+        hbm_toks = host_toks = 0
+        for key, node in chain:
+            if node.page_id >= 0:
+                p = node.page_id
+                self._ref_page(p)
+                hbm_toks += self.page_size
+            else:
+                if not self._free_pages:
+                    break  # no device page for the swap-in: tail recomputes
+                p = self._free_pages.pop()
+                # two shares at once: this slot's and the cache's (the node
+                # is promoted below — never double-freed across tiers)
+                self._page_refs[p] = 2
+                swap_keys.append(key)
+                swap_pids.append(p)
+                swap_payloads.append(node.host)
+                self._prefix.promote(key, p)
+                host_toks += self.page_size
+            pages.append(p)
+        if not pages:
+            # a chain whose first node is host-resident with no free device
+            # page serves NOTHING from cache — that is a miss for hit-rate
+            # purposes, or pool-pressure episodes would over-report hits
+            self.metrics.increment_counter("app_tpu_prefix_miss_total", 1)
+            return
         self._slot_pages[idx] = list(pages)
         self._table[idx, :len(pages)] = pages
         slot.written = len(pages) * self.page_size
-        slot.dispatched = slot.written  # cached tokens need no device write
-        self.metrics.increment_counter("app_tpu_prefix_hit_tokens", slot.written)
+        slot.dispatched = slot.written  # cached tokens need no prefill write
+        if hbm_toks:
+            self.metrics.increment_counter(
+                "app_tpu_prefix_hit_tokens", hbm_toks, tier="hbm")
+        if host_toks:
+            self.metrics.increment_counter(
+                "app_tpu_prefix_hit_tokens", host_toks, tier="host")
+        slot.request.kw["_prefix"] = {
+            "hbm_tokens": hbm_toks, "host_tokens": host_toks,
+            "swapin_pages": len(swap_pids),
+        }
+        if swap_pids:
+            self._pending_swapins.append(
+                (idx, slot, swap_keys, swap_pids, swap_payloads))
+            self._set_prefix_gauges()  # host bytes shrank at promotion
 
     def _prefix_insert(self, idx: int) -> None:
         """Retain the full prompt pages of a slot whose prefill just
@@ -1444,7 +1625,7 @@ class GenerateEngine(_EngineBase):
         for p in new:
             self._ref_page(p)
         if new:
-            self.metrics.set_gauge("app_tpu_prefix_cached_pages", len(self._prefix))
+            self._set_prefix_gauges()
 
     def _alloc_lane_pages(self, i: int, s: "_Slot", upto_pos: int) -> None:
         """Grow lane i's block table to cover ``upto_pos``, preempting the
@@ -1547,6 +1728,10 @@ class GenerateEngine(_EngineBase):
         self._dq.clear()  # a restarted loop must not read a dead life's futures
         self._prev_last = None
         self._spec_carry = None
+        if getattr(self, "_pending_swapins", None):
+            self._pending_swapins = []  # staged by a dead life; never dispatch
+        if getattr(self, "_pending_spills", None):
+            self._pending_spills = []
         depth = self.pipeline_depth
         while not self._stop.is_set() and not self._poisoned:
             # One bounded in-flight device queue (self._dq): batched
@@ -1761,6 +1946,100 @@ class GenerateEngine(_EngineBase):
                 self._activate_lane(idx, s, int(first[0]), time.monotonic())
 
     def _admit(self) -> bool:
+        """Admission round: plan/claim/dispatch prefills, then dispatch any
+        host-tier swap-ins the claims staged. The swap-in dispatch MUST
+        happen before this device thread can dispatch a tail chunk for the
+        claimed slot (_advance_chunked runs next in the loop): all device
+        calls thread ``self.cache``, so issue order is data-dependency
+        order and the upload lands before any read of those pages."""
+        busy = self._admit_prefill()
+        if getattr(self, "_pending_spills", None):
+            self._materialize_spills()
+        if getattr(self, "_pending_swapins", None):
+            busy = self._dispatch_swapins() or busy
+        return busy
+
+    def _materialize_spills(self) -> None:
+        """Complete staged spill copies OUTSIDE the state lock: eviction
+        dispatched each page's gather asynchronously (so pool pressure
+        never blocks the lock on a device round trip) and left the node
+        holding the small gathered device buffers; this step — device
+        thread, once per loop iteration — blocks on those buffers, copies
+        them to host memory, and swaps the node payload. Nodes dropped or
+        promoted in between simply skip the replacement."""
+        items, self._pending_spills = self._pending_spills, []
+        for key, dev_payload in items:
+            host_payload = tuple(np.asarray(x) for x in dev_payload)
+            with self._state_lock:
+                if self._prefix is not None:
+                    self._prefix.replace_host_payload(key, host_payload)
+
+    def _dispatch_swapins(self) -> bool:
+        """Dispatch one async host→device page upload per staged hit onto
+        the unified in-flight queue (device thread, outside the state lock
+        — packing is host memcpy and the device call must never wedge under
+        the lock). Pages were claimed and nodes promoted at hit time; the
+        fold (_fold_swapin) settles the nodes and records the metrics, and
+        discards slot bookkeeping by identity like every other entry."""
+        items, self._pending_swapins = self._pending_swapins, []
+        from gofr_tpu.ops.paged import swap_in_pages
+
+        leaves_proto = jax.tree.leaves(self.cache)
+        for idx, slot, keys, pids, payloads in items:
+            t0 = time.monotonic()
+            n = len(pids)
+            # smallest bucketed upload width: padding is at most 2x the
+            # pages actually swapped, never the full pages_per_slot
+            w = next_bucket(n, self._swapin_buckets)
+            ids = np.full((w,), self.total_pages, np.int32)  # pad rows: OOB, dropped
+            ids[:n] = pids
+            stacked = []
+            for li, proto in enumerate(leaves_proto):
+                buf = np.zeros((proto.shape[0], w) + tuple(proto.shape[2:]),
+                               np.asarray(payloads[0][li]).dtype)
+                for j in range(n):
+                    buf[:, j] = payloads[j][li]
+                stacked.append(buf)
+            payload_tree = jax.tree.unflatten(self._cache_treedef, stacked)
+            self.cache, marker = swap_in_pages(
+                self.cache, jnp.asarray(ids), payload_tree)
+            leaves_proto = jax.tree.leaves(self.cache)
+            # the histogram records the ACTUAL transfer (padded width) so
+            # swap-in latency and bytes stay comparable
+            nbytes = w * self._page_bytes
+            self._dq.append(("swapin", marker, (idx, slot, keys, n, nbytes),
+                             t0, n / w, ("swapin", w)))
+        return True
+
+    def _fold_swapin(self, meta, t0: float, occupancy: float, sig: tuple) -> None:
+        """Dequeue side of one swap-in (process_decode already blocked on
+        the upload's completion marker). Settles the promoted nodes — they
+        become spillable again — whatever happened to the slot; per-slot
+        bookkeeping is discarded by identity (preemption/cancel/stop while
+        in flight): the upload still landed in cache-owned pages holding
+        exactly the content their chain nodes advertise, so nothing needs
+        undoing."""
+        idx, s, keys, n_pages, nbytes = meta
+        now = time.monotonic()
+        with self._state_lock:
+            self._record_step("swapin", now - t0, occupancy, sig)
+            if self._prefix is not None:
+                for key in keys:
+                    self._prefix.settle(key)
+            self.metrics.increment_counter(
+                "app_tpu_prefix_swapin_pages_total", n_pages)
+            self.metrics.record_histogram(
+                "app_tpu_prefix_swapin_seconds", now - t0)
+            self.metrics.record_histogram(
+                "app_tpu_prefix_swapin_bytes", nbytes)
+            if self.slots[idx] is not s:
+                return  # freed/preempted/cancelled mid-swap-in
+            rt = s.request.kw.get("_rt")
+            if rt is not None:
+                rt.event("engine.prefill", "swapin",
+                         pages=n_pages, bytes=nbytes)
+
+    def _admit_prefill(self) -> bool:
         # Plan + claim under the state lock; token packing and the device
         # call OUTSIDE it (a wedged device call must never hold the lock,
         # or stop()'s _fail_all would deadlock behind it — and the pure-
@@ -1815,8 +2094,8 @@ class GenerateEngine(_EngineBase):
                 # loop iteration, and EDF ordering is preserved.
                 still = []
                 for req, toks in ready:
-                    pages = self._usable_hit(toks)
-                    if 2 * len(pages) * self.page_size >= int(toks.shape[0]):
+                    chain = self._usable_hit(toks)
+                    if 2 * len(chain) * self.page_size >= int(toks.shape[0]):
                         idx = self._free_slots()[0]
                         slot = _Slot(
                             req,
@@ -1835,13 +2114,16 @@ class GenerateEngine(_EngineBase):
                         self._mark_admitted(req, time.monotonic())
                         req.kw["_slot"] = idx
                         req.kw["_prompt_len"] = slot.prompt_len
+                        self._prefix_hit(idx, slot, toks, chain=chain)
                         rt = req.kw.get("_rt")
                         if rt is not None:
+                            # hit_pages is what was actually SPLICED — the
+                            # chain can stop short of the planning-time
+                            # length when a host node finds no free page
                             rt.begin("engine.prefill",
                                      **{"slot": idx, "prompt.tokens": slot.prompt_len,
                                         "prefill.chunked": True,
-                                        "prefix.hit_pages": len(pages)})
-                        self._prefix_hit(idx, slot, toks)
+                                        "prefix.hit_pages": len(self._slot_pages[idx])})
                         chunk_claimed = True
                     else:
                         still.append((req, toks))
@@ -1863,6 +2145,16 @@ class GenerateEngine(_EngineBase):
                 ready = admitted
             if not ready:
                 return chunk_claimed
+            if self.kv_layout == "paged" and self._prefix is not None:
+                # cache-consultation accounting at ADMISSION, not per
+                # planning round (a pool-bounced request must not recount):
+                # batched-path admissions serve nothing from cache — a
+                # below-threshold hit goes unused — so each counts one
+                # lookup and one miss
+                self.metrics.increment_counter(
+                    "app_tpu_prefix_lookup_total", len(ready))
+                self.metrics.increment_counter(
+                    "app_tpu_prefix_miss_total", len(ready))
 
             # one prefill call, padded to (len_bucket, batch_bucket), shipped
             # as ONE packed array (layout documented at the jit definitions).
@@ -2322,6 +2614,8 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
                                       conf.get_or_default("ENGINE_PAGED_KV_WRITE", ""))),
             seed=seed,
             prefix_cache=prefix_cache,
+            prefix_host_mb=float(kw.pop("prefix_host_mb",
+                                        conf.get_float("ENGINE_PREFIX_HOST_MB", 0.0))),
             spec_tokens=spec_tokens,
             kv_quantize=kv_quantize,
             prefill_attn_fn=prefill_attn,

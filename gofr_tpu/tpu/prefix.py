@@ -1,4 +1,4 @@
-"""Automatic prefix caching for the paged KV layout.
+"""Hierarchical automatic prefix caching for the paged KV layout.
 
 Full KV pages of completed prompt prefixes are retained in a token-addressed
 chain (one pool reference per cached page) and reused by later prompts that
@@ -9,6 +9,16 @@ patterns (it has none — SURVEY §5.7 notes the model layer is new capability);
 the design matches the public automatic-prefix-caching idea from paged
 serving systems, re-built here over ``ops.paged`` block tables.
 
+Two tiers (ISSUE 4): pages live in the device pool (HBM tier) or, once pool
+pressure would have dropped them, as host-DRAM copies of their K/V content
+(host tier, bounded by ``host_budget_bytes`` — 0 disables the tier and
+restores the single-tier behavior exactly). A chain may interleave tiers:
+each node is independently device-resident (``page_id >= 0``) or
+host-resident (``page_id == -1`` + a ``host`` payload). The engine owns all
+device access — it copies page content out at spill time (``spill_lru`` /
+``commit_spill``) and back in at hit time (``promote`` + an async device
+upload riding the unified in-flight queue).
+
 Correctness invariants:
 - Only FULL pages are cached, and a hit is capped at ``prompt_len - 1``
   tokens, so the final prompt token's logits are always recomputed — the
@@ -17,12 +27,18 @@ Correctness invariants:
   beyond the hit length, which live in pages the slot allocated itself.
 - Pages carry pool refcounts (engine ``_page_refs``): a page returns to the
   free pool only when no slot uses it AND the cache no longer holds it.
-  Pool pressure evicts least-recently-used cache leaves before the engine
-  resorts to preemption.
+  Pool pressure spills (or, with the host tier off, evicts) least-recently-
+  used cache leaves before the engine resorts to preemption. Host-resident
+  nodes hold NO pool reference — a page is counted in exactly one tier.
+- A node promoted to the device tier with its upload still in flight is
+  ``pending``: spill/evict skip it (its device content is not yet valid to
+  copy out), and ``settle`` clears the flag at upload fold time.
 
 KV content equality: a page holding positions [i*P, (i+1)*P) of a given
 token prefix has deterministically identical K/V regardless of which request
-computed it, so chains may interleave pages registered by different requests.
+computed it, so chains may interleave pages registered by different requests
+— and a host payload captured from one request's pages is valid content for
+every later request that hits the same chain node.
 """
 
 from __future__ import annotations
@@ -33,62 +49,110 @@ import numpy as np
 
 
 class _Node:
-    __slots__ = ("parent_key", "tokens", "page_id", "children", "last_used")
+    __slots__ = ("parent_key", "tokens", "page_id", "children", "dev_children",
+                 "last_used", "host", "host_nbytes", "pending")
 
-    def __init__(self, parent_key: int, tokens: tuple, page_id: int, last_used: int):
+    def __init__(self, parent_key: int, tokens: bytes, page_id: int, last_used: int):
         self.parent_key = parent_key
-        self.tokens = tokens
-        self.page_id = page_id
-        self.children = 0
+        self.tokens = tokens          # the page's token BYTES (int32 little-endian)
+        self.page_id = page_id        # device page id, or -1 when host-resident
+        self.children = 0             # children in ANY tier
+        self.dev_children = 0         # device-tier children (spill eligibility)
         self.last_used = last_used
+        self.host = None              # host payload (tuple of per-plane arrays)
+        self.host_nbytes = 0
+        self.pending = False          # device upload dispatched, not yet folded
 
 
 _ROOT = 0
 
 
 class PrefixCache:
-    """Token-addressed chain of cached full KV pages.
+    """Token-addressed chain of cached full KV pages, in two tiers.
 
-    The cache stores bookkeeping only — page contents stay in the engine's
-    paged pool; the engine owns refcounts and calls back into the cache for
-    lookup/insert/evict under its state lock (single-threaded access).
+    The cache stores bookkeeping (plus host-tier page payloads) — device
+    page contents stay in the engine's paged pool; the engine owns refcounts
+    and calls back into the cache for lookup/insert/spill/promote under its
+    state lock (single-threaded access).
 
-    Eviction is a lazy min-heap of ``(last_used, key)`` candidates: every
-    touch/creation of a LEAF pushes an entry; ``evict_lru`` pops until it
-    finds a live one (node still present, still a leaf, timestamp current).
-    Stale entries cost O(log n) each to skip, so eviction under pool
-    pressure is amortized O(log n) instead of the O(n)-scan-per-page the
-    first cut shipped with (ADVICE round 3)."""
+    Eviction is a lazy min-heap of ``(last_used, key)`` candidates per tier:
+    every touch/creation of an eligible node pushes an entry; the pop side
+    skips stale ones (node gone, tier changed, grew children, timestamp
+    moved, upload pending). Stale entries cost O(log n) each to skip, so
+    eviction under pool pressure is amortized O(log n) instead of the
+    O(n)-scan-per-page the first cut shipped with (ADVICE round 3).
 
-    def __init__(self, page_size: int):
+    Chain keys are hashes over the page's raw token bytes
+    (``np.ascontiguousarray(...).tobytes()``), not per-int Python tuples —
+    one contiguous copy + one ``tobytes`` per page keeps lookup/insert free
+    of O(page_size) Python-object churn on the admission hot path."""
+
+    def __init__(self, page_size: int, host_budget_bytes: int = 0):
         self.page_size = page_size
+        self.host_budget = max(0, int(host_budget_bytes))
+        self.host_bytes = 0
         self._nodes: dict[int, _Node] = {}
+        self._dev_count = 0
+        self._host_count = 0
         self._clock = 0
-        self._heap: list[tuple[int, int]] = []  # lazy (last_used, key) min-heap
+        self._heap: list[tuple[int, int]] = []   # device-tier (last_used, key)
+        self._hheap: list[tuple[int, int]] = []  # host-tier (last_used, key)
 
     def __len__(self) -> int:
-        return len(self._nodes)
+        """Device-resident (HBM-tier) page count — what the pool refcounts
+        see, and what ``app_tpu_prefix_cached_pages`` reports."""
+        return self._dev_count
+
+    @property
+    def host_pages(self) -> int:
+        return self._host_count
 
     def _tick(self) -> int:
         self._clock += 1
         return self._clock
 
     @staticmethod
-    def _child_key(parent_key: int, tokens: tuple) -> int:
+    def _child_key(parent_key: int, tokens: bytes) -> int:
         return hash((parent_key, tokens))
+
+    def _page_bytes_of(self, toks: np.ndarray) -> np.ndarray:
+        """One contiguous int32 copy of the full-page region of ``toks`` —
+        per-page keys are ``tobytes()`` slices of this buffer, so neither
+        lookup nor insert materializes per-int Python tuples."""
+        p = self.page_size
+        n_full = int(len(toks)) // p
+        return np.ascontiguousarray(toks[: n_full * p], dtype=np.int32)
 
     def _push(self, key: int, node: _Node) -> None:
         heapq.heappush(self._heap, (node.last_used, key))
         # Lazy deletion leaves one stale entry per touch; without a bound
         # the heap grows with lifetime lookup count. Compact when stale
         # entries dominate — amortized O(1) per push.
-        if len(self._heap) > 4 * len(self._nodes) + 16:
+        if len(self._heap) > 4 * self._dev_count + 16:
             self._heap = [
-                (n.last_used, k) for k, n in self._nodes.items() if n.children == 0
+                (n.last_used, k) for k, n in self._nodes.items()
+                if n.page_id >= 0 and n.dev_children == 0 and not n.pending
             ]
             heapq.heapify(self._heap)
 
-    def _get(self, parent_key: int, key: int, page_toks: tuple) -> _Node | None:
+    def _hpush(self, key: int, node: _Node) -> None:
+        heapq.heappush(self._hheap, (node.last_used, key))
+        if len(self._hheap) > 4 * self._host_count + 16:
+            self._hheap = [
+                (n.last_used, k) for k, n in self._nodes.items()
+                if n.page_id < 0 and n.children == 0
+            ]
+            heapq.heapify(self._hheap)
+
+    def _touch(self, key: int, node: _Node) -> None:
+        node.last_used = self._tick()
+        if node.page_id >= 0:
+            if node.dev_children == 0:
+                self._push(key, node)
+        elif node.children == 0:
+            self._hpush(key, node)
+
+    def _get(self, parent_key: int, key: int, page_toks: bytes) -> _Node | None:
         """Node for ``key``, or None on a miss OR a dict-slot collision.
         Both tokens and ancestry must match: two chains whose colliding
         pages hold identical tokens but different parents are distinct
@@ -98,23 +162,37 @@ class PrefixCache:
             return None
         return node
 
-    def lookup(self, toks: np.ndarray) -> list[int]:
-        """Page ids of the longest cached full-page prefix of ``toks``.
-        Touches LRU clocks; takes NO references — the caller acquires refs
-        for the pages it actually uses (and must cap the hit below
-        ``len(toks)`` so the last token is recomputed)."""
-        pages: list[int] = []
+    def lookup_tiered(self, toks: np.ndarray) -> list[tuple[int, "_Node"]]:
+        """``(key, node)`` for the longest cached full-page prefix of
+        ``toks``, across BOTH tiers (a chain may interleave device- and
+        host-resident nodes). Touches LRU clocks; takes NO references —
+        the caller acquires refs for device pages it uses, claims fresh
+        pages + ``promote``s host nodes it swaps in, and must cap the hit
+        below ``len(toks)`` so the last token is recomputed."""
+        chain: list[tuple[int, _Node]] = []
         key = _ROOT
         p = self.page_size
-        for i in range(int(len(toks)) // p):
-            page_toks = tuple(int(t) for t in toks[i * p:(i + 1) * p])
+        buf = self._page_bytes_of(toks)
+        for i in range(buf.shape[0] // p):
+            page_toks = buf[i * p:(i + 1) * p].tobytes()
             parent, key = key, self._child_key(key, page_toks)
             node = self._get(parent, key, page_toks)
             if node is None:
                 break
-            node.last_used = self._tick()
-            if node.children == 0:
-                self._push(key, node)
+            self._touch(key, node)
+            chain.append((key, node))
+        return chain
+
+    def lookup(self, toks: np.ndarray) -> list[int]:
+        """Device page ids of the longest DEVICE-RESIDENT cached full-page
+        prefix of ``toks`` (the single-tier contract: the ids splice
+        contiguously into a block table, so the walk stops at the first
+        host-resident node). Identical to the pre-tier behavior when the
+        host tier is off."""
+        pages: list[int] = []
+        for _, node in self.lookup_tiered(toks):
+            if node.page_id < 0:
+                break
             pages.append(node.page_id)
         return pages
 
@@ -122,13 +200,17 @@ class PrefixCache:
         """Register ``pages`` (the slot's own, in chain order) as the full
         pages of ``toks``. Returns the page ids NEWLY retained — the caller
         must take one pool reference per returned id (the cache's share).
-        Pages whose chain position is already cached are skipped: the
-        existing page holds identical K/V for the same tokens."""
+        Chain positions already cached on DEVICE are skipped (the existing
+        page holds identical K/V); positions cached on HOST are promoted
+        for free using the slot's page — the slot just computed identical
+        content, so the upload the host tier would otherwise owe is
+        unnecessary (the returned id covers the cache's new ref)."""
         new: list[int] = []
         key = _ROOT
         p = self.page_size
-        for i in range(min(int(len(toks)) // p, len(pages))):
-            page_toks = tuple(int(t) for t in toks[i * p:(i + 1) * p])
+        buf = self._page_bytes_of(toks)
+        for i in range(min(buf.shape[0] // p, len(pages))):
+            page_toks = buf[i * p:(i + 1) * p].tobytes()
             parent, key = key, self._child_key(key, page_toks)
             node = self._get(parent, key, page_toks)
             if node is None:
@@ -136,34 +218,171 @@ class PrefixCache:
                     break  # collision with a different chain: stop extending
                 node = _Node(parent, page_toks, pages[i], self._tick())
                 self._nodes[key] = node
+                self._dev_count += 1
                 pnode = self._nodes.get(parent)
                 if pnode is not None:
                     pnode.children += 1
+                    pnode.dev_children += 1
                 self._push(key, node)
+                new.append(pages[i])
+            elif node.page_id < 0:
+                self._promote(key, node, pages[i], pending=False)
                 new.append(pages[i])
         return new
 
-    def evict_lru(self) -> int | None:
-        """Remove the least-recently-used LEAF node (children == 0 — interior
-        nodes must outlive their descendants or chained pages leak) and
-        return its page id for the caller to release. None when empty."""
+    # -- device-tier eviction / spill -------------------------------------------
+
+    def _pop_dev_lru(self) -> tuple[int, _Node] | None:
+        """Pop the live least-recently-used device-tier node with no
+        device-tier children (descendants must leave HBM first, or chained
+        pages would become unreachable while still refcounted)."""
         while self._heap:
             last_used, key = heapq.heappop(self._heap)
             node = self._nodes.get(key)
-            if node is None or node.children != 0 or node.last_used != last_used:
-                continue  # stale: evicted, grew children, or touched since
-            del self._nodes[key]
-            parent = self._nodes.get(node.parent_key)
-            if parent is not None:
-                parent.children -= 1
-                if parent.children == 0:
-                    self._push(node.parent_key, parent)
-            return node.page_id
+            if (node is None or node.page_id < 0 or node.dev_children != 0
+                    or node.pending or node.last_used != last_used):
+                continue  # stale: evicted, spilled, grew children, or touched
+            return key, node
         return None
 
+    def _unlink(self, node: _Node) -> None:
+        """Parent bookkeeping for a node REMOVED from the chain entirely."""
+        parent = self._nodes.get(node.parent_key)
+        if parent is None:
+            return
+        parent.children -= 1
+        if node.page_id >= 0:
+            parent.dev_children -= 1
+        if parent.page_id >= 0:
+            if parent.dev_children == 0:
+                self._push(node.parent_key, parent)
+        elif parent.children == 0:
+            self._hpush(node.parent_key, parent)
+
+    def evict_lru(self) -> int | None:
+        """Remove the least-recently-used device leaf outright and return
+        its page id for the caller to release (the host-tier-off path).
+        None when no device node is evictable."""
+        popped = self._pop_dev_lru()
+        if popped is None:
+            return None
+        key, node = popped
+        del self._nodes[key]
+        self._dev_count -= 1
+        self._unlink(node)
+        return node.page_id
+
+    def spill_lru(self) -> tuple[int, int] | None:
+        """``(key, page_id)`` of the device node ``evict_lru`` would take,
+        WITHOUT removing it: the engine copies the page's K/V to host and
+        then calls ``commit_spill(key, ...)`` (the two-phase split exists
+        because only the engine can touch device memory). Callers must
+        commit before selecting again. None when nothing is spillable."""
+        popped = self._pop_dev_lru()
+        if popped is None:
+            return None
+        key, node = popped
+        return key, node.page_id
+
+    def commit_spill(self, key: int, payload, nbytes: int) -> int:
+        """Flip the node selected by ``spill_lru`` to the host tier, holding
+        ``payload`` (per-plane host copies of its K/V, ``nbytes`` total).
+        Enforces the host byte budget by dropping least-recently-used host
+        LEAVES (children == 0 in any tier — interior nodes must stay or the
+        chain below them becomes unreachable); returns the number of host
+        pages dropped. The caller releases the cache's pool reference on
+        the spilled page id afterwards — the page leaves HBM either way."""
+        node = self._nodes[key]
+        node.page_id = -1
+        node.host = payload
+        node.host_nbytes = int(nbytes)
+        node.pending = False
+        self._dev_count -= 1
+        self._host_count += 1
+        self.host_bytes += node.host_nbytes
+        parent = self._nodes.get(node.parent_key)
+        if parent is not None:
+            parent.dev_children -= 1
+            if parent.page_id >= 0 and parent.dev_children == 0:
+                self._push(node.parent_key, parent)
+        if node.children == 0:
+            self._hpush(key, node)
+        dropped = 0
+        while self.host_bytes > self.host_budget:
+            if self._drop_host_lru() is None:
+                break  # only interior host nodes left: transient overshoot
+            dropped += 1
+        return dropped
+
+    def replace_host_payload(self, key: int, payload) -> None:
+        """Swap a host node's payload in place — the engine stages spills
+        as small DEVICE buffers under its state lock (the gather dispatch
+        is asynchronous) and completes the device→host read outside it,
+        then materializes the node's payload here. No-op if the node was
+        dropped or promoted in between."""
+        node = self._nodes.get(key)
+        if node is not None and node.page_id < 0 and node.host is not None:
+            node.host = payload
+
+    def _drop_host_lru(self) -> int | None:
+        """Remove the least-recently-used host LEAF; returns its key or
+        None when no host node is droppable."""
+        while self._hheap:
+            last_used, key = heapq.heappop(self._hheap)
+            node = self._nodes.get(key)
+            if (node is None or node.page_id >= 0 or node.children != 0
+                    or node.last_used != last_used):
+                continue
+            del self._nodes[key]
+            self._host_count -= 1
+            self.host_bytes -= node.host_nbytes
+            self._unlink(node)
+            return key
+        return None
+
+    # -- host-tier promotion (swap-in) -------------------------------------------
+
+    def _promote(self, key: int, node: _Node, page_id: int, pending: bool) -> None:
+        node.page_id = page_id
+        node.host = None
+        self.host_bytes -= node.host_nbytes
+        node.host_nbytes = 0
+        node.pending = pending
+        self._host_count -= 1
+        self._dev_count += 1
+        parent = self._nodes.get(node.parent_key)
+        if parent is not None:
+            parent.dev_children += 1
+        node.last_used = self._tick()
+        if not pending and node.dev_children == 0:
+            self._push(key, node)
+
+    def promote(self, key: int, page_id: int) -> None:
+        """Move a host-resident node back to the device tier at ``page_id``
+        (the engine claimed the page and takes the cache's pool reference;
+        the host payload is dropped). The node stays ``pending`` — excluded
+        from spill/evict — until ``settle`` confirms the async upload
+        folded, because until then its device content is not yet valid."""
+        self._promote(key, self._nodes[key], page_id, pending=True)
+
+    def settle(self, key: int) -> None:
+        """Upload fold: the node's device content is now valid — it becomes
+        spillable/evictable like any other device-resident node."""
+        node = self._nodes.get(key)
+        if node is None or node.page_id < 0 or not node.pending:
+            return
+        node.pending = False
+        if node.dev_children == 0:
+            self._push(key, node)
+
     def clear(self) -> list[int]:
-        """Drop everything; returns the page ids that were held."""
-        pages = [n.page_id for n in self._nodes.values()]
+        """Drop everything (both tiers); returns the DEVICE page ids that
+        were held — host payloads carry no pool references."""
+        pages = [n.page_id for n in self._nodes.values() if n.page_id >= 0]
         self._nodes.clear()
         self._heap.clear()
+        self._hheap.clear()
+        self._dev_count = 0
+        self._host_count = 0
+        self.host_bytes = 0
         return pages

@@ -30,6 +30,7 @@ from gofr_tpu.container import new_mock_container
 from gofr_tpu.models import LlamaConfig, llama
 from gofr_tpu.testutil import (
     assert_lane_sets_consistent,
+    assert_page_refs_consistent,
     assert_paged_pool_consistent,
 )
 from gofr_tpu.tpu.engine import GenerateEngine
@@ -50,6 +51,16 @@ def setup():
         return seq[len(prompt):]
 
     return cfg, params, ref
+
+
+def _teardown(eng):
+    """Shared engine teardown: full page-refs/lane-set consistency
+    (testutil.assert_page_refs_consistent) before stopping."""
+    try:
+        assert_page_refs_consistent(eng)
+        assert_lane_sets_consistent(eng)
+    finally:
+        eng.stop()
 
 
 def make_engine(cfg, params, **kw):
@@ -149,7 +160,7 @@ def test_decode_dispatched_between_chunk_prefill_dispatch_and_readback(setup):
         )
         assert_lane_sets_consistent(eng)
     finally:
-        eng.stop()
+        _teardown(eng)
 
 
 @pytest.mark.quick
@@ -173,7 +184,7 @@ def test_decode_dispatched_between_prefill_dispatch_and_readback(setup):
         )
         assert_lane_sets_consistent(eng)
     finally:
-        eng.stop()
+        _teardown(eng)
 
 
 @pytest.mark.parametrize("kv_layout", ["slot", "paged"])
@@ -230,7 +241,7 @@ def test_mixed_arrivals_token_exact(setup, kv_layout):
                 )
                 assert_paged_pool_consistent(eng, slots_empty=True)
         finally:
-            eng.stop()
+            _teardown(eng)
 
 
 def test_depth4_token_exact(setup):
@@ -247,7 +258,7 @@ def test_depth4_token_exact(setup):
         assert got == want
         assert not eng._dq or len(eng._dq) <= 3
     finally:
-        eng.stop()
+        _teardown(eng)
 
 
 def test_stop_mid_mixed_traffic_frees_all_state(setup):

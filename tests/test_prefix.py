@@ -16,7 +16,7 @@ import pytest
 
 from gofr_tpu.container import new_mock_container
 from gofr_tpu.models import LlamaConfig, llama
-from gofr_tpu.testutil import assert_paged_pool_consistent
+from gofr_tpu.testutil import assert_page_refs_consistent, assert_paged_pool_consistent
 from gofr_tpu.tpu.engine import GenerateEngine
 from gofr_tpu.tpu.prefix import PrefixCache
 
@@ -116,6 +116,15 @@ def make_engine(cfg, params, **kw):
     return GenerateEngine(llama, cfg, params, new_mock_container(), **kw)
 
 
+def _teardown(eng):
+    """Shared engine teardown: full page-refs consistency
+    (testutil.assert_page_refs_consistent) before stopping."""
+    try:
+        assert_page_refs_consistent(eng)
+    finally:
+        eng.stop()
+
+
 def _counter_sum(eng, name):
     m = eng.metrics.get(name)
     return sum(m._values.values()) if m is not None else 0
@@ -139,7 +148,7 @@ class TestPrefixEngine:
             assert _counter_sum(eng, "app_tpu_prefix_hit_tokens") == 16
             assert_paged_pool_consistent(eng, slots_empty=True)
         finally:
-            eng.stop()
+            _teardown(eng)
 
     def test_extension_chains_interleave(self, setup):
         """p2 extends p1's prefix; p1 re-issued after p2 still exact; the
@@ -157,7 +166,7 @@ class TestPrefixEngine:
             assert _counter_sum(eng, "app_tpu_prefix_hit_tokens") > 0
             assert_paged_pool_consistent(eng, slots_empty=True)
         finally:
-            eng.stop()
+            _teardown(eng)
 
     def test_concurrent_shared_prefix(self, setup):
         """8 concurrent requests sharing a 16-token prefix with distinct
@@ -186,7 +195,7 @@ class TestPrefixEngine:
             assert _counter_sum(eng, "app_tpu_prefix_hit_tokens") >= 8 * 16
             assert_paged_pool_consistent(eng, slots_empty=True)
         finally:
-            eng.stop()
+            _teardown(eng)
 
     def test_eviction_under_pool_pressure(self, setup):
         """Distinct prompts fill the cache until pool pressure; LRU leaves
@@ -207,7 +216,7 @@ class TestPrefixEngine:
             )
             assert_paged_pool_consistent(eng, slots_empty=True)
         finally:
-            eng.stop()
+            _teardown(eng)
 
     def test_disabled_prefix_cache(self, setup):
         """prefix_cache=False: no retention, pool drains back to fully free."""
@@ -220,4 +229,4 @@ class TestPrefixEngine:
             assert eng._prefix is None
             assert sorted(eng._free_pages) == list(range(eng.total_pages))
         finally:
-            eng.stop()
+            _teardown(eng)
