@@ -1,6 +1,11 @@
-"""Publisher + subscriber example (reference `examples/using-publisher` +
-`using-subscriber`): HTTP handler publishes orders; a subscription handler
-consumes them with at-least-once commit semantics."""
+"""Publisher half of the two-process pub/sub pair (reference
+`examples/using-publisher`): an HTTP handler publishes orders onto the
+broker; the separate `examples/using-subscriber` process consumes them.
+
+The default transport is the in-tree FILE broker (PUBSUB_BACKEND=file):
+both processes share the append-only log under PUBSUB_DIR, so the pair
+runs with zero external dependencies. Point PUBSUB_BACKEND=kafka (+
+PUBSUB_BROKER) at a real broker to run the same code against Kafka."""
 
 import os as _os
 import sys as _sys
@@ -9,8 +14,6 @@ _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.
 
 from gofr_tpu import App
 from gofr_tpu.config import EnvConfig
-
-PROCESSED: list[dict] = []
 
 
 def build_app(config=None) -> App:
@@ -22,16 +25,9 @@ def build_app(config=None) -> App:
     def publish_order(ctx):
         order = ctx.bind(dict)
         ctx.publish("orders", order)
-        return {"published": True}
-
-    def consume_order(ctx):
-        order = ctx.bind(dict)
-        PROCESSED.append(order)
-        ctx.logger.info(f"processed order {order}")
-        return None  # success → offset committed (at-least-once)
+        return {"published": True, "order": order}
 
     app.post("/order", publish_order)
-    app.subscribe("orders", consume_order)
     return app
 
 

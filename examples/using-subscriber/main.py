@@ -1,0 +1,53 @@
+"""Subscriber half of the two-process pub/sub pair (reference
+`examples/using-subscriber`): consumes orders published by the separate
+`examples/using-publisher` process over the shared file-transport broker
+(real Kafka when PUBSUB_BACKEND=kafka), with at-least-once commit
+semantics and an idempotent handler — the consumer-side discipline that
+turns redelivery into an exactly-once EFFECT.
+
+GET /processed exposes what this process consumed, so the publisher
+process (and the example-tier test) can verify cross-process delivery
+over plain HTTP."""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))))
+
+from gofr_tpu import App
+from gofr_tpu.config import EnvConfig
+
+PROCESSED: list[dict] = []
+_SEEN: set = set()
+
+
+def build_app(config=None) -> App:
+    import os
+
+    folder = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+    app = App(config=config or EnvConfig(folder=folder))
+
+    def consume_order(ctx):
+        order = ctx.bind(dict)
+        # idempotency key: redelivery after a crash-before-commit must not
+        # double-apply the order (at-least-once delivery, exactly-once effect)
+        key = order.get("id")
+        if key is not None and key in _SEEN:
+            ctx.logger.info(f"duplicate delivery of order {key} ignored")
+            return None  # still commits: the effect is already applied
+        if key is not None:
+            _SEEN.add(key)
+        PROCESSED.append(order)
+        ctx.logger.info(f"processed order {order}")
+        return None  # success → offset committed (at-least-once)
+
+    def processed(_ctx):
+        return PROCESSED
+
+    app.subscribe("orders", consume_order)
+    app.get("/processed", processed)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

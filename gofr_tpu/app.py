@@ -26,6 +26,7 @@ from aiohttp import web, WSMsgType
 
 from gofr_tpu.config import DictConfig, EnvConfig
 from gofr_tpu.container import Container
+from gofr_tpu.fleet.chaos import fire as chaos_fire
 from gofr_tpu.context import Context
 from gofr_tpu.http.errors import RequestTimeout
 from gofr_tpu.http.middleware import (
@@ -207,6 +208,14 @@ class App:
     def add_kv_store(self, client: Any) -> None:
         self.container.add_kv_store(client)
 
+    def add_file_store(self, provider: Any) -> None:
+        """Swap the container's file datasource for a remote-FS provider
+        (gofr ``file/file.go:69-78`` FileSystemProvider pattern): any object
+        implementing the ``datasource.file.FileSystemProvider`` surface —
+        S3/FTP/SFTP wrappers plug in here; handlers keep using ``ctx.file``
+        unchanged."""
+        self.container.add_file_store(provider)
+
     # -- TPU model serving (the new capability) --------------------------------
 
     def serve_model(self, name: str, spec: Any = None, *, engine: Any = None, **engine_kw: Any):
@@ -265,7 +274,8 @@ class App:
         for path, handler in self._ws_routes:
             http_app.router.add_get(path, self._wrap_ws(handler))
         for route, directory in self._static:
-            http_app.router.add_static(route, directory)
+            http_app.router.add_get(
+                f"{route}/{{static_tail:.*}}", self._static_handler(directory))
         # catch-all 404 with the JSON envelope (gofr handler.go:95-119)
         http_app.router.add_route("*", "/{tail:.*}", self._not_found_handler)
         return http_app
@@ -461,6 +471,36 @@ class App:
     async def _not_found_handler(self, _request: web.Request) -> web.Response:
         return web.json_response({"error": {"message": "route not registered"}}, status=404)
 
+    def _static_handler(self, directory: str):
+        """Static file serving with the reference's hardening
+        (`http/router.go:62-82`): ``openapi.json`` must never be fetchable
+        through a static mount — the spec is served, access-controlled and
+        versioned, at ``/.well-known/openapi.json`` only — so a direct
+        download attempt gets 403; path traversal out of the mounted
+        directory gets 404 like any other absent file."""
+        import pathlib
+
+        base = pathlib.Path(directory).resolve()
+
+        async def handler(request: web.Request) -> web.StreamResponse:
+            tail = request.match_info.get("static_tail", "")
+            if pathlib.PurePosixPath(tail).name == "openapi.json":
+                return web.json_response(
+                    {"error": {"message": "openapi.json is not downloadable from "
+                                          "static routes; use /.well-known/openapi.json"}},
+                    status=403)
+            try:
+                target = (base / tail).resolve()
+            except (OSError, ValueError):
+                return await self._not_found_handler(request)
+            if base not in target.parents and target != base:
+                return await self._not_found_handler(request)
+            if not target.is_file():
+                return await self._not_found_handler(request)
+            return web.FileResponse(target)
+
+        return handler
+
     # -- profiling (SURVEY §5.1; reference http_server.go:53-60) ---------------
 
     def _debug_env(self) -> bool:
@@ -584,6 +624,12 @@ class App:
                 result = handler(ctx)
                 if inspect.iscoroutine(result):
                     raise TypeError("subscribe handlers must be synchronous (they run on a consumer thread)")
+                # chaos point "pubsub.commit": the crash-between-handler-
+                # and-commit window — the at-least-once contract's hard
+                # case (handler effects applied, offset not advanced, so
+                # the message is redelivered; fleet/chaos.py, tested in
+                # tests/test_pubsub_clients.py). Zero-cost when unarmed.
+                chaos_fire("pubsub.commit", topic=topic)
                 msg.commit()  # at-least-once: commit only on success (subscriber.go:54-56)
                 container.metrics.increment_counter("app_pubsub_subscribe_success_count", 1, topic=topic)
                 span.set_status("OK")

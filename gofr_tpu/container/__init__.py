@@ -119,6 +119,17 @@ class Container:
         m.new_histogram("app_tpu_prefix_swapin_bytes",
                         "bytes uploaded per host->device swap-in",
                         buckets=[2 ** 14, 2 ** 17, 2 ** 20, 2 ** 23, 2 ** 26, 2 ** 29])
+        # elastic fleet (gofr_tpu.fleet; docs/parallelism.md): epoch is the
+        # membership generation — it only moves when the fleet changes
+        m.new_gauge("app_fleet_epoch", "current fleet epoch (membership generation)")
+        m.new_gauge("app_fleet_followers", "followers active on the fleet announce channel")
+        m.new_counter("app_fleet_rejoins_total",
+                      "followers admitted at an epoch bump (leader side) / successful "
+                      "redials after leader loss (follower side)")
+        m.new_counter("app_fleet_followers_lost_total",
+                      "followers dropped from the announce fan-out mid-stream")
+        m.new_counter("app_fleet_supervisor_restarts_total",
+                      "fleet member processes restarted by fleet.Supervisor")
         m.new_counter("app_tpu_spec_proposed", "draft tokens proposed by speculative decoding")
         m.new_counter("app_tpu_spec_accepted", "draft tokens accepted by target verification")
         # SLO latency family (docs/observability.md): recorded by the engine
@@ -136,7 +147,7 @@ class Container:
         m.new_counter("app_qos_admitted_total", "requests admitted by QoS")
         m.new_counter("app_qos_rejected_total",
                       "requests rejected by QoS (reason: rate/route_rate/key_rate/"
-                      "tenant_rate/queue/deadline/capacity)")
+                      "tenant_rate/queue/deadline/capacity/restart)")
         m.new_counter("app_qos_shed_total", "requests shed under overload (503s)")
         m.new_gauge("app_qos_queue_depth", "queued requests per priority class")
         m.new_gauge("app_qos_predicted_wait_seconds",
@@ -222,6 +233,11 @@ class Container:
 
     def add_kv_store(self, client: Any) -> None:
         self.kv = self._wire_plugin(client)
+
+    def add_file_store(self, client: Any) -> None:
+        """Replace the default local filesystem with a remote-FS provider
+        (datasource/file.py ``FileSystemProvider``; gofr `file.go:69-78`)."""
+        self.file = self._wire_plugin(client)
 
     def _wire_plugin(self, client: Any) -> Any:
         if hasattr(client, "use_logger"):
@@ -354,6 +370,7 @@ class Container:
         check("redis", self.redis)
         check("pubsub", self.pubsub)
         check("kv", self.kv)
+        check("file", self.file)
         check("mongo", self.mongo)
         check("cassandra", self.cassandra)
         check("clickhouse", self.clickhouse)

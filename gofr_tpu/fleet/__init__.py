@@ -1,0 +1,91 @@
+"""Elastic fleet subsystem: N-process lockstep serving that survives
+member death (docs/parallelism.md "Fleet" section).
+
+Pieces:
+
+- :mod:`gofr_tpu.fleet.channel` — the host-side (DCN) announce transport:
+  followers dial the leader, frames carry the fleet epoch, membership
+  changes happen at step boundaries outside the compiled programs;
+- :mod:`gofr_tpu.fleet.supervisor` — the watchdog→restart→warm-rejoin
+  loop for one fleet process (exit-17 aware, windowed restart budget);
+- :mod:`gofr_tpu.fleet.chaos` — deterministic fault injection at named
+  points (``GOFR_CHAOS``), used by the failure-contract tests only and
+  zero-cost when unset.
+
+Config (docs/configs.md):
+
+    FLEET_LISTEN             leader: TCP port followers dial (role=leader)
+    FLEET_LEADER             follower: leader host:port (role=follower)
+    FLEET_FOLLOWERS          leader: follower count to wait for at bring-up
+    FLEET_EPOCH              starting epoch (a supervisor passes the
+                             process generation here so every life starts
+                             at a fresh epoch base)
+    FLEET_READY_TIMEOUT_S    leader bring-up wait for followers (default 60)
+    FLEET_CONNECT_TIMEOUT_S  follower initial dial window (default 60)
+    FLEET_REJOIN_S           follower redial window after leader loss
+                             (default 30; expiry = leader-lost, exit 17)
+
+The engine wires itself into a fleet when these keys are set
+(tpu/engine.py ``build_engine``); the collective (device-fabric) lockstep
+keeps its v1 group-fatal semantics and ignores this module entirely.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from gofr_tpu.fleet.channel import (
+    ChannelClosed,
+    CollectiveChannel,
+    FleetFollowerChannel,
+    FleetLeaderChannel,
+    FleetProtocolError,
+    fingerprint_of,
+)
+from gofr_tpu.fleet.supervisor import Supervisor
+
+__all__ = [
+    "ChannelClosed",
+    "CollectiveChannel",
+    "FleetConfig",
+    "FleetFollowerChannel",
+    "FleetLeaderChannel",
+    "FleetProtocolError",
+    "Supervisor",
+    "fingerprint_of",
+]
+
+
+@dataclass
+class FleetConfig:
+    """Resolved ``FLEET_*`` config for one process (None = not a fleet)."""
+
+    role: str                       # "leader" | "follower"
+    listen: int = 0                 # leader listen port (0 = ephemeral)
+    leader: str = ""                # follower: leader host:port
+    followers: int = 0              # leader: bring-up expectation
+    epoch: int = 0
+    ready_timeout_s: float = 60.0
+    connect_timeout_s: float = 60.0
+    rejoin_timeout_s: float = 30.0
+
+    @classmethod
+    def from_config(cls, conf) -> "FleetConfig | None":
+        listen = conf.get("FLEET_LISTEN")
+        leader = conf.get("FLEET_LEADER")
+        if not listen and not leader:
+            return None
+        if listen and leader:
+            raise ValueError(
+                "FLEET_LISTEN and FLEET_LEADER are mutually exclusive: a "
+                "process is the leader (listens) or a follower (dials)")
+        return cls(
+            role="leader" if listen else "follower",
+            listen=int(listen) if listen else 0,
+            leader=leader or "",
+            followers=conf.get_int("FLEET_FOLLOWERS", 0),
+            epoch=conf.get_int("FLEET_EPOCH", 0),
+            ready_timeout_s=conf.get_float("FLEET_READY_TIMEOUT_S", 60.0),
+            connect_timeout_s=conf.get_float("FLEET_CONNECT_TIMEOUT_S", 60.0),
+            rejoin_timeout_s=conf.get_float("FLEET_REJOIN_S", 30.0),
+        )

@@ -1,0 +1,423 @@
+"""Host-side (DCN) announce transport for N-process fleet lockstep.
+
+The collective transport in ``tpu/lockstep.py`` rides the device fabric:
+announces ARE collectives, so any process death wedges every peer inside
+an unfinishable collective and the only recovery is full group teardown
+(v1 semantics, preserved there). This module is the recoverable
+alternative: announces ride plain TCP, followers execute the announced
+programs on their own process-local mesh, and membership changes —
+leader restart, follower restart, follower loss — are handled OUTSIDE
+the compiled programs (GSPMD's rule for scaling SPMD past one process).
+
+Topology: followers DIAL the leader (the leader's listen port is the
+fleet's well-known endpoint, exactly like a coordinator). The handshake
+carries the engine-config fingerprint — a follower built from different
+config is rejected outright, never silently desynchronized — and every
+accepted follower parks in a *pending* set until the leader's device
+loop admits it at a step boundary with a ``TAG_EPOCH`` frame (the fleet
+epoch bump; ``tpu/lockstep.py`` docs the follower side).
+
+Wire format, little-endian, one frame per announce::
+
+    int32[4] header  (tag, a, b, epoch)
+    int32    nbytes  payload byte length (0 = header-only frame)
+    bytes    payload the packed int32 array, C order
+
+Failure semantics:
+
+- leader death (process kill or socket close) → follower ``recv`` raises
+  :class:`ChannelClosed`; the follower resets per-epoch state and redials
+  until ``rejoin_timeout_s`` (then it is leader-lost: exit 17 territory);
+- follower death → the leader's ``send`` to it fails; the follower is
+  dropped from the active set (counted, logged) and serving continues —
+  a restarted follower redials into *pending* and rejoins at the next
+  epoch bump;
+- partial frames (leader's device thread died mid-``send``) are resolved
+  by reconnection, never by in-band resync: a rejoining socket starts at
+  a frame boundary by construction.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+_MAGIC = b"GOFR-FLEET1\n"
+_HEADER = struct.Struct("<4i")
+_NBYTES = struct.Struct("<i")
+
+
+class ChannelClosed(Exception):
+    """The peer went away mid-stream (EOF, reset, or local abort). For
+    rejoin-capable channels this is the *recoverable* signal."""
+
+
+class FleetProtocolError(RuntimeError):
+    """Unrecoverable protocol violation (fingerprint mismatch, garbage
+    frame): the process must not keep serving."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError as e:
+            raise ChannelClosed(str(e)) from e
+        if not chunk:
+            raise ChannelClosed("peer closed the connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class FleetLeaderChannel:
+    """Leader end: listens for follower dials, fans every announce out to
+    the active follower set. ``send`` runs on the engine's device thread
+    only; the listener thread touches only the pending set."""
+
+    supports_rejoin = True
+
+    def __init__(self, port: int, *, fingerprint: str, host: str = "0.0.0.0",
+                 logger=None, metrics=None, bind_timeout_s: float = 5.0,
+                 send_timeout_s: float = 10.0):
+        self.fingerprint = fingerprint
+        self.send_timeout_s = send_timeout_s
+        self.logger = logger
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._active: list[socket.socket] = []
+        self._pending: list[socket.socket] = []
+        self._closed = False
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # bind retries: a supervisor-restarted leader rebinds the fleet's
+        # well-known port while the dead life's connections may still be
+        # draining out of the kernel — EADDRINUSE for a moment is part of
+        # the restart path, not an error
+        deadline = time.monotonic() + bind_timeout_s
+        while True:
+            try:
+                self._srv.bind((host, port))
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        self._srv.listen(64)
+        # the accept loop polls on a short timeout instead of blocking
+        # forever: a close() must be able to JOIN the thread before the fd
+        # is released — a thread still blocked in accept() on a closed fd
+        # would steal connections the moment the fd number is reused (e.g.
+        # by the next leader life's listener)
+        self._srv.settimeout(0.25)
+        self.port = self._srv.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fleet-accept", daemon=True)
+        self._accept_thread.start()
+
+    # -- listener thread -------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, addr = self._srv.accept()
+            except socket.timeout:
+                continue  # poll tick: re-check _closed
+            except OSError:
+                return  # listener closed
+            try:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                conn.settimeout(10.0)
+                join = _recv_exact(conn, len(_MAGIC))
+                if join != _MAGIC:
+                    raise FleetProtocolError(f"bad join magic from {addr}")
+                (flen,) = _NBYTES.unpack(_recv_exact(conn, _NBYTES.size))
+                fp = _recv_exact(conn, min(max(flen, 0), 4096)).decode()
+                if fp != self.fingerprint:
+                    # config mismatch is FATAL for the joiner, not for us:
+                    # a follower built from different config would replay
+                    # our programs against different state and silently
+                    # diverge — reject it at the door (tag -1).
+                    conn.sendall(_HEADER.pack(-1, 0, 0, 0) + _NBYTES.pack(0))
+                    conn.close()
+                    if self.logger is not None:
+                        self.logger.warn(
+                            f"fleet: rejected follower {addr}: config "
+                            f"fingerprint {fp!r} != leader {self.fingerprint!r}")
+                    continue
+                # finite SEND timeout for the serving phase: a stalled-but-
+                # alive follower (SIGSTOP, livelock — socket open, never
+                # reading) would otherwise wedge the leader's device thread
+                # in sendall once the kernel buffers fill, stalling the
+                # whole fleet. socket.timeout is an OSError, so send()'s
+                # drop-the-follower path handles slow exactly like dead;
+                # the torn frame is resolved by reconnection as usual.
+                conn.settimeout(self.send_timeout_s)
+            except (ChannelClosed, FleetProtocolError, OSError) as e:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                if self.logger is not None:
+                    self.logger.warn(f"fleet: follower join from {addr} failed: {e}")
+                continue
+            with self._lock:
+                self._pending.append(conn)
+            if self.logger is not None:
+                self.logger.info(f"fleet: follower {addr} joined (pending admission)")
+
+    # -- device-thread API -----------------------------------------------------
+
+    def has_pending(self) -> bool:
+        with self._lock:
+            return bool(self._pending)
+
+    def admit_pending(self, epoch: int) -> int:
+        """Move pending followers into the active set and frame the new
+        epoch to EVERYONE (TAG_EPOCH; rejoiners and survivors alike reset
+        per-epoch state on it). Device thread only, at a step boundary —
+        the caller has already reset its own per-epoch engine state."""
+        with self._lock:
+            fresh, self._pending = self._pending, []
+            self._active.extend(fresh)
+        from gofr_tpu.tpu.lockstep import TAG_EPOCH
+
+        self.send(np.array([TAG_EPOCH, 0, 0, epoch], np.int32), None)
+        return len(fresh)
+
+    def wait_ready(self, expect: int, epoch: int, timeout_s: float) -> int:
+        """Initial bring-up: block until ``expect`` followers joined, then
+        admit them at the starting epoch. Raises on timeout — a fleet
+        configured for N followers must not silently serve with fewer."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                n = len(self._pending) + len(self._active)
+            if n >= expect:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"fleet: only {n}/{expect} followers joined within {timeout_s:.0f}s")
+            time.sleep(0.02)
+        self.admit_pending(epoch)
+        return expect
+
+    def send(self, header: np.ndarray, payload: np.ndarray | None) -> None:
+        """Fan one frame out to every active follower. A failing follower
+        is dropped (counted + logged) and serving continues — its
+        supervisor restarts it into the pending set."""
+        data = _HEADER.pack(*(int(x) for x in header))
+        if payload is None:
+            data += _NBYTES.pack(0)
+        else:
+            raw = np.ascontiguousarray(payload, np.int32).tobytes()
+            data += _NBYTES.pack(len(raw)) + raw
+        with self._lock:
+            conns = list(self._active)
+        lost = []
+        for conn in conns:
+            try:
+                conn.sendall(data)
+            except OSError as e:
+                lost.append(conn)
+                if self.logger is not None:
+                    self.logger.warn(f"fleet: follower lost mid-stream: {e}")
+        if lost:
+            with self._lock:
+                for conn in lost:
+                    if conn in self._active:
+                        self._active.remove(conn)
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                remaining = len(self._active)
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_fleet_followers_lost_total", len(lost))
+                # keep the active-follower gauge truthful between epoch
+                # bumps: a for-good loss never reaches _fleet_admit
+                self.metrics.set_gauge("app_fleet_followers", remaining)
+
+    def follower_count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    def reset_connections(self) -> None:
+        """Close every active follower socket (leader device-loop restart:
+        a mid-``send`` crash may have left partial frames on the wire, and
+        reconnection is the only framing resync). Followers see EOF, reset
+        per-epoch state, and redial into pending."""
+        with self._lock:
+            conns, self._active = self._active, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        # join BEFORE closing the fd (see the settimeout note in __init__)
+        self._accept_thread.join(timeout=2.0)
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = self._active + self._pending
+            self._active, self._pending = [], []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class FleetFollowerChannel:
+    """Follower end: dials the leader, receives frames. ``recv_header``/
+    ``recv_payload`` run on the follower's replay thread; ``abort()`` is
+    the thread-safe poke that releases a blocked recv (liveness watchdog)."""
+
+    supports_rejoin = True
+
+    def __init__(self, leader: str, *, fingerprint: str,
+                 connect_timeout_s: float = 60.0, rejoin_timeout_s: float = 30.0,
+                 logger=None):
+        host, _, port = leader.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.fingerprint = fingerprint
+        self.connect_timeout_s = connect_timeout_s
+        self.rejoin_timeout_s = rejoin_timeout_s
+        self.logger = logger
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._pending_nbytes = 0
+
+    def _dial(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        delay = 0.05
+        while True:
+            try:
+                sock = socket.create_connection(self.addr, timeout=5.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                fp = self.fingerprint.encode()
+                sock.sendall(_MAGIC + _NBYTES.pack(len(fp)) + fp)
+                sock.settimeout(None)
+                with self._lock:
+                    self._sock = sock
+                return
+            except OSError as e:
+                if time.monotonic() > deadline:
+                    raise ChannelClosed(
+                        f"fleet: no leader at {self.addr} within {timeout_s:.0f}s: {e}"
+                    ) from e
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+    def connect(self) -> None:
+        self._dial(self.connect_timeout_s)
+
+    def rejoin(self) -> None:
+        """Leader went away: drop the dead socket and redial until the
+        rejoin deadline (a restarted leader with the same config accepts
+        the same fingerprint). Raises ChannelClosed when the deadline
+        expires — the caller maps that to leader-lost (exit 17)."""
+        self.abort()
+        self._pending_nbytes = 0
+        self._dial(self.rejoin_timeout_s)
+
+    def recv_header(self) -> np.ndarray:
+        sock = self._sock
+        if sock is None:
+            raise ChannelClosed("not connected")
+        raw = _recv_exact(sock, _HEADER.size)
+        header = np.frombuffer(raw, np.int32).copy()
+        if int(header[0]) == -1:
+            raise FleetProtocolError(
+                "fleet: leader rejected this follower (engine config "
+                "fingerprint mismatch — rebuild with the leader's config)")
+        (self._pending_nbytes,) = _NBYTES.unpack(_recv_exact(sock, _NBYTES.size))
+        return header
+
+    def recv_payload(self, shape: tuple[int, ...]) -> np.ndarray:
+        sock = self._sock  # abort() can null it between header and payload
+        if sock is None:
+            raise ChannelClosed("not connected")
+        n = self._pending_nbytes
+        self._pending_nbytes = 0
+        want = int(np.prod(shape)) * 4
+        if n != want:
+            raise FleetProtocolError(
+                f"fleet: payload size {n} != expected {want} for shape {shape}")
+        raw = _recv_exact(sock, n)
+        return np.frombuffer(raw, np.int32).reshape(shape).copy()
+
+    def abort(self) -> None:
+        """Thread-safe close releasing any blocked recv with ChannelClosed
+        (the liveness watchdog's lever — silence past the deadline is
+        treated exactly like leader death: reset and redial)."""
+        with self._lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self.abort()
+
+
+class CollectiveChannel:
+    """The device-fabric transport (``multihost_utils.broadcast_one_to_all``)
+    wrapped in the channel interface — the v1 lockstep data plane for
+    global-mesh (ICI-sharded) deployments. No rejoin: an announce IS a
+    collective, so membership is fixed for the group's lifetime and any
+    process death is group-fatal (tpu/lockstep.py module docs)."""
+
+    supports_rejoin = False
+
+    @staticmethod
+    def _broadcast(value):
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(value)
+
+    def send(self, header: np.ndarray, payload: np.ndarray | None) -> None:
+        self._broadcast(np.asarray(header, np.int32))
+        if payload is not None:
+            self._broadcast(np.asarray(payload, np.int32))
+
+    def recv_header(self) -> np.ndarray:
+        from gofr_tpu.tpu.lockstep import _HEADER_LEN
+
+        return np.asarray(self._broadcast(np.zeros(_HEADER_LEN, np.int32)))
+
+    def recv_payload(self, shape: tuple[int, ...]) -> np.ndarray:
+        return np.asarray(self._broadcast(np.zeros(shape, np.int32)))
+
+    def close(self) -> None:
+        pass
+
+
+def fingerprint_of(*parts: Any) -> str:
+    """Stable config fingerprint: a fleet only forms between processes
+    whose engines were built identically (same model config, seed, slot
+    geometry, layout...). 16 hex chars of sha256 over the reprs."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(repr(p).encode())
+        h.update(b"\x00")
+    return h.hexdigest()[:16]

@@ -1,0 +1,213 @@
+"""Deterministic chaos injection (docs/testing.md chaos-point catalog).
+
+Production code declares *named fault points* — ``engine.step``,
+``engine.restart``, ``lockstep.announce``, ``pubsub.commit`` — and the
+fault that fires there is injected from the outside via the ``GOFR_CHAOS``
+environment variable (or :func:`override` inside a test process). This is
+how the app-tier failure contracts are *proven* rather than asserted:
+the same binary that serves traffic can be told "kill the device loop on
+its 5th step" and the test observes the recovery path.
+
+Spec grammar (``;``-separated points)::
+
+    GOFR_CHAOS="engine.step:raise,nth=5;lockstep.announce:delay,ms=50,every=3"
+
+    point   dotted fault-point name (the catalog lives in docs/testing.md)
+    action  raise        raise ChaosFault at the point (crash that code path)
+            exit         hard-exit the process (code=N, default 1)
+            drop         return True — the call site discards the operation
+            delay        sleep ms=N milliseconds, then continue
+            hold         block until file=PATH exists (timeout=N seconds,
+                         default 30) — the deterministic latch tests use to
+                         pin a window open (no sleeps-as-synchronization)
+    gates   nth=N        fire on the Nth hit of this point only
+            every=N      fire on every Nth hit
+            after=N      fire on every hit once more than N hits happened
+            at_step=N    fire ONCE, the first time the call site's
+                         ``step=`` context reaches N — gating on engine
+                         state (the device-step counter) instead of hit
+                         counts, so "kill the device loop mid-generation"
+                         is exact under any loop-iteration timing
+            p=F          fire with probability F — SEEDED per point from
+                         GOFR_CHAOS_SEED, so a given seed replays the same
+                         fault schedule every run
+            (no gate)    fire on every hit
+
+Determinism: gating is by per-point hit COUNTERS (and a seeded PRNG for
+``p=``), never by wall clock, so a fault schedule is a pure function of
+the spec + seed + call sequence.
+
+Zero cost when off: ``hook(point)`` returns ``None`` unless a spec
+targets the point — call sites bind it once and pay a single branch
+(the ``Tracer.enabled`` discipline); ``fire(point)`` short-circuits on an
+empty table for call sites that can't pre-bind.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Any
+
+
+class ChaosFault(RuntimeError):
+    """The injected failure (action ``raise``). Deliberately a RuntimeError:
+    fault points sit on paths whose real faults are runtime errors, and the
+    recovery machinery under test must not special-case chaos."""
+
+
+class ChaosPoint:
+    """One armed fault point. Calling it applies the gate and, when it
+    fires, performs the action; returns True when the call site should
+    DROP the guarded operation (action ``drop``)."""
+
+    def __init__(self, name: str, action: str, params: dict[str, str], seed: int):
+        self.name = name
+        self.action = action
+        self.params = params
+        self._hits = 0
+        self._fired = False
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed ^ zlib.crc32(name.encode())) \
+            if "p" in params else None
+
+    def _gate(self, ctx: dict[str, Any]) -> bool:
+        with self._lock:
+            self._hits += 1
+            hits = self._hits
+        at_step = self.params.get("at_step")
+        if at_step is not None:
+            with self._lock:
+                if self._fired or int(ctx.get("step", -1)) < int(at_step):
+                    return False
+                self._fired = True
+                return True
+        nth = self.params.get("nth")
+        if nth is not None:
+            return hits == int(nth)
+        every = self.params.get("every")
+        if every is not None:
+            return hits % int(every) == 0
+        after = self.params.get("after")
+        if after is not None:
+            return hits > int(after)
+        p = self.params.get("p")
+        if p is not None:
+            with self._lock:  # PRNG state is shared mutable state
+                return self._rng.random() < float(p)
+        return True
+
+    def __call__(self, **ctx: Any) -> bool:
+        if not self._gate(ctx):
+            return False
+        if self.action == "drop":
+            return True
+        if self.action == "delay":
+            time.sleep(float(self.params.get("ms", "10")) / 1000.0)
+            return False
+        if self.action == "hold":
+            path = self.params.get("file", "")
+            deadline = time.monotonic() + float(self.params.get("timeout", "30"))
+            while path and not os.path.exists(path):
+                if time.monotonic() > deadline:
+                    break
+                time.sleep(0.01)
+            return False
+        if self.action == "exit":
+            os._exit(int(self.params.get("code", "1")))
+        raise ChaosFault(
+            f"chaos: injected fault at {self.name!r} "
+            f"(hit {self._hits}, ctx {ctx or '{}'})"
+        )
+
+
+_TABLE: dict[str, ChaosPoint] | None = None  # None = env not parsed yet
+_TABLE_LOCK = threading.Lock()
+
+
+def _parse(spec: str, seed: int) -> dict[str, ChaosPoint]:
+    table: dict[str, ChaosPoint] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, rest = part.partition(":")
+        bits = [b.strip() for b in rest.split(",")] if rest else []
+        action = bits[0] if bits and "=" not in bits[0] else "raise"
+        params: dict[str, str] = {}
+        for b in bits:
+            if "=" in b:
+                k, _, v = b.partition("=")
+                params[k.strip()] = v.strip()
+        table[point.strip()] = ChaosPoint(point.strip(), action, params, seed)
+    return table
+
+
+def _table() -> dict[str, ChaosPoint]:
+    global _TABLE
+    if _TABLE is None:
+        with _TABLE_LOCK:
+            if _TABLE is None:
+                spec = os.environ.get("GOFR_CHAOS", "")
+                seed = int(os.environ.get("GOFR_CHAOS_SEED", "0"))
+                _TABLE = _parse(spec, seed) if spec else {}
+    return _TABLE
+
+
+def active() -> bool:
+    return bool(_table())
+
+
+def hook(point: str) -> ChaosPoint | None:
+    """The armed ChaosPoint for ``point``, or None (the common case) —
+    bind at construction time and guard with one truthiness branch."""
+    return _table().get(point)
+
+
+def fire(point: str, **ctx: Any) -> bool:
+    """Dynamic-lookup spelling of :func:`hook` for call sites that cannot
+    pre-bind (e.g. the subscriber loop, where tests install an override
+    after the app object exists). True = drop the guarded operation."""
+    table = _table()
+    if not table:
+        return False
+    p = table.get(point)
+    return p(**ctx) if p is not None else False
+
+
+class override:
+    """Context manager installing a chaos spec for in-process tests::
+
+        with chaos.override("pubsub.commit:raise,nth=1"):
+            ...
+
+    Counters start fresh on entry; the previous table (usually empty) is
+    restored on exit."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self._prev: dict[str, ChaosPoint] | None = None
+
+    def __enter__(self) -> "override":
+        global _TABLE
+        with _TABLE_LOCK:
+            self._prev = _TABLE
+            _TABLE = _parse(self.spec, self.seed)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _TABLE
+        with _TABLE_LOCK:
+            _TABLE = self._prev
+
+
+def reset() -> None:
+    """Forget the parsed table so the next use re-reads GOFR_CHAOS (tests
+    that mutate the environment)."""
+    global _TABLE
+    with _TABLE_LOCK:
+        _TABLE = None

@@ -1,0 +1,122 @@
+"""Process supervision for fleet members: the VERDICT #4 runbook as code.
+
+A fleet process dies in one of three recognizable ways:
+
+- **exit 0** — clean stop (leader announced STOP, follower drained): done;
+- **exit 17** (``LOCKSTEP_EXIT_CODE``) — the follower's liveness watchdog
+  declared the leader dead after the rejoin deadline. The right response
+  is a *restart into rejoin-wait*: the fresh follower redials the
+  leader's endpoint and joins the next epoch (fleet/channel.py);
+- **any other code / signal** — a crash (device fault, OOM, kill -9).
+  Restart with the same config; the channel handshake plus the epoch
+  bump make the rejoin safe without state transfer (weights re-init from
+  the same seed; the announce channel is the only state that matters).
+
+The restart budget is windowed like the engine's device-loop budget:
+crashes further apart than ``window_s`` don't count against it — the
+give-up exists for crash LOOPS, not lifetime fault totals. Each respawn
+passes the new generation number to ``spawn`` so the process can derive
+its base fleet epoch (``FLEET_EPOCH``) and logs can correlate lives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+# == tpu.lockstep.LOCKSTEP_EXIT_CODE; literal here because lockstep imports
+# the fleet package (chaos hooks) and this module must stay import-light
+LOCKSTEP_EXIT_CODE = 17
+
+
+class Supervisor:
+    """Supervise ONE fleet process (leader or follower).
+
+    ``spawn(generation) -> Popen-like`` starts the process; the returned
+    object needs ``wait(timeout)``/``poll()``/``returncode`` and
+    ``terminate()``/``kill()`` (subprocess.Popen satisfies all of it).
+    ``run()`` blocks until the process exits cleanly, the budget is
+    exhausted, or ``stop()`` is called; it returns the last exit code.
+    """
+
+    def __init__(self, spawn: Callable[[int], Any], *, name: str = "fleet-proc",
+                 max_restarts: int = 3, window_s: float = 300.0,
+                 backoff_s: float = 0.5, backoff_cap_s: float = 10.0,
+                 restart_on: Callable[[int], bool] | None = None,
+                 logger=None, metrics=None):
+        self.spawn = spawn
+        self.name = name
+        self.max_restarts = max_restarts
+        self.window_s = window_s
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self.restart_on = restart_on or (lambda rc: rc != 0)
+        self.logger = logger
+        self.metrics = metrics
+        self.generation = 0
+        self.restarts = 0
+        self.proc: Any = None
+        self._stop = threading.Event()
+        self._last_crash_at = 0.0
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        if self.logger is not None:
+            self.logger.warn(f"supervisor[{self.name}]: {msg}")
+
+    def run(self) -> int:
+        """The watchdog→restart→warm-rejoin loop. Returns the supervised
+        process's final exit code (0 = clean stop)."""
+        self.proc = self.spawn(self.generation)
+        while True:
+            while self.proc.poll() is None:
+                if self._stop.wait(0.05):
+                    self._log("stop requested; terminating child")
+                    self.proc.terminate()
+                    try:
+                        self.proc.wait(timeout=10)
+                    except Exception:  # noqa: BLE001 - unkillable child
+                        self.proc.kill()
+                        self.proc.wait()
+                    return int(self.proc.returncode or 0)
+            rc = int(self.proc.returncode)
+            if rc == 0:
+                self._log(f"generation {self.generation} exited cleanly")
+                return 0
+            if not self.restart_on(rc):
+                self._log(f"generation {self.generation} exited {rc}; policy says no restart")
+                return rc
+            now = time.monotonic()
+            if now - self._last_crash_at > self.window_s:
+                self.restarts = 0  # isolated fault, not a crash loop
+            self._last_crash_at = now
+            if self.restarts >= self.max_restarts:
+                self._log(
+                    f"generation {self.generation} exited {rc}; restart budget "
+                    f"({self.max_restarts} within {self.window_s:.0f}s) exhausted — giving up")
+                return rc
+            self.restarts += 1
+            why = ("liveness watchdog: leader presumed dead — restarting into rejoin-wait"
+                   if rc == LOCKSTEP_EXIT_CODE else f"crash (exit {rc})")
+            delay = min(self.backoff_s * (2 ** (self.restarts - 1)), self.backoff_cap_s)
+            self._log(
+                f"generation {self.generation} died: {why}; restart "
+                f"{self.restarts}/{self.max_restarts} in {delay:.2f}s")
+            if self._stop.wait(delay):
+                return rc
+            if self.metrics is not None:
+                self.metrics.increment_counter("app_fleet_supervisor_restarts_total", 1)
+            self.generation += 1
+            self.proc = self.spawn(self.generation)
+
+    def start(self) -> threading.Thread:
+        """Run the supervision loop on a daemon thread (the in-app shape);
+        the returned thread's liveness is the fleet member's liveness."""
+        t = threading.Thread(target=self.run, name=f"supervisor-{self.name}", daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -6,9 +6,10 @@ transport-neutral Request interface so subscribe handlers look identical to
 HTTP handlers (`message.go:13-103`), at-least-once commit semantics, and the
 container's backend-by-config switch (`container/container.go:95-122`).
 
-Backends: ``inmemory`` (in-tree, also the test double), ``kafka``/``gcp``/
-``mqtt`` engage only when their client libraries are importable — otherwise the
-container warns and leaves pub/sub unwired.
+Backends: ``inmemory`` (in-tree, also the test double), ``file`` (in-tree,
+cross-PROCESS coordination over a shared directory — pubsub/file.py),
+``kafka``/``gcp``/``mqtt`` engage only when their client libraries are
+importable — otherwise the container warns and leaves pub/sub unwired.
 """
 
 from __future__ import annotations
@@ -101,6 +102,12 @@ def connect_pubsub(backend: str, config, logger, metrics):
 
         logger.info("using in-memory pubsub broker")
         return InMemoryBroker()
+    if backend == "file":
+        from gofr_tpu.pubsub.file import FileBroker
+
+        directory = config.get_or_default("PUBSUB_DIR", "./pubsub-data")
+        logger.infof("using file pubsub broker under %s", directory)
+        return FileBroker(directory)
     if backend == "kafka":
         try:
             import kafka  # type: ignore[import-not-found]  # noqa: F401

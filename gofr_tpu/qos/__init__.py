@@ -281,6 +281,16 @@ class AdmissionController:
         timing out later. Returns the resolved class (capacity acquired;
         released by the request's done callback via ``track``)."""
         cls = self.policy.resolve(cls_name)
+        if getattr(engine, "_restarting", False):
+            # shed-during-restart: the device loop is inside its crash-
+            # recovery backoff window — new work would only deepen the
+            # backlog the restarted loop must drain (queued work already
+            # there survives the restart; docs/qos.md)
+            wait = self._ewma_step or 1.0
+            self._reject(cls, "restart", 503, wait)
+            raise ServiceUnavailable(
+                "engine restarting after a device fault; retry later",
+                retry_after=wait)
         if self.policy.max_queue and engine._backlog() >= self.policy.max_queue:
             wait = self.predicted_wait(engine) or 1.0
             self._reject(cls, "queue", 503, wait)
@@ -323,7 +333,7 @@ class AdmissionController:
                 retry_after: float) -> None:
         self.metrics.increment_counter("app_qos_rejected_total", 1,
                                        reason=reason, qos_class=cls.name)
-        if reason in ("queue", "deadline", "capacity"):
+        if reason in ("queue", "deadline", "capacity", "restart"):
             # overload-driven (we turned away feasible work because of
             # load), as opposed to a client exceeding its rate budget —
             # this is what flips health to DEGRADED for the shed window

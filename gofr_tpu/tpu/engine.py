@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gofr_tpu.fleet import chaos
 from gofr_tpu.http.errors import RequestTimeout
 from gofr_tpu.qos.scheduler import QoSQueue
 from gofr_tpu.tracing import RequestTrace, current_span
@@ -212,6 +213,13 @@ class _EngineBase:
         # budget — the give-up is for crash LOOPS, not lifetime fault totals
         self.restart_window_s = 60.0
         self._last_crash_at = 0.0
+        # chaos fault points (fleet/chaos.py; None — one branch — unless a
+        # GOFR_CHAOS spec arms them): "engine.step" fires at the top of
+        # every device-loop iteration, "engine.restart" inside the restart
+        # backoff window (the deterministic latch the DEGRADED-window
+        # contract tests pin open)
+        self._chaos_step = chaos.hook("engine.step")
+        self._chaos_restart = chaos.hook("engine.restart")
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -322,6 +330,24 @@ class _EngineBase:
                 self._restarts += 1
                 self.metrics.increment_counter("app_tpu_engine_restarts", 1)
                 self._restarting = True
+                try:
+                    ls = getattr(self, "_ls", None)
+                    if ls is not None:
+                        # rejoin-capable fleet leader (a collective-transport
+                        # leader never reaches here: max_restarts is 0): the
+                        # crash may have cut an announce mid-frame, so drop
+                        # every follower connection — each redials into the
+                        # pending set and the restarted loop admits them all
+                        # at a bumped epoch (_fleet_admit)
+                        ls.reset_connections()
+                    if self._chaos_restart is not None:
+                        self._chaos_restart(attempt=self._restarts)
+                except Exception as e2:  # noqa: BLE001
+                    # an exception ESCAPING this handler would kill the
+                    # device thread without _fail_all — every queued caller
+                    # would hang to its timeout. Restart-path faults must
+                    # never outrank the restart itself.
+                    self.logger.log_exception(e2, "engine restart path")
                 time.sleep(min(0.1 * (2 ** self._restarts), 5.0))
                 self._restarting = False
                 self.logger.warn(
@@ -746,6 +772,7 @@ class GenerateEngine(_EngineBase):
         prefill_attn_fn: Any = None,
         prefill_attn_divisor: int = 1,
         lockstep_role: str | None = None,
+        fleet: Any = None,
         spec_draft: tuple | None = None,
         pipeline_depth: int | None = None,
     ):
@@ -1025,28 +1052,30 @@ class GenerateEngine(_EngineBase):
             self.cache = self._build_slot_cache()
             self._prefix = None  # prefix caching needs the paged layout
         # multi-host lockstep (tpu/lockstep.py): the leader announces every
-        # device call so follower processes issue the same global programs
+        # device call so follower processes issue the same global programs.
+        # ``fleet`` (a fleet.FleetConfig) switches the announce transport to
+        # the host-side channel (fleet/channel.py): membership becomes
+        # elastic (epoch-based rejoin) and the device-loop restart budget
+        # stays available — a leader restart is an epoch bump, not fleet
+        # death. Without it the collective transport's v1 semantics hold:
+        # a crash-RESTART would reset step/carry state on the leader only,
+        # desynchronizing followers — never restart in collective lockstep.
         self.lockstep_role = lockstep_role
         self._ls = None
-        if lockstep_role:
-            # a crash-RESTART would reset step/carry state on the leader
-            # only, desynchronizing followers — never restart in lockstep
+        self._fleet = fleet
+        self._seed = seed
+        if lockstep_role and fleet is None:
             self.max_restarts = 0
-        if lockstep_role == "leader":
-            from gofr_tpu.tpu.lockstep import LockstepLeader
-
-            self._ls = LockstepLeader()
         # follower liveness deadline (lockstep.py): leader heartbeats at a
         # third of it so watchdogs only fire on true leader death
         deadline = container.config.get_float("LOCKSTEP_DEADLINE_S", 0.0)
         self._hb_interval = deadline / 3 if deadline > 0 else 0.0
         if lockstep_role:
             # the cache is created process-locally; a multi-host global
-            # program needs it placed as a GLOBAL (replicated) array
-            from jax.sharding import NamedSharding, PartitionSpec as _P
-
-            self.cache = jax.device_put(
-                self.cache, NamedSharding(self.tpu.mesh, _P()))
+            # program needs it placed as a GLOBAL (replicated) array (on a
+            # fleet's process-local mesh the same placement replicates it
+            # across the local devices)
+            self.cache = self._place_cache(self.cache)
         self.slots: list[_Slot | None] = [None] * slots
         # Lane sets, maintained INCREMENTALLY at claim/free/stage-transition
         # time: the device loop consults free/decoding/prefilling lanes
@@ -1058,12 +1087,14 @@ class GenerateEngine(_EngineBase):
         self._decode_lanes: set[int] = set()
         self._prefill_lanes: set[int] = set()
         # Reusable packed staging buffers keyed by (kind, shape): a steady-
-        # state step re-zeroes one preallocated int32 buffer per signature
-        # instead of paying an np.zeros allocation per device call. Safe to
-        # reuse because jnp.asarray/broadcast copy the host buffer before
-        # the dispatching call returns, and all packing runs on the device
-        # thread. The population is bounded like _compiled (bucket ladder).
-        self._staging_bufs: dict[tuple, np.ndarray] = {}
+        # state step re-zeroes a preallocated int32 buffer per signature
+        # instead of paying an np.zeros allocation per device call. Buffers
+        # rotate through a ring (STAGING_RING; see _staging) because the
+        # per-replica host→device fetch of a dispatched call is async —
+        # immediate reuse could be rewritten under a lagging replica. All
+        # packing runs on the device thread; the population is bounded
+        # like _compiled (bucket ladder).
+        self._staging_bufs: dict[tuple, tuple] = {}
         self._pending: list[tuple[Request, np.ndarray]] = []
         # prompts longer than the largest prefill bucket: admitted one at a
         # time and streamed into the cache chunk-by-chunk. Paged always
@@ -1099,6 +1130,32 @@ class GenerateEngine(_EngineBase):
         self._decode_chunk = progs.decode_chunk
         if progs.spec_chunk is not None:
             self._spec_chunk_fn = progs.spec_chunk
+
+        # lockstep announcer, last: a fleet LEADER starts listening here
+        # and blocks until FLEET_FOLLOWERS identical-fingerprint followers
+        # dialed in — the whole engine must exist first (the fingerprint
+        # covers the resolved geometry, and admitted followers immediately
+        # receive whatever warmup()/the device loop announces next)
+        if lockstep_role == "leader":
+            from gofr_tpu.tpu.lockstep import LockstepLeader
+
+            if fleet is not None:
+                from gofr_tpu.fleet import FleetLeaderChannel
+
+                ch = FleetLeaderChannel(
+                    fleet.listen, fingerprint=self.fleet_fingerprint(),
+                    logger=self.logger, metrics=self.metrics)
+                self._ls = LockstepLeader(channel=ch, epoch=fleet.epoch)
+                self.metrics.set_gauge("app_fleet_epoch", self._ls.epoch)
+                if fleet.followers:
+                    self._ls.wait_ready(fleet.followers, fleet.ready_timeout_s)
+                    self.metrics.set_gauge(
+                        "app_fleet_followers", self._ls.follower_count())
+                    self.logger.infof(
+                        "fleet leader ready: %d follower(s) at epoch %d (port %d)",
+                        self._ls.follower_count(), self._ls.epoch, ch.port)
+            else:
+                self._ls = LockstepLeader()
 
     # -- public API ------------------------------------------------------------
 
@@ -1301,12 +1358,32 @@ class GenerateEngine(_EngineBase):
         tpu/lockstep.py): blocks executing the leader's announced programs
         until the leader stops. Do not call start(). With
         LOCKSTEP_DEADLINE_S set, a liveness watchdog hard-exits this
-        process if the leader goes silent (kill -9/OOM — lockstep.py)."""
+        process if the leader goes silent (kill -9/OOM — lockstep.py).
+
+        Under a fleet config (FLEET_LEADER) the announce stream rides the
+        host-side channel instead of the device collective: this dials the
+        leader (retrying for FLEET_CONNECT_TIMEOUT_S), replays its epochs,
+        and on leader loss REDIALS for FLEET_REJOIN_S before declaring the
+        leader dead — the epoch-based warm rejoin (docs/parallelism.md)."""
         if self.lockstep_role != "follower":
             raise RuntimeError("engine was not built with lockstep_role='follower'")
         from gofr_tpu.tpu.lockstep import LockstepFollower
 
         deadline = self.container.config.get_float("LOCKSTEP_DEADLINE_S", 0.0)
+        if self._fleet is not None:
+            from gofr_tpu.fleet import FleetFollowerChannel
+
+            channel = FleetFollowerChannel(
+                self._fleet.leader, fingerprint=self.fleet_fingerprint(),
+                connect_timeout_s=self._fleet.connect_timeout_s,
+                rejoin_timeout_s=self._fleet.rejoin_timeout_s,
+                logger=self.logger)
+            channel.connect()
+            try:
+                LockstepFollower(self, deadline_s=deadline, channel=channel).run()
+            finally:
+                channel.close()
+            return
         LockstepFollower(self, deadline_s=deadline).run()
 
     # -- device loop -----------------------------------------------------------
@@ -1339,12 +1416,66 @@ class GenerateEngine(_EngineBase):
                 if s is not None:
                     self._free_slot(i)
                     s.request.complete(error=error)
-            # The crashed call may have DONATED the cache buffer before
-            # dying — self.cache can reference a deleted array, and every
-            # post-restart step would fail on it, burning the whole restart
-            # budget on one fault. Rebuild it (all slots are empty now).
+        # The crashed call may have DONATED the cache buffer before
+        # dying — self.cache can reference a deleted array, and every
+        # post-restart step would fail on it, burning the whole restart
+        # budget on one fault. Rebuild it (all slots are empty now);
+        # _reset_device_state first SETTLES still-executing dispatches, so
+        # the rebuild cannot reuse memory a stale program is writing into.
+        self._reset_device_state()
+
+    def _drain_device_state(self) -> None:
+        """Settle every possibly-still-executing device computation of the
+        dying epoch BEFORE its buffers are dropped. A host-side crash (or
+        an epoch bump) can leave dispatched calls running: rebinding
+        ``self.cache``/clearing ``_dq`` frees their output buffers, and the
+        allocator may hand that memory to the NEXT epoch's fresh cache
+        while the stale program is still writing into it — scribbling the
+        new state (observed as deterministic-under-load token corruption in
+        the fleet chaos drill). Blocking here bounds recovery by the last
+        step's runtime. A crashed program raising out of the wait is
+        expected — its buffers are settled either way. NEVER call this
+        holding the state lock: a truly wedged program would then deadlock
+        ``stop()``'s ``_fail_all`` behind the lock forever (the wedged path
+        must stay poison-and-abandon, lockstep.py semantics)."""
+        for entry in list(self._dq):
+            try:
+                jax.block_until_ready(entry[1])
+            except Exception:  # noqa: BLE001 - crashed call: settled anyway
+                pass
+        self._dq.clear()
+        for ref in (self.cache, self._prev_last, self._spec_carry):
+            if ref is not None:
+                try:
+                    jax.block_until_ready(ref)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _place_cache(self, cache):
+        """Cache placement shared by the ctor and every rebuild site: under
+        lockstep the (process-local) cache must be placed as a replicated
+        GLOBAL array on the engine's mesh, or the first rebuilt-cache
+        program would re-place it differently from the other processes."""
+        if not self.lockstep_role:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        return jax.device_put(cache, NamedSharding(self.tpu.mesh, _P()))
+
+    def _reset_device_state(self) -> None:
+        """Reset every piece of per-epoch device state to its virgin value:
+        fresh cache (the crashed call may have donated the old buffer; a
+        fleet epoch bump needs leader and followers on identical state),
+        empty page pool/tables, no decode or spec carries. Slots must
+        already be empty (failed by _crash_recover or requeued by
+        _fleet_admit); weights and compiled programs are untouched — this
+        is the warm part of warm-rejoin. Safe on followers (their slot
+        bookkeeping is never populated) and re-entrant under the state
+        lock."""
+        self._drain_device_state()  # before the lock — see its docstring
+        with self._state_lock:
             if self.kv_layout == "paged":
-                self.cache = self._build_paged_cache()
+                self.cache = self._place_cache(self._build_paged_cache())
                 self._free_pages = list(range(self._page_sink, self.total_pages))
                 self._slot_pages = [[] for _ in range(self.num_slots)]
                 self._table = np.full(
@@ -1354,15 +1485,67 @@ class GenerateEngine(_EngineBase):
                 self._pending_swapins = []
                 self._pending_spills = []
                 if self._prefix is not None:
-                    # cached pages (both tiers) rode the same suspect device
+                    # cached pages (both tiers) rode the dead epoch's device
                     # state; the gauges must say so (a stale cached_pages /
-                    # host_pages reading after a restart would misreport
+                    # host_pages reading after a reset would misreport
                     # capacity until the next eviction touched them)
                     self._prefix.clear()
                     self._set_prefix_gauges()
             else:
-                self.cache = self._build_slot_cache()
-            self._spec_carry = None  # rode the same suspect device state
+                self.cache = self._place_cache(self._build_slot_cache())
+            self._prev_last = None
+            self._spec_carry = None  # rode the same dead-epoch device state
+
+    def fleet_fingerprint(self) -> str:
+        """Engine-config fingerprint for the fleet handshake: two processes
+        form a fleet only when everything that determines the compiled
+        programs and the replayed state transitions is identical
+        (fleet/channel.py rejects mismatches at the door)."""
+        from gofr_tpu.fleet import fingerprint_of
+
+        return fingerprint_of(
+            getattr(self.family, "__name__", type(self.family).__name__),
+            self.cfg, self._seed, self.num_slots, self.max_len,
+            self.decode_chunk, self.prefill_buckets, self.max_prefill_batch,
+            self.kv_layout, self.page_size if self.kv_layout == "paged" else 0,
+            getattr(self, "total_pages", 0), self.spec_tokens,
+            self.kv_quantize, self.top_k, self.top_p,
+        )
+
+    def _fleet_admit(self) -> bool:
+        """Step-boundary membership change (device thread, loop top): when
+        followers are parked in the channel's pending set — fresh joins,
+        rejoins after a leader or follower death — bump the fleet epoch and
+        bring EVERYONE onto identical virgin per-epoch state. Slot-resident
+        work is REQUEUED by recompute (the preemption machinery), not
+        failed: the leader's device state is healthy here, so nothing is
+        lost — requests re-prefill under the new epoch and their replay is
+        announced to the whole (new) fleet."""
+        ls = self._ls
+        if ls is None or not ls.has_pending():
+            return False
+        # drain in-flight device work first: queued folds reference the
+        # pre-bump cache and slot objects
+        while self._dq:
+            process_decode(self)
+        with self._state_lock:
+            while self._preempt_newest():
+                pass
+        # outside the lock: _reset_device_state blocks on still-executing
+        # device work first (_drain_device_state), and that wait must never
+        # run under _state_lock — a wedged program would deadlock stop()'s
+        # _fail_all behind the lock. Slots cannot repopulate in the gap:
+        # admission runs on this (device) thread only.
+        self._reset_device_state()
+        n = ls.admit_pending()
+        self.metrics.set_gauge("app_fleet_epoch", ls.epoch)
+        self.metrics.set_gauge("app_fleet_followers", ls.follower_count())
+        self.metrics.increment_counter("app_fleet_rejoins_total", n)
+        self.logger.warn(
+            f"fleet: admitted {n} follower(s) at epoch {ls.epoch} "
+            f"({ls.follower_count()} active); slot-resident work requeued"
+        )
+        return True
 
     # -- slot/page bookkeeping -------------------------------------------------
 
@@ -1397,16 +1580,34 @@ class GenerateEngine(_EngineBase):
         if self._page_refs[p] == 0:
             self._free_pages.append(p)
 
+    # staging buffers per (kind, shape) rotate through a ring this long
+    # before reuse. One shared buffer is NOT safe: the host→device fetch of
+    # a dispatched call's packed input is asynchronous PER DEVICE REPLICA
+    # (jnp.asarray does not copy for every device before dispatch returns),
+    # so rewriting the buffer for the next same-kind dispatch can corrupt
+    # what a lagging replica reads — divergent per-device KV writes, then
+    # garbage collectives (found by the fleet chaos drill: deterministic
+    # wrong tokens after a crash-restart under load). A device cannot lag a
+    # full ring behind the newest dispatch: every program carries a
+    # collective, so all replicas advance together within the bounded
+    # in-flight window (pipeline depth ≤ 4, plus abandoned crash-path
+    # dispatches) — 8 is comfortably past both.
+    STAGING_RING = 8
+
     def _staging(self, kind: str, shape: tuple[int, ...]) -> np.ndarray:
-        """A zeroed int32 staging buffer for one packed dispatch, reused
-        across steps per (kind, shape) signature. Device-thread only."""
+        """A zeroed int32 staging buffer for one packed dispatch, drawn
+        from a per-(kind, shape) ring so allocation is amortized without
+        ever rewriting a buffer a still-fetching replica may read.
+        Device-thread only."""
         key = (kind, shape)
-        buf = self._staging_bufs.get(key)
-        if buf is None:
-            buf = np.zeros(shape, np.int32)
-            self._staging_bufs[key] = buf
-        else:
-            buf.fill(0)
+        ring = self._staging_bufs.get(key)
+        if ring is None:
+            ring = ([np.zeros(shape, np.int32) for _ in range(self.STAGING_RING)], [0])
+            self._staging_bufs[key] = ring
+        bufs, idx = ring
+        buf = bufs[idx[0]]
+        idx[0] = (idx[0] + 1) % len(bufs)
+        buf.fill(0)
         return buf
 
     def _claim_slot(self, idx: int, slot: _Slot) -> None:
@@ -1743,6 +1944,12 @@ class GenerateEngine(_EngineBase):
             # spec is the one synchronous discipline left: its next round's
             # page allocation depends on data the host only learns at
             # readback (decode.spec_round).
+            if self._chaos_step is not None:
+                self._chaos_step(step=self._step_count)
+            if self._ls is not None and self._ls.has_pending():
+                # fleet membership change: admit (re)joining followers at
+                # this step boundary via an epoch bump (requeue + reset)
+                self._fleet_admit()
             processed = False
             admitted = self._admit()
             if depth == 1:
@@ -2536,11 +2743,28 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
         # (tpu/lockstep.py). A crash-restart would desynchronize followers,
         # so lockstep engines don't restart.
         lockstep_role = kw.pop("lockstep_role", None)
-        if (lockstep_role is None and getattr(tpu, "distributed", False)
+        # elastic fleet (gofr_tpu.fleet; FLEET_LISTEN / FLEET_LEADER): the
+        # announce stream rides the host-side channel with epoch-based
+        # rejoin, so the restart budget STAYS available — a leader device-
+        # loop restart is an epoch bump, not fleet death
+        fleet = kw.pop("fleet", None)
+        if fleet is None:
+            from gofr_tpu.fleet import FleetConfig
+
+            fleet = FleetConfig.from_config(conf)
+        if fleet is not None:
+            if lockstep_role not in (None, fleet.role):
+                raise ValueError(
+                    f"lockstep_role {lockstep_role!r} contradicts the FLEET_* "
+                    f"config (role {fleet.role!r})")
+            lockstep_role = fleet.role
+        elif (lockstep_role is None and getattr(tpu, "distributed", False)
                 and jax.process_count() > 1):
             lockstep_role = "leader" if jax.process_index() == 0 else "follower"
-        if lockstep_role:
+        if lockstep_role and fleet is None:
             kw["max_restarts"] = 0
+        if fleet is not None:
+            kw["fleet"] = fleet
 
         prefix_cache = bool(kw.pop("prefix_cache", conf.get_bool("ENGINE_PREFIX_CACHE", True)))
         if prefill_attn is None and sp_size > 1 and spec.task == "generate":

@@ -290,32 +290,49 @@ def test_stop_mid_mixed_traffic_frees_all_state(setup):
     assert_paged_pool_consistent(eng, slots_empty=True)
 
 
-class _RecordingLeader:
-    """Stands in for LockstepLeader: captures the (header, payload)
-    broadcast stream the leader would put on the fabric."""
+class _RecordingChannel:
+    """Stands in for the announce transport (fleet/channel.py interface):
+    captures the (header, payload) frame stream the leader would put on
+    the fabric, then replays it through a same-config follower."""
+
+    supports_rejoin = False
 
     def __init__(self):
         self.stream: list[tuple[np.ndarray, np.ndarray | None]] = []
-        self._stopped = False
+        self._replay = None
+        self._payload = None
 
-    def announce(self, tag, a, b, packed):
-        self.stream.append((np.array([tag, a, b], np.int32),
-                            np.array(packed, np.int32, copy=True)))
+    # leader side
+    def send(self, header, payload):
+        self.stream.append((
+            np.array(header, np.int32, copy=True),
+            None if payload is None else np.array(payload, np.int32, copy=True),
+        ))
 
-    def maybe_heartbeat(self, interval_s):  # pragma: no cover - idle only
+    def close(self):
         pass
 
-    def stop(self):
-        from gofr_tpu.tpu.lockstep import TAG_STOP
+    # follower side (consumes the recorded stream)
+    def recv_header(self):
+        header, self._payload = next(self._replay)
+        return header
 
-        if not self._stopped:
-            self._stopped = True
-            self.stream.append((np.array([TAG_STOP, 0, 0], np.int32), None))
+    def recv_payload(self, shape):
+        payload, self._payload = self._payload, None
+        assert payload is not None and payload.shape == tuple(shape), (
+            "follower reconstructed a different payload shape than the "
+            f"leader announced: {None if payload is None else payload.shape} "
+            f"vs {shape}"
+        )
+        return payload
+
+    def start_replay(self):
+        self._replay = iter(self.stream)
 
 
 @pytest.mark.quick
 @pytest.mark.parametrize("kv_layout", ["slot", "paged"])
-def test_lockstep_replay_reproduces_device_state(setup, kv_layout, monkeypatch):
+def test_lockstep_replay_reproduces_device_state(setup, kv_layout):
     """Leader/follower determinism under the async pipeline: the announce
     stream recorded while the leader serves overlapped mixed traffic must
     replay through LockstepFollower to a BIT-IDENTICAL final cache (and
@@ -330,8 +347,8 @@ def test_lockstep_replay_reproduces_device_state(setup, kv_layout, monkeypatch):
     if kv_layout == "paged":
         kw.update(kv_layout="paged", page_size=8, prefix_cache=False)
     leader = make_engine(cfg, params, **kw)
-    rec = _RecordingLeader()
-    leader._ls = rec
+    chan = _RecordingChannel()
+    leader._ls = ls_mod.LockstepLeader(channel=chan)
     long_prompt = [(5 * i) % 150 + 1 for i in range(13)]
     try:
         reqs = [leader.submit(p, max_new_tokens=5, timeout=120)
@@ -340,27 +357,12 @@ def test_lockstep_replay_reproduces_device_state(setup, kv_layout, monkeypatch):
         assert outs[1]["tokens"] == ref(long_prompt, 5)
     finally:
         leader.stop()
-    assert rec.stream and int(rec.stream[-1][0][0]) == ls_mod.TAG_STOP
+    assert chan.stream and int(chan.stream[-1][0][0]) == ls_mod.TAG_STOP
 
-    flat: list[np.ndarray] = []
-    for header, payload in rec.stream:
-        flat.append(header)
-        if payload is not None:
-            flat.append(payload)
-    it = iter(flat)
-
-    def fake_broadcast(value):
-        item = next(it)
-        assert np.asarray(value).shape == item.shape, (
-            "follower reconstructed a different payload shape than the "
-            f"leader announced: {np.asarray(value).shape} vs {item.shape}"
-        )
-        return item
-
-    monkeypatch.setattr(ls_mod, "_broadcast", fake_broadcast)
+    chan.start_replay()
     follower = make_engine(cfg, params, **kw)
     try:
-        LockstepFollower(follower).run()
+        LockstepFollower(follower, channel=chan).run()
         leader_leaves = jax.tree.leaves(leader.cache)
         follower_leaves = jax.tree.leaves(follower.cache)
         assert len(leader_leaves) == len(follower_leaves)

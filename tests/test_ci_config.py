@@ -143,6 +143,24 @@ def test_ci_has_py310_compat_gate():
         assert "gofr_tpu" in runs and "tests" in runs
 
 
+def test_ci_builds_the_serving_image():
+    """The root Dockerfile (serving runtime; libtpu/jaxlib pinning docs live
+    in its header) must exist and be built by a CI job — image breakage is
+    deploy breakage and no pytest tier would catch it."""
+    dockerfile = REPO / "Dockerfile"
+    assert dockerfile.exists(), "root Dockerfile missing"
+    text = dockerfile.read_text()
+    # the pinning contract the satellite documents: jax version + libtpu
+    # release index as build args, never floating installs
+    assert "JAX_VERSION" in text and "libtpu" in text.lower()
+    ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+    builds = [
+        name for name, job in ci["jobs"].items()
+        if any("docker build" in step.get("run", "") for step in job.get("steps", []))
+    ]
+    assert builds, "ci.yml has no job running `docker build` on the root Dockerfile"
+
+
 def test_ci_runs_the_quick_tier():
     ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
     quick_runs = [

@@ -437,3 +437,58 @@ def test_redis_exec_failure_leaves_durable_pending_marker():
     assert run_migrations({2: Migration(up=lambda d: d.redis.set("k", "v"))}, c) == [2]
     assert c.sql.query_row(
         "SELECT method FROM gofr_migrations WHERE version = 2")["method"] == "UP"
+
+
+def test_file_provider_seam_wires_hooks_and_health():
+    """FileSystemProvider seam (reference `file/file.go:69-78`): a remote-FS
+    provider swapped in via add_file_store gets the plugin wiring
+    (use_logger/use_metrics/connect, in that contract order), replaces
+    container.file for handlers, and joins health aggregation."""
+    from gofr_tpu.datasource.file import FileSystemProvider, InMemoryFileSystem
+
+    c = new_mock_container()
+    fs = InMemoryFileSystem(bucket="b1")
+    assert isinstance(fs, FileSystemProvider)
+    assert isinstance(LocalFileSystem("."), FileSystemProvider)
+    assert fs.health_check()["status"] == "DOWN"  # remote client pre-connect
+
+    c.add_file_store(fs)
+    assert c.file is fs
+    assert fs.connected and fs.logger is c.logger and fs.metrics is c.metrics
+    assert c.health()["services"]["file"]["status"] == "UP"
+
+
+def test_inmemory_file_provider_full_surface():
+    from gofr_tpu.datasource.file import InMemoryFileSystem
+
+    fs = InMemoryFileSystem()
+    fs.connect()
+    fs.mkdir("data")
+    with pytest.raises(FileExistsError):
+        fs.mkdir("data")
+    fs.mkdir_all("a/b/c")
+    assert fs.exists("a/b")
+    fs.create("data/rows.jsonl", b'{"a": 1}\n{"a": 2}\n')
+    assert list(fs.read_rows("data/rows.jsonl")) == [{"a": 1}, {"a": 2}]
+    fs.create("data/notes.txt", b"x\ny\n")
+    assert list(fs.read_rows("data/notes.txt")) == ["x", "y"]
+    assert fs.list("data") == ["notes.txt", "rows.jsonl"]
+    assert fs.open("data/notes.txt").read() == b"x\ny\n"
+    assert fs.stat("data/notes.txt").st_size == 4
+    fs.rename("data/notes.txt", "data/notes2.txt")
+    assert fs.exists("data/notes2.txt") and not fs.exists("data/notes.txt")
+    fs.remove("data/notes2.txt")
+    with pytest.raises(FileNotFoundError):
+        fs.read("data/notes2.txt")
+    with pytest.raises(FileNotFoundError):
+        fs.create("nodir/x.txt", b"")  # parent must exist, like a real FS
+    fs.remove_all("data")
+    assert not fs.exists("data") and not fs.exists("data/rows.jsonl")
+    # dotfile names survive normalization intact (".env" is a FILE NAME,
+    # not path structure) and stay distinct from their dotless sibling
+    fs.create(".env", b"A=1\n")
+    fs.create("env", b"other\n")
+    assert fs.read(".env") == b"A=1\n" and fs.read("env") == b"other\n"
+    assert sorted(n for n in fs.list(".") if "env" in n) == [".env", "env"]
+    # traversal above the root is clipped, like the local provider's chroot
+    assert fs.read("../.env") == b"A=1\n"

@@ -503,3 +503,35 @@ def test_websocket_edge_cases():
         assert out["push"] == {"push": 1}
     # connection unregistered after close
     assert len(app.ws_hub) == 0
+
+
+def test_static_files_served_but_openapi_forbidden(tmp_path):
+    """Static-route hardening (reference `http/router.go:62-82`): a static
+    mount serves its files, but `openapi.json` — at any depth — returns
+    403 (the spec is served at /.well-known/openapi.json only), and path
+    traversal out of the mount resolves to 404, never a file."""
+    import httpx
+
+    (tmp_path / "index.html").write_text("<h1>hi</h1>")
+    (tmp_path / "openapi.json").write_text("{}")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "openapi.json").write_text("{}")
+    (sub / "ok.txt").write_text("fine")
+    outside = tmp_path.parent / "outside-secret.txt"
+    outside.write_text("secret")
+
+    app = make_app()
+    app.add_static_files("/static", str(tmp_path))
+    with AppHarness(app) as h, httpx.Client(base_url=h.base) as c:
+        assert c.get("/static/index.html").text == "<h1>hi</h1>"
+        assert c.get("/static/sub/ok.txt").text == "fine"
+        r = c.get("/static/openapi.json")
+        assert r.status_code == 403
+        assert "well-known" in r.json()["error"]["message"]
+        assert c.get("/static/sub/openapi.json").status_code == 403
+        assert c.get("/static/missing.txt").status_code == 404
+        # traversal: %2E%2E decodes to ".." after routing; must not escape
+        r = c.get(f"{h.base}/static/%2E%2E/outside-secret.txt")
+        assert r.status_code == 404
+        assert "secret" not in r.text

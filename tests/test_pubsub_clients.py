@@ -232,3 +232,136 @@ def test_google_requires_project_id():
     with pytest.raises(ValueError, match="GOOGLE_PROJECT_ID"):
         GooglePubSubBroker(DictConfig({}), MockLogger(), None,
                            client_factory=lambda: (None, None))
+
+
+# -- file-transport broker (pubsub/file.py) --------------------------------------
+
+
+def test_file_broker_cross_instance_roundtrip_and_restart(tmp_path):
+    """Two FileBroker instances over one directory stand in for two
+    PROCESSES (the using-publisher / using-subscriber pair): messages and
+    headers cross the boundary, commits are durable, and a fresh instance
+    (a restarted consumer process) resumes at the committed offset —
+    redelivering the uncommitted suffix (at-least-once)."""
+    from gofr_tpu.pubsub.file import FileBroker
+
+    pub, sub = FileBroker(str(tmp_path)), FileBroker(str(tmp_path))
+    pub.publish("orders", {"n": 1}, headers={"traceparent": "00-abc"})
+    msg = sub.subscribe("orders", group="g", timeout=5)
+    assert msg is not None and msg.bind(dict) == {"n": 1}
+    assert msg.param("traceparent") == "00-abc"
+    assert msg.metadata["offset"] == 0
+    msg.commit()
+    assert sub.subscribe("orders", group="g", timeout=0.1) is None  # drained
+
+    # restarted consumer: starts from the durable committed offset
+    pub.publish("orders", {"n": 2})
+    sub2 = FileBroker(str(tmp_path))
+    m2 = sub2.subscribe("orders", group="g", timeout=5)
+    assert m2 is not None and m2.bind(dict) == {"n": 2} and m2.metadata["offset"] == 1
+    # ...and m2 was never committed, so the NEXT restart redelivers it
+    sub3 = FileBroker(str(tmp_path))
+    m3 = sub3.subscribe("orders", group="g", timeout=5)
+    assert m3 is not None and m3.bind(dict) == {"n": 2}
+    m3.commit()
+    assert sub3.subscribe("orders", group="g", timeout=0.1) is None
+    assert pub.health_check()["status"] == "UP"
+    assert "orders" in pub.topics()
+
+
+def test_file_broker_never_delivers_torn_tail(tmp_path):
+    """A publisher in another process can be observed mid-append: an
+    unterminated trailing line is NOT a committed record and must not be
+    delivered (it would hand the handler truncated bytes, and its commit
+    would then skip the completed message). Only the newline lands it."""
+    from gofr_tpu.pubsub.file import FileBroker
+
+    b = FileBroker(str(tmp_path))
+    b.publish("t", {"n": 0})
+    full_line = open(b._log_path("t")).read()
+    with open(b._log_path("t"), "a") as f:
+        f.write(full_line.rstrip("\n"))  # mid-append snapshot: no newline yet
+    m0 = b.subscribe("t", group="g", timeout=5)
+    assert m0 is not None and m0.bind(dict) == {"n": 0}
+    m0.commit()
+    assert b.subscribe("t", group="g", timeout=0.2) is None  # torn tail invisible
+    with open(b._log_path("t"), "a") as f:
+        f.write("\n")  # the append completes
+    m1 = b.subscribe("t", group="g", timeout=5)
+    assert m1 is not None and m1.metadata["offset"] == 1
+
+
+def test_file_broker_contiguous_prefix_commit(tmp_path):
+    """Out-of-order commits advance the durable offset only across a
+    contiguous prefix (the in-memory broker's at-least-once rule)."""
+    from gofr_tpu.pubsub.file import FileBroker
+
+    b = FileBroker(str(tmp_path))
+    for n in range(3):
+        b.publish("t", {"n": n})
+    m0 = b.subscribe("t", group="g", timeout=5)
+    m1 = b.subscribe("t", group="g", timeout=5)
+    m2 = b.subscribe("t", group="g", timeout=5)
+    m2.commit()  # gap at 0-1: offset must stay 0
+    m1.commit()  # gap at 0: still 0
+    assert b._read_offset("t", "g") == 0
+    m0.commit()  # prefix complete -> 3
+    assert b._read_offset("t", "g") == 3
+
+
+# -- subscriber chaos: crash between handler and commit --------------------------
+
+
+def test_subscriber_crash_between_handler_and_commit_redelivers():
+    """The at-least-once hard case, driven by the chaos layer's
+    ``pubsub.commit`` fault point (fleet/chaos.py): the handler runs, the
+    injected crash lands BEFORE the offset commit, the broker redelivers,
+    and the idempotent handler turns the duplicate delivery into an
+    exactly-once EFFECT — after which the commit sticks and nothing is
+    delivered again."""
+    import time
+
+    from gofr_tpu.app import new_testing
+    from gofr_tpu.fleet import chaos
+
+    app = new_testing({})
+    broker = app.container.pubsub
+    group = app.container.app_name
+    deliveries: list = []
+    effects: set = set()
+
+    def handler(ctx):
+        order = ctx.bind(dict)
+        deliveries.append(order)
+        effects.add(order["id"])  # set-add: idempotent effect
+
+    app.subscribe("orders", handler)
+
+    def wait_for(cond, what, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while not cond():
+            assert time.monotonic() < deadline, f"timed out waiting for {what}"
+            time.sleep(0.01)
+
+    with chaos.override("pubsub.commit:raise,nth=1"):
+        app._start_subscribers()
+        try:
+            broker.publish("orders", {"id": 7})
+            wait_for(lambda: len(deliveries) == 1, "first delivery")
+            # handler ran; the commit was killed -> offset NOT advanced
+            wait_for(lambda: broker._cursor.get(("orders", group)) == 1,
+                     "consume cursor")
+            assert broker._offsets.get(("orders", group), 0) == 0
+            # consumer crash/rebalance redelivers the uncommitted message
+            broker.rewind_uncommitted("orders", group=group)
+            wait_for(lambda: len(deliveries) == 2, "redelivery")
+            wait_for(lambda: broker._offsets.get(("orders", group), 0) == 1,
+                     "commit after retry")
+            # exactly-once-after-retry EFFECT: applied once, committed once
+            assert effects == {7}
+            # nothing left to redeliver now that the commit stuck
+            broker.rewind_uncommitted("orders", group=group)
+            time.sleep(0.2)
+            assert len(deliveries) == 2
+        finally:
+            app._sub_stop.set()

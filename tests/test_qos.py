@@ -698,3 +698,35 @@ class TestOverloadFaultInjection:
         finally:
             stop_poll.set()
             eng.stop()
+
+
+@pytest.mark.quick
+class TestShedDuringRestart:
+    def test_restarting_engine_sheds_new_work(self):
+        """Shed-during-restart (docs/qos.md): while an engine's device loop
+        is inside its crash-recovery backoff window, NEW submissions are
+        rejected 503 + Retry-After (work already queued survives the
+        restart; piling more on only deepens what the restarted loop must
+        drain). Flips health to DEGRADED like every overload shed."""
+        ctrl, c = make_controller(shed_window_s=60.0)
+
+        class FakeEngine:
+            num_slots = 2
+            _restarting = True
+
+            def _backlog(self):
+                return 0
+
+        eng = FakeEngine()
+        with pytest.raises(ServiceUnavailable) as err:
+            ctrl.admit_engine(eng, None, None)
+        assert err.value.status_code == 503
+        assert err.value.retry_after and err.value.retry_after > 0
+        assert ctrl.shedding and ctrl.health_check()["status"] == "DEGRADED"
+        assert c.metrics.get("app_qos_rejected_total").value(
+            reason="restart", qos_class="default") == 1
+        assert c.metrics.get("app_qos_shed_total").value(reason="restart") == 1
+
+        # restart window over: admission resumes
+        eng._restarting = False
+        assert ctrl.admit_engine(eng, None, None).name == "default"

@@ -148,3 +148,101 @@ def test_unknown_model_is_client_error(lm_app):
     with AppHarness(lm_app) as h, httpx.Client(base_url=h.base, timeout=60) as client:
         r = client.post("/bad", json={})
         assert r.status_code == 500
+
+
+# -- app-tier failure contract under a chaos-killed device loop ------------------
+#
+# The fleet chaos layer (gofr_tpu/fleet/chaos.py; docs/testing.md) injects
+# "kill the device loop once the step counter reaches N" into the SAME app
+# that serves traffic, proving the contract VERDICT r5 #6 asked for: in-
+# flight work fails fast (5xx / in-band SSE error), queued work survives the
+# supervised restart, and /.well-known/health is DEGRADED exactly during the
+# restart window (held open deterministically by a chaos latch — no sleeps
+# as synchronization).
+
+
+def _chaos_app():
+    from gofr_tpu.http.streaming import StreamingResponse
+
+    app = make_app()
+    spec = ModelSpec("llama", LlamaConfig.tiny(), task="generate", dtype=jnp.float32)
+    app.serve_model("lm", spec, slots=2, max_len=64, decode_chunk=2)
+
+    def generate(ctx):
+        body = ctx.bind(dict)
+        return ctx.generate("lm", body["prompt"],
+                            max_new_tokens=int(body.get("max_new_tokens", 4)),
+                            timeout=120)
+
+    def generate_stream(ctx):
+        body = ctx.bind(dict)
+        it = ctx.generate("lm", body["prompt"],
+                          max_new_tokens=int(body.get("max_new_tokens", 8)),
+                          stream=True, timeout=120)
+        return StreamingResponse(it, event="token")
+
+    app.post("/generate", generate)
+    app.post("/generate/stream", generate_stream)
+    return app
+
+
+def test_device_loop_kill_midstream_sse_and_degraded_window(tmp_path):
+    import time as _time
+
+    from gofr_tpu.fleet import chaos
+
+    latch = tmp_path / "release-restart"
+    with chaos.override(
+            f"engine.step:raise,at_step=3;engine.restart:hold,file={latch},timeout=120"):
+        app = _chaos_app()
+        with AppHarness(app) as h, httpx.Client(base_url=h.base, timeout=180) as client:
+            # in-flight SSE stream: 40 tokens at decode_chunk=2 is ~20 device
+            # steps, so the at_step=3 kill lands mid-stream by construction
+            events = []
+            with client.stream("POST", "/generate/stream",
+                               json={"prompt": [1, 2, 3], "max_new_tokens": 40}) as r:
+                assert r.status_code == 200
+                for line in r.iter_lines():
+                    if line.startswith("event: "):
+                        events.append(line.split("event: ", 1)[1])
+            assert "error" in events, events  # IN-BAND error, not a dropped conn
+            assert "done" not in events       # the stream did not lie about finishing
+
+            # restart window is latch-held open: health MUST be DEGRADED now
+            deadline = _time.time() + 60
+            while _time.time() < deadline:
+                health = client.get("/.well-known/health").json()["data"]
+                if health["status"] == "DEGRADED":
+                    break
+                _time.sleep(0.02)
+            assert health["status"] == "DEGRADED", health
+            assert health["services"]["model:lm"]["status"] == "DEGRADED"
+
+            # a request arriving DURING the window queues up and must survive
+            results: list = []
+            t = threading.Thread(target=lambda: results.append(
+                client.post("/generate", json={"prompt": [4, 5], "max_new_tokens": 3})))
+            t.start()
+            latch.write_text("")  # release the held restart
+            t.join(timeout=150)
+            assert results, "queued request never completed after the restart"
+            assert results[0].status_code == 201, results[0].text
+            assert len(results[0].json()["data"]["tokens"]) == 3
+
+            health = client.get("/.well-known/health").json()["data"]
+            assert health["status"] == "UP", health  # DEGRADED only during the window
+
+
+def test_device_loop_kill_inflight_5xx_then_recovers():
+    from gofr_tpu.fleet import chaos
+
+    with chaos.override("engine.step:raise,at_step=3"):
+        app = _chaos_app()
+        with AppHarness(app) as h, httpx.Client(base_url=h.base, timeout=180) as client:
+            r = client.post("/generate", json={"prompt": [1, 2, 3], "max_new_tokens": 40})
+            assert r.status_code == 500, r.text  # in-flight work fails FAST, not by timeout
+            assert "error" in r.json()  # envelope, with internals masked
+            # supervised restart: the same engine serves again (queue survived)
+            r2 = client.post("/generate", json={"prompt": [1, 2], "max_new_tokens": 3})
+            assert r2.status_code == 201, r2.text
+            assert len(r2.json()["data"]["tokens"]) == 3
