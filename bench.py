@@ -46,6 +46,13 @@ Env knobs:
     GOFR_BENCH_DEBUG          1 = per-phase device-call accounting in extra
     GOFR_TPU_PEAK_TFLOPS      override bf16 peak for MFU (default by device kind)
     GOFR_TPU_PEAK_GBS         override HBM GB/s for MBU (default by device kind)
+    GOFR_AUTOTUNE             0 = disable the warmup kernel autotuner; the
+                              decision table lands in extra.autotune either way
+    GOFR_AUTOTUNE_CACHE       path for autotune decisions (restarts skip re-timing)
+
+The JSON line also reports extra.mbu_decode_lb against the newest archived
+BENCH_r*.json round (extra.mbu_prev: round, value, delta) so kernel wins
+and regressions are visible per PR without diffing artifacts.
 """
 
 from __future__ import annotations
@@ -149,6 +156,46 @@ def _pallas_active() -> bool:
     from gofr_tpu.ops.pallas import flash_attention_available
 
     return flash_attention_available()
+
+
+def _prev_bench_extra() -> tuple[int, dict] | None:
+    """(round, extra) from the newest prior BENCH_r*.json next to this file.
+
+    Bench rounds archive the run as {"n", "cmd", "rc", "tail", "parsed"};
+    prefer the structured "parsed" record, falling back to scanning the
+    (possibly truncated) output tail for the metric line. Used to report
+    the mbu_decode_lb / autotune-decision delta per PR (ROADMAP O3: kernel
+    wins and regressions must be visible per round)."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m:
+            rounds.append((int(m.group(1)), p))
+    for n, p in sorted(rounds, reverse=True):
+        try:
+            with open(p, encoding="utf-8") as f:
+                doc = json.load(f)
+        except Exception:  # noqa: BLE001 - a torn archive is just skipped
+            continue
+        if not isinstance(doc, dict):
+            continue
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and isinstance(parsed.get("extra"), dict):
+            return n, parsed["extra"]
+        for line in reversed(str(doc.get("tail", "")).splitlines()):
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                try:
+                    rec = json.loads(line)
+                except Exception:  # noqa: BLE001
+                    continue
+                if isinstance(rec, dict):
+                    return n, rec.get("extra") or {}
+    return None
 
 
 def _percentile(xs: list[float], p: float) -> float:
@@ -437,16 +484,47 @@ def main() -> None:
         "n_params": n_params,
         "quantize": quantize or "bf16",
         "param_bytes": int(param_bytes),
-        # kernels are opt-in after the round-3 A/B: XLA beat the Pallas
-        # kernels on v5e on both prefill and decode (BASELINE.md, round-3
-        # hardware validation notes); re-check with GOFR_BENCH_PALLAS_AB=1
-        "pallas": "on" if _pallas_active()
-                  else "off by default (XLA faster on v5e; see BASELINE.md)",
         "mfu": round(mfu, 4) if mfu is not None else None,
         "mbu_decode_lb": round(mbu, 4) if mbu is not None else None,
         "ttft_p50_s": round(_percentile(m["ttfts"], 50), 4),
         "ttft_p99_s": round(_percentile(m["ttfts"], 99), 4),
     }
+    # warmup autotuner decision table (ops/autotune.py): which backend each
+    # decode op pinned for this run's engine, with the measured timings —
+    # the per-PR record ROADMAP O3 asks for. The headline engine is the
+    # last to warm up before this point, so the module-level report is its.
+    from gofr_tpu.ops import autotune as _autotune
+
+    at_rep = _autotune.last_report()
+    extra["autotune"] = at_rep or {"enabled": _autotune.enabled(), "decisions": {}}
+    # kernel status derives from what actually served the run: the autotune
+    # pins when the tuner decided, else the static GOFR_PALLAS gate (the
+    # pre-autotuner posture — see docs/kernels.md for the precedence chain)
+    if at_rep and at_rep.get("decisions"):
+        extra["pallas"] = "autotuned: " + ", ".join(
+            f"{op}->{rec.get('backend')}"
+            for op, rec in sorted(at_rep["decisions"].items()))
+    else:
+        extra["pallas"] = ("on (GOFR_PALLAS static gate)" if _pallas_active()
+                           else "off (static gate; see docs/kernels.md)")
+    # regression tracking: delta vs the newest archived round so a kernel
+    # win (or loss) is visible in every round's artifact without diffing
+    prev = _prev_bench_extra()
+    if prev is not None:
+        prev_round, prev_extra = prev
+        prev_mbu = prev_extra.get("mbu_decode_lb")
+        extra["mbu_prev"] = {"round": prev_round, "mbu_decode_lb": prev_mbu}
+        cur_mbu = extra["mbu_decode_lb"]
+        if cur_mbu is not None and isinstance(prev_mbu, (int, float)):
+            extra["mbu_prev"]["delta"] = round(cur_mbu - prev_mbu, 4)
+        print(
+            f"mbu_decode_lb: {cur_mbu} (prev round r{prev_round:02d}: "
+            f"{prev_mbu}, delta "
+            f"{extra['mbu_prev'].get('delta', 'n/a')}); autotune: "
+            + (", ".join(
+                f"{op}->{rec.get('backend')}" for op, rec in
+                (extra["autotune"].get("decisions") or {}).items()) or "none"),
+            file=sys.stderr)
     if kv_layout != "slot":
         extra["kv_layout"] = kv_layout
     if kv_quantize:

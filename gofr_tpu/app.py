@@ -570,10 +570,18 @@ class App:
     async def _debug_engine_handler(self, request: web.Request) -> web.Response:
         """GET /debug/engine?n=K → the last K device steps (kind, wall time,
         batch occupancy, compile signature, backlog) plus a health snapshot
-        of every served engine."""
+        of every served engine, including the warmup autotuner's pinned
+        kernel backend per op with its timings (ops/autotune.py)."""
         steps = self.container.flight.steps(limit=self._debug_limit(request))
-        engines = {name: engine.health_check() if hasattr(engine, "health_check") else {}
-                   for name, engine in self.container.engines.items()}
+        engines = {}
+        for name, engine in self.container.engines.items():
+            snap = engine.health_check() if hasattr(engine, "health_check") else {}
+            report = getattr(engine, "autotune_report", None)
+            rep = report() if report is not None else None
+            if rep:
+                snap = dict(snap)
+                snap["autotune"] = rep
+            engines[name] = snap
         return web.json_response(
             {"data": {"count": len(steps), "steps": steps, "engines": engines}})
 
@@ -659,8 +667,35 @@ class App:
         if self._debug_env():
             self._start_profiler_server()
 
-        # engines first (device warm-up), then servers
+        # engines first (device warm-up), then servers. ENGINE_WARMUP=true
+        # front-loads every program compile AND the kernel-backend autotune
+        # (docs/serving.md: seconds at boot instead of inside the first
+        # requests' latency window; generate engines need no example).
+        warm = self.config.get_or_default("ENGINE_WARMUP", "false").lower() == "true"
         for name, engine in self.container.engines.items():
+            if warm and hasattr(engine, "warmup"):
+                # signature-probed, NOT try/except TypeError around the call
+                # — that would conflate "needs an example input" (BatchEngine;
+                # app boot has none, first traffic compiles as before) with a
+                # genuine TypeError from inside warmup (same rationale as
+                # container._pubsub_supports_headers)
+                import inspect
+
+                try:
+                    needs_example = any(
+                        p.default is p.empty
+                        and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                        for p in inspect.signature(engine.warmup).parameters.values())
+                except (TypeError, ValueError):
+                    needs_example = True
+                if not needs_example:
+                    try:
+                        n = engine.warmup()
+                        self.logger.infof("model engine %s warmed (%d programs)", name, n)
+                    except Exception as e:  # noqa: BLE001 - warmup is an
+                        # optimization: surface the failure loudly but let the
+                        # engine serve (first traffic compiles lazily)
+                        self.logger.log_exception(e, f"engine {name} warmup failed")
             if hasattr(engine, "start"):
                 engine.start()
                 self.logger.infof("model engine %s started", name)

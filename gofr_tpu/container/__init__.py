@@ -130,6 +130,12 @@ class Container:
                       "followers dropped from the announce fan-out mid-stream")
         m.new_counter("app_fleet_supervisor_restarts_total",
                       "fleet member processes restarted by fleet.Supervisor")
+        # kernel-backend autotuner (ops/autotune.py, docs/kernels.md):
+        # info-style gauge — 1 on the (op, backend) pair the warmup
+        # autotuner pinned for 'auto' resolution, 0 on the loser
+        m.new_gauge("app_tpu_kernel_backend",
+                    "pinned attention-kernel backend per op (1 = op resolves "
+                    "backend='auto' to this backend; labels: op, backend)")
         m.new_counter("app_tpu_spec_proposed", "draft tokens proposed by speculative decoding")
         m.new_counter("app_tpu_spec_accepted", "draft tokens accepted by target verification")
         # SLO latency family (docs/observability.md): recorded by the engine

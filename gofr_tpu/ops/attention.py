@@ -20,17 +20,27 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def resolve_backend(backend: str) -> str:
-    """'auto' picks XLA unless the Pallas kernels are opted in
-    (GOFR_PALLAS=1 on TPU, or the interpreter for tests) — on v5e the XLA
-    paths measured faster than the current kernels (see
-    ops/pallas/__init__.flash_attention_available). An explicit 'pallas'
-    is honored whenever the platform can lower kernels at all, degrading
-    to 'xla' only off-TPU so one model code path serves the CPU test mesh
-    and real chips."""
+def resolve_backend(backend: str, op: str | None = None) -> str:
+    """'auto' resolves, in precedence order (docs/kernels.md): an explicit
+    GOFR_PALLAS env value (0/1 — the operator override), then a pinned
+    warmup-autotune decision for ``op`` (ops.autotune.decision_scope;
+    engines pin measured winners for the decode ops around every trace
+    they drive), then the legacy static default (XLA on hardware, Pallas
+    under the interpreter — ops/pallas/__init__.flash_attention_available).
+    An explicit 'pallas' is honored whenever the platform can lower
+    kernels at all, degrading to 'xla' only off-TPU so one model code path
+    serves the CPU test mesh and real chips."""
     if backend == "auto":
-        from gofr_tpu.ops.pallas import flash_attention_available
+        import os
 
+        from gofr_tpu.ops.pallas import flash_attention_available, kernel_platform
+
+        if os.environ.get("GOFR_PALLAS", "") not in ("0", "1"):
+            from gofr_tpu.ops.autotune import pinned_backend
+
+            pinned = pinned_backend(op)
+            if pinned is not None:
+                return "pallas" if pinned == "pallas" and kernel_platform() else "xla"
         return "pallas" if flash_attention_available() else "xla"
     if backend == "pallas":
         from gofr_tpu.ops.pallas import kernel_platform
@@ -168,7 +178,7 @@ def decode_attention(
     """Single-step decode: q [B, Hq, D] against a head-major cache
     [B, Hkv, Smax, D], attending to positions < lengths[b]. Returns
     [B, Hq, D]."""
-    if resolve_backend(backend) == "pallas":
+    if resolve_backend(backend, op="decode") == "pallas":
         from gofr_tpu.ops.pallas import interpret_mode
         from gofr_tpu.ops.pallas.decode_attention import _pick_block
         from gofr_tpu.ops.pallas.decode_attention import decode_attention as pallas_decode
@@ -183,6 +193,16 @@ def decode_attention(
         if bkv >= min(smax, 128) and bkv % 8 == 0:
             return pallas_decode(
                 q, k_cache, v_cache, lengths, scale=scale, interpret=interpret_mode()
+            )
+        if backend == "pallas":
+            # Only 'auto' may degrade silently — an explicit request the
+            # kernel cannot satisfy must not be ignored (ADVICE.md round 2;
+            # paged_decode_attention already raises for its analog).
+            raise ValueError(
+                f"backend='pallas' requested but cache Smax {smax} yields kv "
+                f"block {bkv} (need a block >= min(Smax, 128) that divides "
+                f"Smax and is a multiple of 8); use a 128-aligned cache "
+                f"length or backend='auto'"
             )
     b, hq, d = q.shape
     _, hkv, smax, _ = k_cache.shape
@@ -238,10 +258,36 @@ def paged_decode_attention_q(
     lengths: jnp.ndarray,
     *,
     scale: float | None = None,
+    backend: str = "auto",
 ) -> jnp.ndarray:
-    """paged_decode_attention over an int8 pool (ops.paged.QPagedKVCache):
-    gather the int8 logical views + scales per slot, then reuse the
-    folded-scale decode path — gathered bytes stay int8."""
+    """paged_decode_attention over an int8 pool (ops.paged.QPagedKVCache).
+
+    'pallas' is the FUSED kernel (ops.pallas.paged_decode.paged_decode_
+    attention_q): int8 pages + scale rows stream straight out of the pool
+    through the scalar-prefetched block tables and dequantize in-kernel —
+    no materialized logical view, HBM traffic stays int8. 'xla' gathers
+    the int8 logical views + scales per slot (one extra HBM round trip for
+    the copy) and reuses the folded-scale dense decode path — correct
+    everywhere. 'auto' follows resolve_backend (autotune pin aware)."""
+    page = kq_pool.shape[2]
+    if resolve_backend(backend, op="paged_decode_q") == "pallas":
+        if page % 8 == 0:
+            from gofr_tpu.ops.pallas import interpret_mode
+            from gofr_tpu.ops.pallas.paged_decode import (
+                paged_decode_attention_q as pallas_paged_q,
+            )
+
+            return pallas_paged_q(
+                q, kq_pool, vq_pool, ks_pool, vs_pool, table, lengths,
+                scale=scale, interpret=interpret_mode(),
+            )
+        if backend == "pallas":
+            # explicit requests never degrade silently (ADVICE.md round 2)
+            raise ValueError(
+                f"backend='pallas' requested but page size {page} is not a "
+                f"multiple of 8 (f32 sublane tile); use a page_size % 8 == 0 "
+                f"or backend='auto'"
+            )
     from gofr_tpu.ops.paged import gather_kv_q
 
     gkq, gks = gather_kv_q(kq_pool, ks_pool, table)
@@ -270,7 +316,7 @@ def paged_decode_attention(
     decode path — correct everywhere, but pays an extra HBM round trip.
     """
     page = k_pool.shape[2]
-    if resolve_backend(backend) == "pallas":
+    if resolve_backend(backend, op="paged_decode") == "pallas":
         if page % 8 == 0:
             from gofr_tpu.ops.pallas import interpret_mode
             from gofr_tpu.ops.pallas.paged_decode import paged_decode_attention as pallas_paged

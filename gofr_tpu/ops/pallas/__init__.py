@@ -58,15 +58,20 @@ def kernel_platform() -> bool:
 
 
 def flash_attention_available() -> bool:
-    """Should ``backend='auto'`` pick the hand-written kernels?
+    """Should ``backend='auto'`` pick the hand-written kernels, absent a
+    per-op autotune decision?
 
-    Measured on TPU v5e (round 3, 1B llama): XLA beats the current kernels
-    on BOTH paths — decode 6.4k vs 4.6k tok/s @64 slots, prefill(512) 34.7k
-    vs 27.2k tok/s — so 'auto' defaults to XLA on hardware and the kernels
-    are opt-in via GOFR_PALLAS=1 until they win their A/B (re-run with
-    ``GOFR_BENCH_PALLAS_AB=1 python bench.py``). Interpreter tests still
-    exercise the kernels (GOFR_PALLAS_INTERPRET=1), and an explicit
-    ``backend='pallas'`` bypasses this gate entirely."""
+    This is the LAST stop in resolve_backend's precedence chain
+    (ops/attention.py): the decode ops prefer a warmup-autotune pin
+    (ops/autotune.py — measured per (op, shape, kv dtype, device_kind) on
+    the engine's real serving shapes) whenever one is in scope, and
+    GOFR_PALLAS, when explicitly set, overrides both. The static default
+    here encodes the round-3 v5e measurement: XLA beat the then-current
+    kernels on BOTH paths — decode 6.4k vs 4.6k tok/s @64 slots,
+    prefill(512) 34.7k vs 27.2k tok/s — so 'auto' falls back to XLA on
+    hardware. Interpreter tests still exercise the kernels
+    (GOFR_PALLAS_INTERPRET=1), and an explicit ``backend='pallas'``
+    bypasses this gate entirely."""
     if os.environ.get("GOFR_PALLAS", "") == "0":
         return False
     if interpret_mode():

@@ -11,8 +11,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as _pltpu
 
 NEG_INF = -1e30
+
+# jax renamed pltpu.TPUCompilerParams → CompilerParams (~0.5); same fields
+# either way. One shim here so every kernel works across the pin range.
+CompilerParams = getattr(_pltpu, "CompilerParams", None) or _pltpu.TPUCompilerParams
 
 
 def init_softmax_scratch(ki, acc_ref, m_ref, l_ref) -> None:
@@ -25,11 +30,18 @@ def init_softmax_scratch(ki, acc_ref, m_ref, l_ref) -> None:
         l_ref[...] = jnp.zeros_like(l_ref)
 
 
-def softmax_block_update(s, v, acc_ref, m_ref, l_ref) -> None:
+def softmax_block_update(s, v, acc_ref, m_ref, l_ref, v_scale=None) -> None:
     """One online-softmax step: fold masked scores ``s`` [rows, block_kv]
     (f32, masked entries == NEG_INF) and values ``v`` [block_kv, d] into the
     running (acc, m, l) scratch. Fully-masked-so-far rows keep l == 0 so the
-    final divide yields zeros, not NaN."""
+    final divide yields zeros, not NaN.
+
+    ``v_scale`` [block_kv] is the int8-KV dequant fold: per-position value
+    scales ride the probabilities before the PV contraction — the same
+    place the XLA path folds ``vs`` (ops.attention.decode_attention_q) —
+    so a quantized ``v`` stays int8 in HBM/VMEM and converts only at the
+    matmul input. The normalizer ``l`` is scale-free either way (it sums
+    the unscaled probabilities)."""
     m_prev = m_ref[:, :1]
     l_prev = l_ref[:, :1]
     m_next = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -41,8 +53,13 @@ def softmax_block_update(s, v, acc_ref, m_ref, l_ref) -> None:
     alpha = jnp.exp(m_prev - m_safe)  # rescale of previous blocks
     l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
+    if v_scale is None:
+        p_in, v_in = p.astype(v.dtype), v
+    else:
+        p_in = p * v_scale.astype(jnp.float32)[None, :]
+        v_in = v.astype(jnp.float32)
     pv = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        p_in, v_in, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     acc_ref[...] = acc_ref[...] * alpha + pv
     m_ref[...] = jnp.broadcast_to(m_next, m_ref.shape)

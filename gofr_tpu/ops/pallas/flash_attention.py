@@ -31,6 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from gofr_tpu.ops.pallas.common import (
     NEG_INF,
+    CompilerParams,
     init_softmax_scratch,
     softmax_block_update,
     softmax_finish,
@@ -172,7 +173,7 @@ def flash_attention(
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

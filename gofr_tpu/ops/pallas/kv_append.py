@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gofr_tpu.ops.pallas.common import CompilerParams
+
 
 def _pick_block(total: int, desired: int) -> int:
     if total <= desired:
@@ -98,7 +100,7 @@ def append_tokens_inplace(
         # inputs 3/4 are (k_layer, v_layer) AFTER the prefetch operand;
         # aliasing makes the untouched tiles true no-ops in HBM
         input_output_aliases={3: 0, 4: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -176,7 +178,7 @@ def append_tokens_paged_inplace(
             jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
         ],
         input_output_aliases={4: 0, 5: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

@@ -13,6 +13,16 @@ Grid: (slot, kv_head, logical_page); the page axis is ``arbitrary`` so the
 online-softmax scratch (common.py recurrence) carries across pages of one
 (slot, head). Unallocated logical pages (table entry == P) clamp to P-1 and
 are fully position-masked, contributing nothing.
+
+``paged_decode_attention_q`` is the fused int8-KV variant (ISSUE 6 /
+ROADMAP O3): quantized K/V pages plus their per-position scale planes
+stream straight out of the pool through the SAME scalar-prefetched block
+tables and dequantize in-kernel — ``ks`` multiplies the scores, ``vs``
+rides the probabilities inside the online-softmax recurrence (common.py),
+exactly where the XLA path folds them (ops.attention.decode_attention_q).
+No gather-materialized logical view exists anywhere: HBM traffic for the
+most bandwidth-bound op in the system stays int8 end to end, where the
+XLA fallback pays a full extra int8 round trip for the gather copy.
 """
 
 from __future__ import annotations
@@ -26,6 +36,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from gofr_tpu.ops.pallas.common import (
     NEG_INF,
+    CompilerParams,
     init_softmax_scratch,
     softmax_block_update,
     softmax_finish,
@@ -118,9 +129,121 @@ def paged_decode_attention(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((n, hkv, group, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(lengths.astype(jnp.int32), safe_table, q4, k_pool, v_pool)
+    return out.reshape(n, hq, d)
+
+
+def _paged_decode_q_kernel(
+    ln_ref,    # SMEM [N] per-slot live length (scalar prefetch)
+    table_ref, # SMEM [N, MaxP] block table (scalar prefetch)
+    q_ref,     # VMEM [1, 1, G, d]
+    k_ref,     # VMEM int8 [1, 1, page, d] — the physical page from index_map
+    v_ref,     # VMEM int8 [1, 1, page, d]
+    ks_ref,    # VMEM [1, 1, page] per-position K scales (same page pick)
+    vs_ref,    # VMEM [1, 1, page]
+    o_ref,     # VMEM [1, 1, G, d]
+    acc_ref,   # scratch f32 [G, d]
+    m_ref,     # scratch f32 [G, 128]
+    l_ref,     # scratch f32 [G, 128]
+    *,
+    scale: float,
+    page: int,
+    n_pages: int,
+    group: int,
+):
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+    init_softmax_scratch(pi, acc_ref, m_ref, l_ref)
+
+    q = q_ref[0, 0]                      # [G, d]
+    k = k_ref[0, 0].astype(q.dtype)      # int8 → compute dtype, in VMEM
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [G, page]
+    # K-scale fold: constant along the d reduction, so it multiplies the
+    # finished scores per key position (decode_attention_q order: scale
+    # before the mask, where a masked position's value is irrelevant).
+    s = s * ks_ref[0, 0].astype(jnp.float32)[None, :]
+
+    kv_pos = pi * page + jax.lax.broadcasted_iota(jnp.int32, (group, page), 1)
+    s = jnp.where(kv_pos < ln_ref[bi], s, NEG_INF)
+
+    # V-scale fold happens inside the recurrence (common.py): probabilities
+    # pick up vs before the PV matmul, v converts from int8 at the input.
+    softmax_block_update(s, v_ref[0, 0], acc_ref, m_ref, l_ref,
+                         v_scale=vs_ref[0, 0])
+
+    def write(out):
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+    softmax_finish(pi, n_pages, acc_ref, l_ref, write)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_decode_attention_q(
+    q: jnp.ndarray,        # [N, Hq, D]
+    kq_pool: jnp.ndarray,  # int8 [P, Hkv, page, D]
+    vq_pool: jnp.ndarray,  # int8 [P, Hkv, page, D]
+    ks_pool: jnp.ndarray,  # [P, Hkv, page] per-position K scales
+    vs_pool: jnp.ndarray,  # [P, Hkv, page]
+    table: jnp.ndarray,    # [N, MaxP] int32, OOB entries == P
+    lengths: jnp.ndarray,  # [N] live length per slot
+    *,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused single-step decode against the int8 paged pool → [N, Hq, D].
+
+    Same contract as ops.attention.paged_decode_attention_q, without the
+    gather: int8 pages and their scale rows are block-streamed per
+    (slot, head, logical page) and dequantized in-register."""
+    n, hq, d = q.shape
+    pool, hkv, page, _ = kq_pool.shape
+    _, maxp = table.shape
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not divisible by kv heads {hkv}")
+    group = hq // hkv
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+
+    q4 = q.reshape(n, hkv, group, d)
+    safe_table = jnp.minimum(table, pool - 1).astype(jnp.int32)
+
+    def kv_map(bi, hi, pi, ln_ref, table_ref):
+        return (table_ref[bi, pi], hi, 0, 0)
+
+    def sc_map(bi, hi, pi, ln_ref, table_ref):
+        return (table_ref[bi, pi], hi, 0)
+
+    kernel = functools.partial(
+        _paged_decode_q_kernel, scale=scale, page=page, n_pages=maxp, group=group
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(n, hkv, maxp),
+            in_specs=[
+                pl.BlockSpec((1, 1, group, d), lambda bi, hi, pi, ln, tb: (bi, hi, 0, 0)),
+                pl.BlockSpec((1, 1, page, d), kv_map),
+                pl.BlockSpec((1, 1, page, d), kv_map),
+                pl.BlockSpec((1, 1, page), sc_map),
+                pl.BlockSpec((1, 1, page), sc_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, group, d), lambda bi, hi, pi, ln, tb: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, d), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, hkv, group, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), safe_table, q4, kq_pool, vq_pool, ks_pool, vs_pool)
     return out.reshape(n, hq, d)

@@ -287,15 +287,24 @@ class _EngineBase:
     def _trace_scope(self):
         """Context every trace-driving section runs under: paged engines pin
         the KV append lowering they resolved at construction
-        (ops/paged.write_mode_scope), so no trace re-reads os.environ."""
+        (ops/paged.write_mode_scope), and generate engines pin the decode
+        attention backends their warmup autotuner measured
+        (ops/autotune.decision_scope) — so no trace re-reads os.environ and
+        every trace this engine drives resolves 'auto' the same way."""
         import contextlib
 
+        stack = contextlib.ExitStack()
         mode = getattr(self, "paged_kv_write", None)
         if mode:
             from gofr_tpu.ops.paged import write_mode_scope
 
-            return write_mode_scope(mode)
-        return contextlib.nullcontext()
+            stack.enter_context(write_mode_scope(mode))
+        pins = getattr(self, "_autotune_pins", None)
+        if pins:
+            from gofr_tpu.ops import autotune
+
+            stack.enter_context(autotune.decision_scope(pins))
+        return stack
 
     def _run(self) -> None:
         from gofr_tpu.ops.pallas import platform_hint
@@ -1095,6 +1104,14 @@ class GenerateEngine(_EngineBase):
         # packing runs on the device thread; the population is bounded
         # like _compiled (bucket ladder).
         self._staging_bufs: dict[tuple, tuple] = {}
+        # Warmup-time kernel-backend autotuner (ops/autotune.py; ROADMAP O3):
+        # {op: backend} pins consulted by every trace via _trace_scope, the
+        # report served at /debug/engine, and an injectable timer for
+        # CPU-safe unit tests. Empty until warmup() measures (or loads the
+        # GOFR_AUTOTUNE_CACHE entry for this exact shape/device).
+        self._autotune_pins: dict[str, str] = {}
+        self._autotune: dict | None = None
+        self._autotune_timer = None
         self._pending: list[tuple[Request, np.ndarray]] = []
         # prompts longer than the largest prefill bucket: admitted one at a
         # time and streamed into the cache chunk-by-chunk. Paged always
@@ -1177,8 +1194,12 @@ class GenerateEngine(_EngineBase):
         # traces on the caller thread could resolve kernels for the wrong
         # backend (e.g. Pallas for a CPU test mesh under an attached TPU),
         # and jit would cache that mis-resolved program per shape
-        with platform_hint(getattr(self.tpu, "platform", None)), self._trace_scope():
-            return self._warmup_traced(lbs, bbs)
+        with platform_hint(getattr(self.tpu, "platform", None)):
+            # backend autotune runs BEFORE the programs trace: the pins it
+            # produces are what _trace_scope makes the traces below see
+            self._autotune_backends()
+            with self._trace_scope():
+                return self._warmup_traced(lbs, bbs)
 
     def _warmup_traced(self, lbs: list[int], bbs: list[int]) -> int:
         count = 0
@@ -1280,6 +1301,125 @@ class GenerateEngine(_EngineBase):
                 self._compiled.add(("swapin", wb))
                 count += 1
         return count
+
+    def _autotune_backends(self) -> None:
+        """Measure Pallas vs XLA for this engine's decode attention op on
+        its REAL serving shapes and pin the winner for every trace
+        (ops/autotune.py; ROADMAP O3). Replaces the static GOFR_PALLAS
+        gate with a per-(op, shape, kv dtype, device_kind) decision, cached
+        across restarts via GOFR_AUTOTUNE_CACHE. Stands down when the
+        autotuner is disabled (GOFR_AUTOTUNE=0 / explicit GOFR_PALLAS /
+        interpreter mode) and under lockstep — a leader-only pin would make
+        leader and follower trace DIFFERENT decode programs, and the
+        announce protocol has no way to reproduce a timing on the
+        follower's behalf."""
+        from gofr_tpu.ops import autotune
+
+        if self.lockstep_role or self._autotune_pins or not autotune.enabled():
+            return
+        from gofr_tpu.ops import attention as attn_ops
+        from gofr_tpu.ops.pallas import kernel_platform
+
+        cfg = self.cfg
+        hq = getattr(cfg, "num_heads", 0)
+        hkv = getattr(cfg, "num_kv_heads", hq)
+        d = getattr(cfg, "head_size", None) or getattr(cfg, "head_dim", 0)
+        if not (hq and hkv and d):  # family exposes no GQA geometry
+            return
+        qdtype = getattr(cfg, "dtype", jnp.bfloat16)
+        devices = getattr(self.tpu, "devices", None)
+        kind = (getattr(devices[0], "device_kind", None) if devices
+                else None) or getattr(self.tpu, "platform", "cpu")
+        tuner = autotune.Autotuner(
+            device_kind=str(kind), cache_file=autotune.cache_path(),
+            timer=self._autotune_timer, logger=self.logger)
+        pallas_ok = kernel_platform()
+        t0 = time.monotonic()
+        n = self.num_slots
+
+        if self.kv_layout == "paged":
+            # Candidate inputs reuse the engine's own layer-0 pool planes
+            # (right per-shard shape AND dtype, no second pool in HBM) with
+            # a full-occupancy block table and full lengths — the worst-case
+            # stream each serving decode step pays.
+            maxp, page = self.pages_per_slot, self.page_size
+            pool = self.total_pages
+            rng = np.random.RandomState(0)
+            table = jnp.asarray(
+                rng.permutation(n * maxp)[: n * maxp] % max(pool, 1),
+                jnp.int32).reshape(n, maxp)
+            lengths = jnp.full((n,), maxp * page, jnp.int32)
+            q = jnp.asarray(rng.standard_normal((n, hq, d)), qdtype)
+            skey = autotune.shape_key(n, hq, hkv, d, page, maxp, pool)
+            if self.kv_quantize:
+                kq, vq = self.cache.k[0], self.cache.v[0]
+                ks, vs = self.cache.ks[0], self.cache.vs[0]
+                cands = {"xla": self._at_fn(
+                    attn_ops.paged_decode_attention_q, "xla",
+                    q, kq, vq, ks, vs, table, lengths)}
+                if pallas_ok and page % 8 == 0:
+                    cands["pallas"] = self._at_fn(
+                        attn_ops.paged_decode_attention_q, "pallas",
+                        q, kq, vq, ks, vs, table, lengths)
+                tuner.measure("paged_decode_q", skey, "int8", cands)
+            else:
+                kp, vp = self.cache.k[0], self.cache.v[0]
+                cands = {"xla": self._at_fn(
+                    attn_ops.paged_decode_attention, "xla",
+                    q, kp, vp, table, lengths)}
+                if pallas_ok and page % 8 == 0:
+                    cands["pallas"] = self._at_fn(
+                        attn_ops.paged_decode_attention, "pallas",
+                        q, kp, vp, table, lengths)
+                tuner.measure("paged_decode", skey, str(kp.dtype), cands)
+        elif not self.kv_quantize:
+            # slot layout, dense cache (the int8 slot path has no kernel
+            # variant to race). With spec on the cache is (kv, aux).
+            kv = self.cache[0] if isinstance(self.cache, tuple) else self.cache
+            kc, vc = kv.k[0], kv.v[0]
+            smax = kc.shape[2]
+            rng = np.random.RandomState(0)
+            q = jnp.asarray(rng.standard_normal((n, hq, d)), qdtype)
+            lengths = jnp.full((n,), smax, jnp.int32)
+            cands = {"xla": self._at_fn(
+                attn_ops.decode_attention, "xla", q, kc, vc, lengths)}
+            if pallas_ok:
+                # a block-ineligible Smax makes this candidate raise (the
+                # explicit-pallas contract) — the tuner records the error
+                # and XLA wins by disqualification
+                cands["pallas"] = self._at_fn(
+                    attn_ops.decode_attention, "pallas", q, kc, vc, lengths)
+            tuner.measure("decode", autotune.shape_key(n, hq, hkv, d, smax),
+                          str(kc.dtype), cands)
+
+        self._autotune_pins = tuner.pins()
+        self._autotune = {"elapsed_s": round(time.monotonic() - t0, 3),
+                          **tuner.report()}
+        autotune.set_last_report(self._autotune)
+        for op, rec in tuner.decisions.items():
+            # info-style gauge: 1 on the pinned (op, backend) pair, 0 on
+            # the loser so a re-tune never leaves both labels asserted
+            for b in ("pallas", "xla"):
+                self.metrics.set_gauge(
+                    "app_tpu_kernel_backend",
+                    1.0 if b == rec["backend"] else 0.0, op=op, backend=b)
+            self.logger.infof(
+                "autotune: %s -> %s (%s, shapes %s, %s)", op, rec["backend"],
+                rec["source"], rec["shape"], rec.get("timings_ms") or "untimed")
+
+    @staticmethod
+    def _at_fn(op_fn, backend: str, *arrays):
+        """A timed autotune candidate: the op jitted over REAL device-shaped
+        array arguments (arguments, not closure constants — XLA must not
+        fold the benchmark away) with the backend bound explicitly."""
+        jf = jax.jit(partial(op_fn, backend=backend))
+        return lambda: jf(*arrays)
+
+    def autotune_report(self) -> dict | None:
+        """The warmup autotuner's decision table (None until warmup ran or
+        when autotune is disabled) — surfaced at /debug/engine and recorded
+        in the bench JSON."""
+        return self._autotune
 
     def submit(
         self,

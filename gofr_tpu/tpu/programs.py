@@ -36,6 +36,13 @@ block-table columns for paged):
   history length | ``[2]`` temps (f32 bitcast) | ``[3, 0]`` rng step
   | ``[4:4+Wp]`` table.T | ``[4+Wp:]`` history.T. Inactive lanes ship
   hlen = Hcap + 1 AND an all-OOB table row.
+
+Backend resolution is a TRACE-time property of these programs: the decode
+attention ops inside them resolve ``backend="auto"`` when a program first
+traces (warmup), consulting the engine's pinned autotune decisions
+(ops/autotune.decision_scope, entered via ``engine._trace_scope``). A
+compiled program keeps whatever backend its trace resolved for its whole
+life — re-tuning means a new process, same as the KV write lowerings.
 """
 
 from __future__ import annotations

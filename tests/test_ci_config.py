@@ -120,6 +120,24 @@ def test_quick_tier_marker_coverage():
     assert len(marked) >= 5, f"quick tier shrank to {marked}"
 
 
+def test_kernel_autotune_suite_is_in_quick_tier():
+    """ISSUE 6 satellite: the fused int8 paged-decode parity tests and the
+    autotuner units (tests/test_autotune.py) must ride the `-m quick` CI
+    job on every push — interpreter-mode parity and fake-timer units are
+    CPU-safe by construction, so exemption would be a coverage hole."""
+    path = REPO / "tests" / "test_autotune.py"
+    assert path.exists(), "tests/test_autotune.py missing"
+    text = path.read_text()
+    assert "pytestmark = pytest.mark.quick" in text, (
+        "test_autotune.py must be quick-marked module-wide"
+    )
+    assert "test_autotune.py" not in QUICK_EXEMPT, (
+        "test_autotune.py must not be exempted from the quick tier"
+    )
+    # the two halves of ISSUE 6 are both present: kernel parity + autotuner
+    assert "paged_decode_q" in text and "Autotuner" in text
+
+
 def test_ci_has_py310_compat_gate():
     """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
     syntax (same-quote nested f-strings) passes every 3.12 job silently and
