@@ -33,6 +33,11 @@ Env knobs:
                               workload on the paged engine, three-way: cache
                               off / HBM-only / HBM+host spill tier (cold and
                               warm TTFT p50, per-tier hit tokens)
+    GOFR_BENCH_ROUTER         1 = also measure the multi-replica router A/B
+                              (gofr_tpu.router): 2 in-process replicas under
+                              a tenant-skewed shared-prefix workload, prefix-
+                              affinity vs random routing (aggregate req/s,
+                              warm-TTFT p50, prefix hit-token ratio per arm)
     GOFR_BENCH_PIPELINE       device pipeline depth (default 2; 1 = sync, up to 4)
     GOFR_BENCH_OVERLAP_AB     1 = also measure the mixed-arrival workload (paced
                               arrivals of short + chunked-long prompts) with the
@@ -683,6 +688,123 @@ def main() -> None:
                 pref_ab["hbm"]["warm_ttft_p50_s"]
                 / max(pref_ab["hbm_host"]["warm_ttft_p50_s"], 1e-9), 3)
         extra["prefix_ab"] = pref_ab
+
+    # multi-replica router A/B (ISSUE 7, ROADMAP O2): two in-process paged
+    # replicas behind the REAL routing decision plane (gofr_tpu.router —
+    # static two-member ring, no HTTP hop so the placement effect isn't
+    # buried under proxy overhead). Tenant-skewed workload: each tenant
+    # shares a multi-page prefix across its requests; the affinity arm
+    # hashes each request's prefix chain key onto the ring so a tenant's
+    # repeats land on the replica caching its prefix, the random arm
+    # scatters them. Reported per arm: aggregate req/s over the skewed
+    # wave, warm-TTFT p50 of per-tenant re-issues, and the prefix
+    # hit-token ratio (cache hit tokens / prompt tokens submitted).
+    if os.environ.get("GOFR_BENCH_ROUTER") == "1":
+        from gofr_tpu.router import Router, RouterPolicy
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        tenants = 6
+        ppage = 128 if cfg.max_seq_len >= 512 else 16
+        shared_pages = 4
+        tail = ppage // 2
+        r_new = min(max_new, 8)
+        n_router = max(2 * tenants, n_requests // 4)
+        r_slots = max(2, min(best[0], 4))
+        r_max_len = shared_pages * ppage + tail + r_new + 8
+        pages_per_slot = -(-(r_max_len + best[1]) // ppage)
+        # pool holds every tenant prefix + active slots: the A/B isolates
+        # PLACEMENT (which replica is warm), not cache-pressure effects
+        r_pages = r_slots * pages_per_slot + tenants * shared_pages
+        shared_r = [rng.randint(1, cfg.vocab_size, size=shared_pages * ppage).tolist()
+                    for _ in range(tenants)]
+        # zipf-ish tenant skew: tenant i draws with weight 1/(i+1)
+        weights = np.array([1.0 / (i + 1) for i in range(tenants)])
+        draws = rng.choice(tenants, size=n_router, p=weights / weights.sum())
+        rkw = dict(slots=r_slots, max_len=r_max_len,
+                   max_prefill_batch=prefill_batch, decode_chunk=best[1],
+                   prefill_buckets=[shared_pages * ppage + tail],
+                   decode_pipeline=pipeline, kv_layout="paged",
+                   page_size=ppage, total_pages=r_pages, prefix_cache=True)
+        router_ab: dict = {}
+        for mode in ("affinity", "random"):
+            policy = RouterPolicy(page_size=ppage, mode=mode, jitter_s=0.0,
+                                  replicas={"r0": "", "r1": ""}, seed=7)
+            router = Router(container, policy=policy)
+            hit0 = _counter_total(container, "app_tpu_prefix_hit_tokens")
+            replicas: dict = {}
+            try:
+                try:
+                    for n in ("r0", "r1"):
+                        # built incrementally INSIDE the try: if the second
+                        # engine fails to construct, the finally still stops
+                        # the first instead of leaking its device pages into
+                        # the next arm
+                        replicas[n] = GenerateEngine(llama, cfg, params,
+                                                     container, **rkw)
+                    for eng in replicas.values():
+                        eng.warmup()
+                        eng.start()
+
+                    placed = {"home": 0, "total": 0}
+
+                    def _route(prompt):
+                        rp = router.plan(router.shard_key(prompt))
+                        placed["total"] += 1
+                        placed["home"] += rp.targets[0].name == rp.home
+                        return replicas[rp.targets[0].name]
+
+                    prompt_toks = 0
+                    # skewed wave: concurrent, repeats per tenant (cold on
+                    # first touch, warm after) — the aggregate number
+                    wave = []
+                    for t in draws:
+                        p = shared_r[t] + rng.randint(
+                            1, cfg.vocab_size, size=tail).tolist()
+                        prompt_toks += len(p)
+                        wave.append(p)
+                    t0 = time.monotonic()
+                    reqs = [_route(p).submit(p, max_new_tokens=r_new,
+                                             timeout=timeout) for p in wave]
+                    for r in reqs:
+                        r.result(timeout)
+                    wave_elapsed = time.monotonic() - t0
+                    # warm probes: one fresh-tail re-issue per tenant,
+                    # sequential (no queueing confound) — TTFT is where
+                    # landing on the warm replica pays
+                    warm_ttfts = []
+                    for t in range(tenants):
+                        p = shared_r[t] + rng.randint(
+                            1, cfg.vocab_size, size=tail).tolist()
+                        prompt_toks += len(p)
+                        warm_ttfts.append(_route(p).generate(
+                            p, max_new_tokens=r_new, timeout=timeout)["ttft_s"])
+                finally:
+                    for eng in replicas.values():
+                        eng.stop()
+                hits = _counter_total(container, "app_tpu_prefix_hit_tokens") - hit0
+                router_ab[mode] = {
+                    "req_per_s": round(n_router / wave_elapsed, 2),
+                    "warm_ttft_p50_s": round(_percentile(warm_ttfts, 50), 4),
+                    "hit_token_ratio": round(hits / max(prompt_toks, 1), 4),
+                    "affinity_hit_ratio": round(
+                        placed["home"] / max(placed["total"], 1), 4),
+                }
+            except Exception as e:  # noqa: BLE001
+                router_ab[mode] = f"error: {e}"[:160]
+            finally:
+                router.stop()
+        router_ab["tenants"] = tenants
+        router_ab["requests"] = n_router
+        router_ab["shared_pages"] = shared_pages
+        if (isinstance(router_ab.get("affinity"), dict)
+                and isinstance(router_ab.get("random"), dict)):
+            router_ab["warm_ttft_speedup"] = round(
+                router_ab["random"]["warm_ttft_p50_s"]
+                / max(router_ab["affinity"]["warm_ttft_p50_s"], 1e-9), 3)
+            router_ab["hit_ratio_gain"] = round(
+                router_ab["affinity"]["hit_token_ratio"]
+                - router_ab["random"]["hit_token_ratio"], 4)
+        extra["router"] = router_ab
 
     # NB: on the CPU fallback the "device" compute runs on the same host
     # cores as the packing/readback, so overlap has nothing to hide behind

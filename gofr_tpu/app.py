@@ -38,7 +38,7 @@ from gofr_tpu.http.middleware import (
 )
 from gofr_tpu.http.request import HTTPRequest
 from gofr_tpu.http.responder import respond, to_json
-from gofr_tpu.http.streaming import StreamingResponse
+from gofr_tpu.http.streaming import RawStreamingResponse, StreamingResponse
 from gofr_tpu.websocket import ConnectionHub, WSConnection
 
 Handler = Callable[[Context], Any]
@@ -75,6 +75,8 @@ class App:
         self._runners: list[web.AppRunner] = []
         self._sub_threads: list[threading.Thread] = []
         self._sub_stop = threading.Event()
+        self._gossip = None  # GossipReporter once enable_router_gossip runs
+        self._cleanup: list[Callable[[], None]] = []
 
     # -- route registration (gofr.go:244-276) ----------------------------------
 
@@ -163,6 +165,30 @@ class App:
         controller = AdmissionController(policy, self.container.metrics, logger=self.logger)
         self.container.register_qos(controller)
         return controller
+
+    def enable_router_gossip(self, name: str | None = None, url: str | None = None,
+                             **kw: Any):
+        """Make this replica visible to a data-plane router tier
+        (gofr_tpu.router; docs/routing.md): a GossipReporter publishes this
+        process's health/epoch/shed snapshot on the pubsub backbone every
+        ``ROUTER_GOSSIP_INTERVAL_S``. Starts with ``run()`` (after the
+        engines), publishes a terminal DOWN at shutdown. Returns the
+        reporter, or None when no PUBSUB_BACKEND is wired."""
+        if self.container.pubsub is None:
+            self.logger.error("enable_router_gossip ignored: no PUBSUB_BACKEND configured")
+            return None
+        from gofr_tpu.router.gossip import GossipReporter
+
+        self._gossip = GossipReporter(
+            self.container, name=name,
+            url=url or f"http://127.0.0.1:{self.http_port}", **kw)
+        return self._gossip
+
+    def on_cleanup(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` during graceful shutdown, before the container closes
+        — how components bound to the app (the data-plane router's gossip
+        subscription, custom pollers) stop with it."""
+        self._cleanup.append(fn)
 
     # -- other entrypoints -----------------------------------------------------
 
@@ -339,13 +365,19 @@ class App:
                 err = e
                 if not hasattr(e, "status_code"):
                     self.logger.log_exception(e, f"handler {request.method} {request.path}")
+            if err is None and isinstance(result, RawStreamingResponse):
+                return await self._stream_raw(request, result)
             if err is None and isinstance(result, StreamingResponse):
                 return await self._stream_sse(request, result)
             wire = respond(result, err, request.method)
+            # a header-borne Content-Type (proxy Passthrough: the replica's
+            # verbatim value, parameters included) wins — aiohttp rejects
+            # parameterized values in the content_type argument
+            has_ct = any(k.lower() == "content-type" for k in wire.headers)
             return web.Response(
                 body=wire.body,
                 status=wire.status,
-                content_type=wire.content_type,
+                content_type=None if has_ct else wire.content_type,
                 headers=wire.headers,
             )
 
@@ -383,6 +415,41 @@ class App:
                 await resp.write(StreamingResponse.sse_error(str(e)))
             except Exception:  # noqa: BLE001 - client already gone
                 return resp
+        try:
+            await resp.write_eof()
+        except Exception:  # noqa: BLE001 - broken transport on eof
+            pass
+        return resp
+
+    async def _stream_raw(self, request: web.Request, stream: RawStreamingResponse) -> web.StreamResponse:
+        """Drive a RawStreamingResponse: write the handler's wire chunks
+        through verbatim (proxy passthrough — the router's SSE hop). Chunks
+        are pulled on the executor (the upstream read blocks); a client
+        disconnect closes the upstream iterator so the proxied transfer is
+        aborted, not drained."""
+        headers = {k: v for k, v in stream.headers.items()
+                   if k.lower() not in ("content-length", "transfer-encoding",
+                                        "connection", "content-encoding")}
+        if not any(k.lower() == "content-type" for k in headers):
+            headers["Content-Type"] = stream.content_type
+        resp = web.StreamResponse(status=stream.status, headers=headers)
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        sentinel = object()
+        try:
+            while True:
+                chunk = await loop.run_in_executor(self._executor, next, stream.iterator, sentinel)
+                if chunk is sentinel:
+                    break
+                if chunk:
+                    await resp.write(chunk)
+        except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+            stream.close()
+            raise
+        except Exception as e:  # noqa: BLE001 - upstream died mid-proxy; the
+            # status line is already on the wire, so all we can do is stop
+            self.logger.log_exception(e, "raw stream proxy")
+            stream.close()
         try:
             await resp.write_eof()
         except Exception:  # noqa: BLE001 - broken transport on eof
@@ -722,11 +789,21 @@ class App:
 
         self._start_subscribers()
         self.cron.start()
+        if self._gossip is not None:
+            # after the engines: the first snapshot reports real health
+            self._gossip.start()
 
         if ready is not None:
             ready.set()
         await self._shutdown.wait()
         self.logger.info("shutting down")
+        if self._gossip is not None:
+            self._gossip.stop()  # terminal DOWN leaves the router ring now
+        for fn in self._cleanup:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - one hook must not block the rest
+                self.logger.log_exception(e, "cleanup hook")
         self._sub_stop.set()
         self.cron.stop()
         if grpc_server is not None:

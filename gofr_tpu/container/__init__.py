@@ -136,6 +136,23 @@ class Container:
         m.new_gauge("app_tpu_kernel_backend",
                     "pinned attention-kernel backend per op (1 = op resolves "
                     "backend='auto' to this backend; labels: op, backend)")
+        # data-plane router (gofr_tpu.router, docs/routing.md): the
+        # front-end tier's routing/spillover/shed accounting — affinity hit
+        # ratio = routed_total{affinity="home"} / requests_total
+        m.new_counter("app_router_requests_total",
+                      "requests entering the router data plane (by qos_class)")
+        m.new_counter("app_router_routed_total",
+                      "requests proxied to a replica (replica; affinity = home|spill)")
+        m.new_counter("app_router_spilled_total",
+                      "requests that LANDED off their home replica (replica = home "
+                      "it left; reason: shedding/restart/down = plan-time exclusion, "
+                      "busy/error = the home's own 429/5xx/transport answer)")
+        m.new_counter("app_router_shed_total",
+                      "requests shed AT the router (qos_class; reason)")
+        m.new_gauge("app_router_ring_size",
+                    "replicas currently in the consistent-hash ring")
+        m.new_gauge("app_router_replicas_known",
+                    "replicas known to the router registry, any state")
         m.new_counter("app_tpu_spec_proposed", "draft tokens proposed by speculative decoding")
         m.new_counter("app_tpu_spec_accepted", "draft tokens accepted by target verification")
         # SLO latency family (docs/observability.md): recorded by the engine

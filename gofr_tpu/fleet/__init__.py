@@ -52,8 +52,24 @@ __all__ = [
     "FleetLeaderChannel",
     "FleetProtocolError",
     "Supervisor",
+    "epoch_of",
     "fingerprint_of",
 ]
+
+
+def epoch_of(engine) -> int:
+    """Membership/restart generation of ``engine``, for the router's
+    health/epoch gossip (router/gossip.py): the fleet epoch when the engine
+    fronts a fleet (leader lockstep epoch — bumped at every membership
+    change and every warm rejoin), else its device-loop restart count.
+    Both move exactly when the replica's per-epoch device state was
+    rebuilt, which is what ring re-admission keys on (router/registry.py:
+    a replica dropped during its restart window must come back at a
+    strictly bumped epoch). Max of the two on a fleet leader: a restart IS
+    an epoch bump there, but the counters can briefly disagree mid-window."""
+    ls = getattr(engine, "_ls", None)
+    epoch = int(getattr(ls, "epoch", 0) or 0)
+    return max(epoch, int(getattr(engine, "_restarts", 0) or 0))
 
 
 @dataclass

@@ -62,6 +62,9 @@ class HTTPRequest(Request):
         self.body = body
         self.remote = remote
         self.route_template = route_template or path
+        # kept verbatim alongside the parsed form: a proxy tier (router
+        # data plane) must forward the query string byte-identical
+        self.query_string = query_string
         self._query = parse_qs(query_string, keep_blank_values=True)
         self._path_params = dict(path_params or {})
         self._ctx: dict[str, Any] = {}

@@ -14,7 +14,7 @@ import json
 from typing import Any
 
 from gofr_tpu.http.errors import retry_after_hint, status_of
-from gofr_tpu.http.responses import File, Raw, Redirect, Response
+from gofr_tpu.http.responses import File, Passthrough, Raw, Redirect, Response
 
 
 def _default(o: Any) -> Any:
@@ -60,6 +60,10 @@ def respond(result: Any, err: BaseException | None, method: str = "GET") -> Wire
             headers["Retry-After"] = retry_after_hint(retry_after)
         return WireResponse(status, to_json({"error": {"message": message}}), headers=headers)
 
+    if isinstance(result, Passthrough):
+        return WireResponse(result.status_code, result.body,
+                            content_type=result.content_type,
+                            headers=dict(result.headers))
     if isinstance(result, Redirect):
         return WireResponse(result.status_code, b"", headers={"Location": result.url})
     if isinstance(result, File):

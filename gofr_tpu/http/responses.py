@@ -38,3 +38,16 @@ class Response:
     data: object
     status_code: int | None = None
     headers: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Passthrough:
+    """Verbatim wire response: raw body bytes with explicit status, content
+    type and headers, no envelope — what a proxy tier (router data plane)
+    returns so a replica's response, its ``Retry-After``/``X-Trace-Id``
+    headers included, reaches the client byte-identical."""
+
+    body: bytes
+    status_code: int = 200
+    content_type: str = "application/json"
+    headers: dict[str, str] = field(default_factory=dict)

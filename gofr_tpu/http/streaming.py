@@ -46,3 +46,33 @@ class StreamingResponse:
         streamed piece whose TEXT is '{"done": true}' encodes to a JSON
         string and stays unambiguously data."""
         return json.dumps(item)
+
+
+class RawStreamingResponse:
+    """Raw-bytes streaming passthrough: the handler supplies an iterator of
+    wire chunks plus the status/headers to send, and the app writes them
+    through verbatim — no SSE encoding, no envelope. This is the proxy
+    shape (router data plane forwarding a replica's SSE stream): the
+    upstream bytes, event framing included, reach the client as produced.
+
+    ``close`` (or the iterator's own ``close``) is invoked when the client
+    disconnects mid-stream, so the proxied upstream transfer is aborted
+    instead of draining to a ghost."""
+
+    def __init__(self, iterator: Iterable[bytes], *, status: int = 200,
+                 headers: dict[str, str] | None = None,
+                 content_type: str = "application/octet-stream",
+                 close: Any = None):
+        self.iterator: Iterator[bytes] = iter(iterator)
+        self.status = int(status)
+        self.headers = dict(headers or {})
+        self.content_type = content_type
+        self._close = close
+
+    def close(self) -> None:
+        for closer in (self._close, getattr(self.iterator, "close", None)):
+            if callable(closer):
+                try:
+                    closer()
+                except Exception:  # noqa: BLE001 - teardown must not mask the cause
+                    pass

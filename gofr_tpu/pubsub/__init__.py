@@ -79,8 +79,11 @@ class PubSub(Protocol):
         consumer side through ``Message.param``."""
         ...
 
-    def subscribe(self, topic: str, group: str = "") -> Message | None:
-        """Block until the next message for ``topic`` (None on shutdown)."""
+    def subscribe(self, topic: str, group: str = "", timeout: float | None = None) -> Message | None:
+        """Block until the next message for ``topic`` (None on shutdown).
+        ``timeout`` (supported by every in-tree broker; the app's
+        subscriber loop and the router's gossip loop poll with it) bounds
+        the wait and returns None on expiry."""
         ...
 
     def health_check(self) -> dict[str, Any]: ...

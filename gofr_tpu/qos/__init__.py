@@ -78,7 +78,11 @@ DEFAULT_CLASSES = (
 class QoSPolicy:
     """Declarative QoS policy (config keys in parentheses; docs/qos.md).
 
-    ``classes`` must be rank-ordered, highest priority first."""
+    ``classes`` must be rank-ordered, highest priority first. The policy
+    is also the class vocabulary OUTSIDE this process: the data-plane
+    router (gofr_tpu.router) builds one from the same config to resolve
+    ``X-QoS-Class`` and decide spillover, so router and replicas agree on
+    what an unknown class means (docs/routing.md)."""
 
     classes: list[PriorityClass] = field(default_factory=lambda: list(DEFAULT_CLASSES))
     default_class: str = "default"          # QOS_DEFAULT_CLASS

@@ -1,0 +1,498 @@
+"""Prefix-affinity data-plane router: one endpoint in front of N replicas.
+
+ROADMAP O2's "millions of users" tier. Each replica is a full App+engine
+process; the router is a thin front-end App whose handlers proxy
+admissions to replicas chosen by a consistent hash over the request's
+prompt-prefix CHAIN KEY — computed router-side with the exact
+page-granular token-bytes hashing the replica's prefix cache uses
+(``tpu.prefix.chain_key``; stable blake2b, so router and replicas agree
+across processes). Repeat tenants therefore land on the replica that
+already holds their cached prefix pages (PR 4's hierarchical cache), and
+a replica's warm state compounds instead of being sprayed away.
+
+Pieces:
+
+- :mod:`gofr_tpu.router.ring` — the consistent-hash ring (vnode points;
+  removal moves only the leaving replica's keys);
+- :mod:`gofr_tpu.router.registry` — replica records + the ring-membership
+  state machine, fed by health/epoch gossip;
+- :mod:`gofr_tpu.router.gossip` — the replica-side reporter
+  (``app.enable_router_gossip()``) publishing over the pubsub backbone;
+- this module — ``RouterPolicy`` (ROUTER_* config) and ``Router``: the
+  routing decision (``plan``) plus the HTTP data plane (``handle``/
+  ``bind``): header-preserving proxying, SSE streaming passthrough via
+  ``service.HTTPService(stream=True)`` → ``RawStreamingResponse``,
+  traceparent forwarding so the replica span parents under the router
+  span, ``app_router_*`` metrics and the ``/debug/router`` flight view.
+
+QoS-aware spillover (docs/routing.md): when a request's HOME replica is
+shedding (QoS 429/503 from PR 1) or inside its restart window (PR 5),
+classes in ``ROUTER_SPILL_CLASSES`` spill to the next replicas in ring
+order; lower classes are shed AT the router with 503 + Retry-After —
+the home replica's own Retry-After hint when it answered, the gossiped
+hint otherwise — so overload semantics survive the extra hop end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from gofr_tpu.http.errors import ServiceUnavailable
+from gofr_tpu.http.responses import Passthrough, Raw
+from gofr_tpu.http.streaming import RawStreamingResponse
+from gofr_tpu.qos import QoSPolicy
+from gofr_tpu.router.gossip import DEFAULT_TOPIC, GossipReporter
+from gofr_tpu.router.registry import Replica, ReplicaRegistry
+from gofr_tpu.router.ring import HashRing, hash_point
+from gofr_tpu.service import ServiceError
+from gofr_tpu.tpu import prefix
+
+__all__ = ["GossipReporter", "HashRing", "Replica", "ReplicaRegistry",
+           "Router", "RouterPolicy"]
+
+# hop-by-hop / transport-owned headers, never proxied in either direction
+_HOP_HEADERS = {"host", "content-length", "connection", "keep-alive",
+                "transfer-encoding", "upgrade", "accept-encoding",
+                "content-encoding", "te", "trailer", "proxy-connection",
+                "date", "server"}
+
+
+@dataclass
+class RouterPolicy:
+    """Declarative router policy (config keys in docs/configs.md)."""
+
+    page_size: int = 128                 # ROUTER_PAGE_SIZE — MUST match the replicas'
+    key_pages: int = 1                   # ROUTER_KEY_PAGES (shard-key chain depth)
+    key_field: str = "prompt"            # ROUTER_KEY_FIELD (JSON body field)
+    vnodes: int = 64                     # ROUTER_VNODES
+    mode: str = "affinity"               # ROUTER_MODE: affinity | random (A/B arm)
+    spill_classes: tuple = ("interactive", "default")  # ROUTER_SPILL_CLASSES
+    max_spill: int = 1                   # ROUTER_MAX_SPILL (ring successors tried)
+    retry_after_s: float = 1.0           # ROUTER_RETRY_AFTER_S (shed hint fallback)
+    ttl_s: float = 3.0                   # ROUTER_TTL_S (gossip silence → out of ring)
+    jitter_s: float = 2.0                # ROUTER_REJOIN_JITTER_S (anti-stampede)
+    proxy_timeout_s: float = 120.0       # ROUTER_PROXY_TIMEOUT_S
+    topic: str = DEFAULT_TOPIC           # ROUTER_GOSSIP_TOPIC
+    group: str = ""                      # ROUTER_GOSSIP_GROUP ('' = unique per router)
+    replicas: dict[str, str] = field(default_factory=dict)  # ROUTER_REPLICAS static seed
+    seed: int = 0                        # ROUTER_SEED (random-mode determinism)
+
+    @classmethod
+    def from_config(cls, config, **overrides: Any) -> "RouterPolicy":
+        kw: dict[str, Any] = {
+            "page_size": config.get_int("ROUTER_PAGE_SIZE", 128),
+            "key_pages": max(1, config.get_int("ROUTER_KEY_PAGES", 1)),
+            "key_field": config.get_or_default("ROUTER_KEY_FIELD", "prompt"),
+            "vnodes": config.get_int("ROUTER_VNODES", 64),
+            "mode": config.get_or_default("ROUTER_MODE", "affinity"),
+            "max_spill": config.get_int("ROUTER_MAX_SPILL", 1),
+            "retry_after_s": config.get_float("ROUTER_RETRY_AFTER_S", 1.0),
+            "ttl_s": config.get_float("ROUTER_TTL_S", 3.0),
+            "jitter_s": config.get_float("ROUTER_REJOIN_JITTER_S", 2.0),
+            "proxy_timeout_s": config.get_float("ROUTER_PROXY_TIMEOUT_S", 120.0),
+            "topic": config.get_or_default("ROUTER_GOSSIP_TOPIC", DEFAULT_TOPIC),
+            "group": config.get_or_default("ROUTER_GOSSIP_GROUP", ""),
+            "seed": config.get_int("ROUTER_SEED", 0),
+        }
+        spill = config.get_or_default("ROUTER_SPILL_CLASSES", "interactive,default")
+        kw["spill_classes"] = tuple(s.strip() for s in spill.split(",") if s.strip())
+        reps = config.get_or_default("ROUTER_REPLICAS", "")
+        if reps:
+            # "name=http://host:port,name2=..." — static seed for ringless
+            # bring-up; gossip refines health once it flows
+            kw["replicas"] = dict(part.split("=", 1) for part in reps.split(",") if "=" in part)
+        kw.update(overrides)
+        if kw["mode"] not in ("affinity", "random"):
+            raise ValueError(f"ROUTER_MODE {kw['mode']!r}: use 'affinity' or 'random'")
+        return cls(**kw)
+
+
+@dataclass
+class RoutePlan:
+    """One admission's routing decision (pure — no I/O): the replicas to
+    try in order, or the router-side shed verdict."""
+
+    key: int
+    qos_class: str
+    spillable: bool
+    home: str | None                    # affinity home (full ring), if any
+    targets: list[Replica]              # try order; empty iff shed is set
+    shed: tuple[str, float] | None = None  # (reason, retry_after_s)
+    spill_reason: str | None = None     # why the home was excluded upfront
+
+
+class Router:
+    """The front-end tier: decision plane + HTTP data plane. Create one per
+    router process, ``bind()`` it to an App (or call ``handle`` from your
+    own routes), and point replicas' ``enable_router_gossip()`` at the same
+    pubsub backbone."""
+
+    def __init__(self, container, policy: RouterPolicy | None = None,
+                 qos_policy: QoSPolicy | None = None, **overrides: Any):
+        self.container = container
+        self.policy = policy if policy is not None else RouterPolicy.from_config(
+            container.config, **overrides)
+        self.qos_policy = qos_policy or QoSPolicy.from_config(container.config)
+        self.ring = HashRing(self.policy.vnodes)
+        self.registry = ReplicaRegistry(
+            self.ring, metrics=container.metrics, logger=container.logger,
+            ttl_s=self.policy.ttl_s, jitter_s=self.policy.jitter_s)
+        for name, url in self.policy.replicas.items():
+            self.registry.add_static(name, url)
+        self._rng = random.Random(self.policy.seed)
+        self._clients: dict[str, Any] = {}
+        self._retired: list[Any] = []  # displaced clients, closed at stop()
+        self._lock = threading.Lock()
+        self._decisions: deque = deque(maxlen=256)
+        self._stats = {"requests": 0, "home": 0, "spill": 0, "shed": 0}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- keys ------------------------------------------------------------------
+
+    def shard_key(self, tokens) -> int:
+        """Stable shard key of a token prompt: the chain key of its
+        ``key_pages``-th full page (the same value the replica's prefix
+        cache stores for that node — tpu/prefix.py), falling back to a
+        digest of the raw token bytes for sub-page prompts."""
+        # truncate BEFORE hashing: only the first key_pages pages feed the
+        # shard key, and this runs per request on the proxy's hot path —
+        # digesting a long prompt's remaining pages would be pure waste
+        arr = np.asarray(tokens)[: self.policy.key_pages * self.policy.page_size]
+        keys = prefix.chain_keys(arr, self.policy.page_size)
+        if keys:
+            return keys[-1]
+        return prefix.chain_key(
+            prefix._ROOT, np.ascontiguousarray(arr, dtype=np.int32).tobytes())
+
+    def request_key(self, req) -> int:
+        """Shard key of an HTTP request: token-prefix chain key when the
+        JSON body carries ``key_field`` (ids or text), else a digest of the
+        raw body — unkeyable requests still distribute uniformly."""
+        body = getattr(req, "body", b"") or b""
+        try:
+            data = json.loads(body) if body else None
+        except (ValueError, UnicodeDecodeError):
+            data = None
+        val = data.get(self.policy.key_field) if isinstance(data, dict) else None
+        if isinstance(val, str) and val:
+            # bounded text prefix (≈4 chars/token), mirroring the token
+            # path's key_pages truncation: prompts sharing a long preamble
+            # but differing tails must still share a shard key
+            return hash_point(
+                val[: self.policy.key_pages * self.policy.page_size * 4].encode())
+        if isinstance(val, (list, tuple)) and val:
+            try:
+                return self.shard_key(val)
+            except (ValueError, TypeError, OverflowError):
+                pass
+        return hash_point(body or getattr(req, "path", "/").encode())
+
+    # -- decision plane --------------------------------------------------------
+
+    def plan(self, key: int, cls_name: str | None = None) -> RoutePlan:
+        qos_class = self.qos_policy.resolve(cls_name).name
+        spillable = qos_class in self.policy.spill_classes
+        self.registry.sweep()
+        # affinity home comes from the FULL ring (live + restart-window
+        # members): a key whose home is mid-restart must shed its low
+        # classes rather than silently pile onto the successor
+        full = self.registry.full.lookup(key, 1)
+        home = full[0] if full else None
+        if self.policy.mode == "random":
+            live = self.ring.members()
+            if live:
+                live = self._rng.sample(live, len(live))
+        else:
+            # hot path: only home + spill candidates are ever used — the
+            # +2 slack absorbs shedding-filtered candidates without paying
+            # a full vnode walk under the ring lock per admission
+            live = self.ring.lookup(key, n=1 + self.policy.max_spill + 2)
+        home_r = self.registry.get(home) if home else None
+        home_live = home_r is not None and home_r.in_ring and not home_r.shedding
+        if home_live and self.policy.mode == "affinity":
+            targets = [home_r]
+            if spillable:
+                spares = [self.registry.get(n) for n in live if n != home]
+                targets += [r for r in spares
+                            if r is not None and not r.shedding][: self.policy.max_spill]
+            return RoutePlan(key, qos_class, spillable, home, targets)
+        if self.policy.mode == "random":
+            targets = [self.registry.get(n) for n in live[: 1 + self.policy.max_spill]]
+            targets = [r for r in targets if r is not None]
+            if targets:
+                return RoutePlan(key, qos_class, spillable, home, targets)
+        else:
+            # home shedding / restarting / absent
+            if spillable:
+                spares = [self.registry.get(n) for n in live if n != home]
+                targets = [r for r in spares if r is not None and not r.shedding]
+                if not targets:  # everyone advisory-shedding: their own QoS decides
+                    targets = [r for r in spares if r is not None]
+                if not targets and home_r is not None and home_r.in_ring:
+                    targets = [home_r]  # home shedding but alive: let it answer
+                if targets:
+                    return RoutePlan(key, qos_class, spillable, home,
+                                     targets[: self.policy.max_spill + 1],
+                                     spill_reason=self._home_reason(home_r))
+        # nothing to try — or a LOW class whose home is shedding/restarting:
+        # shed AT the router (tentpole policy), with the home's gossiped
+        # Retry-After hint riding out so backpressure survives the hop
+        reason = self._home_reason(home_r) or "no_replicas"
+        retry_after = self.policy.retry_after_s
+        if home_r is not None:
+            retry_after = home_r.retry_after or retry_after
+        return RoutePlan(key, qos_class, spillable, home, [],
+                         shed=(reason, retry_after))
+
+    @staticmethod
+    def _home_reason(home_r: Replica | None) -> str | None:
+        """Why a request could not go to its home replica (None = it can)."""
+        if home_r is None:
+            return None
+        if home_r.restarting or home_r.drop_reason == "restart":
+            return "restart"
+        if home_r.shedding:
+            return "shedding"
+        if not home_r.in_ring:
+            return "down"
+        return None
+
+    # -- data plane ------------------------------------------------------------
+
+    def handle(self, ctx):
+        """Proxy one admission (register as an App handler via ``bind``).
+        Raises typed HTTP errors for router-side sheds; returns
+        ``Passthrough`` (buffered) or ``RawStreamingResponse`` (SSE) for
+        replica answers — headers, Retry-After included, preserved."""
+        req = ctx.request
+        cls_name = ctx.header(self.qos_policy.class_header)
+        key = self.request_key(req)
+        p = self.plan(key, cls_name)
+        m = self.container.metrics
+        m.increment_counter("app_router_requests_total", 1, qos_class=p.qos_class)
+        with self._lock:
+            self._stats["requests"] += 1
+        if p.shed is not None:
+            reason, retry_after = p.shed
+            m.increment_counter("app_router_shed_total", 1,
+                                qos_class=p.qos_class, reason=reason)
+            self._record(p, sent=None, outcome=f"shed:{reason}")
+            with self._lock:
+                self._stats["shed"] += 1
+            raise ServiceUnavailable(
+                f"home replica unavailable ({reason}); retry later",
+                retry_after=retry_after)
+        headers = self._forward_headers(req, ctx.span)
+        path = req.path + (f"?{req.query_string}" if getattr(req, "query_string", "") else "")
+        last_error: Exception | None = None
+        moved_reason: str | None = None  # why the HOME was abandoned mid-loop
+        for i, rep in enumerate(p.targets):
+            client = self._client(rep)
+            try:
+                resp = client.request(req.method, path, body=req.body or None,
+                                      headers=headers, stream=True)
+            except ServiceError as e:
+                last_error = e
+                if rep.name == p.home:
+                    moved_reason = "error"
+                continue
+            if resp.status_code == 429 or resp.status_code >= 500:
+                if i + 1 < len(p.targets):
+                    # replica-side overload/failure: spill to the next ring
+                    # replica (spillable classes have successors planned)
+                    resp.close()
+                    if rep.name == p.home:
+                        moved_reason = "busy"
+                    continue
+                # terminal target: the replica's own 429/503 (Retry-After
+                # intact) or 5xx passes through — never remapped
+            return self._finish(p, rep, resp, moved_reason)
+        self._record(p, sent=None, outcome="error")
+        with self._lock:
+            self._stats["shed"] += 1
+        m.increment_counter("app_router_shed_total", 1,
+                            qos_class=p.qos_class, reason="error")
+        raise ServiceUnavailable(
+            f"no replica accepted the request ({last_error})",
+            retry_after=self.policy.retry_after_s)
+
+    def _finish(self, p: RoutePlan, rep: Replica, resp, moved_reason: str | None = None):
+        m = self.container.metrics
+        affinity = "home" if rep.name == p.home else "spill"
+        m.increment_counter("app_router_routed_total", 1,
+                            replica=rep.name, affinity=affinity)
+        if affinity == "spill" and self.policy.mode == "affinity" and p.home:
+            # counted ONCE, at the landing: the replica label is the home
+            # the request left, the reason why it left (plan-time exclusion
+            # or the home's in-band 429/5xx/transport answer)
+            m.increment_counter(
+                "app_router_spilled_total", 1, replica=p.home,
+                reason=p.spill_reason or moved_reason or "out_of_ring")
+        with self._lock:
+            self._stats["home" if affinity == "home" else "spill"] += 1
+        self._record(p, sent=rep.name, outcome=str(resp.status_code))
+        ctype = resp.headers.get("content-type", "application/octet-stream")
+        # the replica's Content-Type rides in the headers VERBATIM — its
+        # parameters (charset, multipart boundary) must survive the hop;
+        # the bare type below is only for the SSE/buffered routing decision
+        out_headers = {k: v for k, v in resp.headers.items()
+                       if k.lower() not in _HOP_HEADERS}
+        bare_type = ctype.split(";")[0].strip()
+        if bare_type == "text/event-stream":
+            # streaming passthrough: upstream SSE bytes flow through as
+            # produced; a client disconnect closes the upstream transfer
+            return RawStreamingResponse(
+                resp.iter_content(), status=resp.status_code,
+                headers=out_headers, content_type=bare_type, close=resp.close)
+        return Passthrough(resp.read(), status_code=resp.status_code,
+                           content_type=bare_type, headers=out_headers)
+
+    def _forward_headers(self, req, span) -> dict[str, str]:
+        headers = {k: v for k, v in (getattr(req, "headers", None) or {}).items()
+                   if k.lower() not in _HOP_HEADERS}
+        remote = getattr(req, "remote", "")
+        if remote:
+            # scan case-insensitively: HTTPRequest stores lowercase keys,
+            # other callers may not — the chain must merge, not duplicate
+            prior = ""
+            for k in [k for k in headers if k.lower() == "x-forwarded-for"]:
+                prior = headers.pop(k)
+            headers["X-Forwarded-For"] = f"{prior}, {remote}".lstrip(", ")
+        if span is not None:
+            # the replica's server span must parent under THIS hop's span,
+            # not the client's original — one trace, correctly nested
+            headers["traceparent"] = span.traceparent()
+        return headers
+
+    def _client(self, rep: Replica):
+        from gofr_tpu.service import HTTPService
+
+        base = (rep.url or "").rstrip("/")
+        with self._lock:
+            c = self._clients.get(rep.name)
+            if c is None or c.base_url != base:
+                if c is not None:
+                    # NOT closed here: another handler thread may still be
+                    # proxying a stream through it — retire it and close at
+                    # router stop() instead of aborting in-flight transfers
+                    self._retired.append(c)
+                c = HTTPService(base, self.container.logger, self.container.metrics,
+                                timeout=self.policy.proxy_timeout_s)
+                self._clients[rep.name] = c
+            return c
+
+    def _record(self, p: RoutePlan, sent: str | None, outcome: str) -> None:
+        with self._lock:  # debug_view iterates this deque under the lock
+            self._decisions.append({
+                "t": round(time.time(), 3), "key": f"{p.key:016x}",
+                "qos_class": p.qos_class, "home": p.home, "sent": sent,
+                "outcome": outcome,
+            })
+
+    # -- gossip subscription ---------------------------------------------------
+
+    def start(self) -> "Router":
+        """Subscribe to replica gossip on the container's pubsub backbone
+        (no-op without one — static ROUTER_REPLICAS still route)."""
+        if self._thread is not None or self.container.pubsub is None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._gossip_loop, daemon=True,
+                                        name="gofr-router-gossip")
+        self._thread.start()
+        return self
+
+    def _gossip_loop(self) -> None:
+        ps = self.container.pubsub
+        # unique default group: EVERY router instance sees every gossip
+        # message (consumer groups split a topic; health must not be split)
+        group = self.policy.group or f"router-{os.getpid()}-{id(self) & 0xFFFF:04x}"
+        while not self._stop.is_set():
+            try:
+                msg = ps.subscribe(self.policy.topic, group=group, timeout=0.5)
+            except Exception as e:  # noqa: BLE001 - broker blip; keep the ring serving
+                self.container.logger.warnf("router gossip subscribe failed: %r", e)
+                self._stop.wait(1.0)
+                continue
+            if msg is None:
+                self.registry.sweep()  # TTL expiry needs no traffic
+                # a CLOSED broker returns None immediately instead of
+                # blocking out its timeout — without this wait the loop
+                # would spin a full core from broker close to stop()
+                self._stop.wait(0.05)
+                continue
+            try:
+                data = msg.bind(dict)
+                ts = data.get("ts")
+                # durable brokers (pubsub/file.py) replay the topic's history
+                # to a fresh consumer group: snapshots much older than any
+                # liveness window are boot-time replay, not current state —
+                # applying them would admit dead URLs until fresh gossip
+                # lands. Threshold is generous (3×TTL, ≥30s) so ordinary
+                # publisher/router clock skew cannot mute live gossip.
+                if (isinstance(ts, (int, float))
+                        and time.time() - ts > max(3 * self.policy.ttl_s, 30.0)):
+                    msg.commit()
+                    continue
+                self.registry.observe(data)
+            except Exception as e:  # noqa: BLE001 - malformed gossip is dropped
+                self.container.logger.warnf("router gossip message ignored: %r", e)
+            msg.commit()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=3.0)
+        with self._lock:
+            clients = list(self._clients.values()) + self._retired
+            self._clients, self._retired = {}, []
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- app binding / observability -------------------------------------------
+
+    def bind(self, app, routes: list[tuple[str, str]] | None = None) -> "Router":
+        """Register the proxy on ``app``: every (method, path) in
+        ``routes`` (default: POST /generate and POST /generate/stream)
+        proxies through ``handle``; APP_ENV=DEBUG adds the /debug/router
+        flight view. Starts the gossip subscription."""
+        for method, route_path in routes or (("POST", "/generate"),
+                                             ("POST", "/generate/stream")):
+            app.add_route(method, route_path, self.handle)
+        if app._debug_env():
+            # same envelope as /debug/requests and /debug/engine
+            app.get("/debug/router", lambda _ctx: Raw({"data": self.debug_view()}))
+        app.on_cleanup(self.stop)  # the gossip thread dies with the app
+        return self.start()
+
+    def debug_view(self) -> dict[str, Any]:
+        """The /debug/router payload: ring membership, per-replica state,
+        decision counters (affinity hit ratio), recent routing decisions."""
+        with self._lock:
+            stats = dict(self._stats)
+            decisions = list(self._decisions)
+        routed = stats["home"] + stats["spill"]
+        stats["affinity_hit_ratio"] = (
+            round(stats["home"] / routed, 4) if routed else None)
+        return {
+            "mode": self.policy.mode,
+            "ring": self.ring.members(),
+            "ring_size": len(self.ring),
+            "replicas": self.registry.snapshot(),
+            "stats": stats,
+            "decisions": decisions,
+        }

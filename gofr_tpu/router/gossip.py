@@ -1,0 +1,116 @@
+"""Replica-side health/epoch gossip over the pubsub backbone.
+
+Every replica process runs one ``GossipReporter``: a daemon thread that
+publishes a compact liveness snapshot to ``ROUTER_GOSSIP_TOPIC`` every
+``ROUTER_GOSSIP_INTERVAL_S`` — the feed ``Router``'s registry consumes
+(router/registry.py has the ring-membership state machine). The message
+rides the same broker the app already uses for work distribution
+(``PUBSUB_BACKEND``: inmemory for tests, file for multi-process on one
+host, kafka/gcp beyond), so the router tier needs no new transport.
+
+Snapshot schema (one JSON object per message):
+
+    replica     stable replica name (defaults to APP_NAME)
+    url         base URL the router proxies to
+    status      UP | DEGRADED | DOWN — worst engine health
+    epoch       max fleet/restart epoch over engines (fleet.epoch_of)
+    restarting  any engine inside its PR 5 crash-recovery window
+    shedding    QoS shed within its window (AdmissionController.shedding)
+    retry_after backoff hint (s) for router-side sheds while unavailable
+    seq, ts     per-reporter sequence + wall clock (debug only)
+
+``stop()`` publishes a terminal ``DOWN`` so graceful shutdown leaves the
+ring immediately instead of waiting out the router's gossip TTL.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from gofr_tpu.fleet import epoch_of
+
+DEFAULT_TOPIC = "gofr.router.gossip"
+
+
+class GossipReporter:
+    def __init__(self, container, name: str | None = None, url: str = "", *,
+                 topic: str | None = None, interval_s: float | None = None,
+                 retry_after_s: float = 1.0):
+        self.container = container
+        conf = container.config
+        self.name = name or container.app_name
+        self.url = url
+        self.topic = topic or conf.get_or_default("ROUTER_GOSSIP_TOPIC", DEFAULT_TOPIC)
+        self.interval_s = (float(interval_s) if interval_s is not None
+                           else conf.get_float("ROUTER_GOSSIP_INTERVAL_S", 1.0))
+        self.retry_after_s = float(retry_after_s)
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- snapshot --------------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        status = "UP"
+        restarting = False
+        epoch = 0
+        for engine in self.container.engines.values():
+            try:
+                h = (engine.health_check()
+                     if hasattr(engine, "health_check") else {"status": "UP"})
+            except Exception:  # noqa: BLE001 - a broken probe is a DOWN engine
+                h = {"status": "DOWN"}
+            s = str(h.get("status", "UP")).upper()
+            if s == "DOWN":
+                status = "DOWN"
+            elif s != "UP" and status == "UP":
+                status = "DEGRADED"
+            restarting = restarting or bool(getattr(engine, "_restarting", False))
+            epoch = max(epoch, epoch_of(engine))
+        qos = self.container.qos
+        shedding = bool(qos.shedding) if qos is not None else False
+        self._seq += 1
+        return {
+            "replica": self.name, "url": self.url, "status": status,
+            "epoch": epoch, "restarting": restarting, "shedding": shedding,
+            "retry_after": self.retry_after_s, "seq": self._seq,
+            "ts": time.time(),
+        }
+
+    def publish_once(self, status: str | None = None) -> None:
+        snap = self.snapshot()
+        if status is not None:
+            snap["status"] = status
+        self.container.publish(self.topic, snap)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "GossipReporter":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"gofr-gossip-{self.name}")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.publish_once()
+            except Exception as e:  # noqa: BLE001 - gossip must outlive broker blips
+                self.container.logger.warnf("gossip publish failed: %r", e)
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2 * self.interval_s + 1.0)
+        try:
+            # terminal DOWN: leave the ring now, not at gossip-TTL expiry
+            self.publish_once(status="DOWN")
+        except Exception:  # noqa: BLE001 - broker may already be closed
+            pass

@@ -1,0 +1,240 @@
+"""Replica registry: gossip-fed membership + the ring-admission state machine.
+
+One ``Replica`` record per known replica, updated by health/epoch gossip
+messages published on the pubsub backbone (router/gossip.py emits them,
+``Router`` subscribes and feeds ``observe``). The registry owns the
+consistent-hash ring's membership:
+
+- ``UP`` (and not restarting) → in the ring, routable.
+- ``shedding`` (QoS 429/503 within its shed window) → STAYS in the ring —
+  shedding is a per-request spillover signal, not a membership change, so
+  one overloaded replica never shifts every key.
+- restart window (PR 5: the engine's crash-recovery backoff, gossiped as
+  ``restarting``) or ``DOWN`` or gossip silence past ``ttl_s`` → dropped
+  from the ring; its keys move to ring successors.
+- re-admission: after the replica gossips ``UP`` again — and, when the
+  drop was a restart window, at a STRICTLY BUMPED epoch (the engine's
+  restart/fleet-epoch counter; a replica whose device state was rebuilt
+  must prove it finished the rebuild) — plus a deterministic per-(replica,
+  epoch) anti-stampede jitter, so several replicas restarting near each
+  other re-shift the ring at different instants instead of as one step.
+
+Thread-safety: ``observe``/``sweep``/readers all take one lock; callers are
+the router's gossip thread and its request handlers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from gofr_tpu.router.ring import HashRing, hash_point
+
+
+@dataclass
+class Replica:
+    name: str
+    url: str = ""
+    status: str = "UNKNOWN"        # UP | DEGRADED | DOWN | STALE | UNKNOWN
+    epoch: int = 0
+    shedding: bool = False
+    restarting: bool = False
+    retry_after: float = 0.0       # replica-suggested backoff hint (s)
+    static: bool = False           # seeded by config, exempt from gossip TTL
+    last_seen: float = 0.0
+    in_ring: bool = False
+    drop_reason: str = ""          # restart | down | stale ('' = never dropped)
+    healthy_epoch: int = -1        # last epoch gossiped while UP and in the ring
+    drop_epoch: int = -1           # healthy_epoch at drop time (epoch-gate base)
+    drop_at: float = 0.0
+    readmit_at: float = 0.0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name, "url": self.url, "status": self.status,
+            "epoch": self.epoch, "shedding": self.shedding,
+            "restarting": self.restarting, "in_ring": self.in_ring,
+            "drop_reason": self.drop_reason or None,
+        }
+
+
+class ReplicaRegistry:
+    def __init__(self, ring: HashRing, metrics=None, logger=None, *,
+                 ttl_s: float = 3.0, jitter_s: float = 2.0,
+                 now: Callable[[], float] = time.monotonic):
+        self.ring = ring
+        # the FULL ring also holds restart-window members: a key whose home
+        # is mid-restart still BELONGS to that home (low classes shed, high
+        # classes spill) — only a down/stale/graceful-DOWN replica gives its
+        # keys up for every class (Router.plan reads both rings)
+        self.full = HashRing(ring.vnodes)
+        self.metrics = metrics
+        self.logger = logger
+        self.ttl_s = float(ttl_s)
+        self.jitter_s = float(jitter_s)
+        self._now = now
+        self._replicas: dict[str, Replica] = {}
+        self._lock = threading.Lock()
+
+    # -- feeds -----------------------------------------------------------------
+
+    def add_static(self, name: str, url: str) -> None:
+        """Seed a replica from config (``ROUTER_REPLICAS``): in the ring
+        immediately, never TTL-expired — gossip, when it arrives, still
+        moves it through the normal state machine (a static replica's
+        restart window drops it like any other)."""
+        with self._lock:
+            r = self._replicas.setdefault(name, Replica(name))
+            r.url = url or r.url
+            r.static = True
+            r.status = "UP"
+            r.last_seen = self._now()
+            if not r.in_ring:
+                self._admit(r)
+            self._gauges()
+
+    def observe(self, msg: dict) -> None:
+        """Apply one gossip message (see GossipReporter.snapshot for the
+        schema). Malformed fields degrade to safe defaults rather than
+        poisoning the registry."""
+        name = str(msg.get("replica") or "")
+        if not name:
+            return
+        with self._lock:
+            r = self._replicas.setdefault(name, Replica(name))
+            r.url = str(msg.get("url") or r.url)
+            r.status = str(msg.get("status") or "UP").upper()
+            try:
+                # assigned, not max()ed: a fully-replaced process (Supervisor
+                # respawn without FLEET_EPOCH) legitimately restarts its
+                # epoch count, and per-publisher broker ordering already
+                # rules out stale reorderings
+                r.epoch = int(msg.get("epoch") or 0)
+            except (TypeError, ValueError):
+                pass
+            r.shedding = bool(msg.get("shedding"))
+            r.restarting = bool(msg.get("restarting"))
+            try:
+                r.retry_after = float(msg.get("retry_after") or 0.0)
+            except (TypeError, ValueError):
+                r.retry_after = 0.0
+            r.last_seen = self._now()
+            if r.in_ring and r.status == "UP" and not r.restarting:
+                # the epoch-gate base: the engine bumps its restart counter
+                # BEFORE its window opens, so the drop-triggering gossip
+                # already carries the post-rebuild epoch — only an epoch
+                # seen while healthy proves nothing was mid-rebuild
+                r.healthy_epoch = r.epoch
+            self._apply(r)
+            self._gauges()
+
+    def sweep(self) -> None:
+        """Time-driven transitions: TTL-expire silent replicas, finish
+        jitter-delayed re-admissions. Called on every routing decision and
+        every gossip message — cheap (one pass over a handful of records)."""
+        with self._lock:
+            now = self._now()
+            for r in self._replicas.values():
+                stale = (not r.static and self.ttl_s > 0
+                         and now - r.last_seen > self.ttl_s)
+                if r.in_ring and stale:
+                    r.status = "STALE"
+                    self._drop(r, "stale")
+                elif not r.in_ring and stale and r.status != "STALE":
+                    # a restart-window member that went silent: it no longer
+                    # owns its keys for ANY class
+                    r.status = "STALE"
+                    r.drop_reason = "stale"
+                    self.full.remove(r.name)
+                else:
+                    self._apply(r)
+            self._gauges()
+
+    # -- state machine ---------------------------------------------------------
+
+    def _apply(self, r: Replica) -> None:
+        healthy = r.status == "UP" and not r.restarting
+        if r.in_ring:
+            # DOWN outranks restarting: a terminal DOWN gossiped while an
+            # engine is mid-restart-window (graceful stop during a crash
+            # recovery) must give the keys up NOW, not look transient
+            if r.status in ("DOWN", "STALE"):
+                self._drop(r, "down")
+            elif r.restarting:
+                self._drop(r, "restart")
+        elif healthy and self._readmittable(r):
+            self._admit(r)
+        elif r.status == "DOWN" and r.drop_reason == "restart":
+            # a restart window that ended in persistent DOWN (engine out of
+            # restart budget, app alive and still gossiping): the member
+            # gives up its keys after all — otherwise non-spillable classes
+            # homed on it would shed 503 forever
+            self.full.remove(r.name)
+            r.drop_reason = "down"
+
+    def _readmittable(self, r: Replica) -> bool:
+        if r.drop_reason == "restart" and r.epoch <= r.drop_epoch:
+            # the restart window ends with an epoch bump (engine restart
+            # counter / fleet epoch); an UP at the old epoch is the dying
+            # gossip tick racing the drop, not a completed rebuild. Escape
+            # hatch: a replica steadily UP well past the gossip TTL is
+            # demonstrably serving (e.g. a replaced process whose epoch
+            # count restarted) — re-admit it rather than strand it.
+            if self._now() - r.drop_at < max(self.ttl_s, 3 * self.jitter_s):
+                return False
+        return self._now() >= r.readmit_at
+
+    def _drop(self, r: Replica, reason: str) -> None:
+        if r.in_ring:
+            self.ring.remove(r.name)
+            r.in_ring = False
+        if reason != "restart":
+            # restart windows are transient: the member keeps its keys (low
+            # classes shed, high spill); down/stale gives them up entirely
+            self.full.remove(r.name)
+        r.drop_epoch = r.healthy_epoch
+        r.drop_at = self._now()
+        r.drop_reason = reason
+        r.readmit_at = self._now() + self._jitter(r)
+        if self.logger is not None:
+            self.logger.warnf("router: replica %s left the ring (%s, epoch %d)",
+                              r.name, reason, r.epoch)
+
+    def _jitter(self, r: Replica) -> float:
+        """Deterministic per-(replica, drop epoch) fraction of ``jitter_s``:
+        replicas desynchronize their ring re-entry with no coordination, and
+        a test with ``jitter_s=0`` is exact."""
+        if self.jitter_s <= 0:
+            return 0.0
+        return (hash_point(f"{r.name}:{r.drop_epoch}".encode()) % 1000) / 1000.0 * self.jitter_s
+
+    def _admit(self, r: Replica) -> None:
+        self.ring.add(r.name)
+        self.full.add(r.name)
+        r.in_ring = True
+        r.drop_reason = ""
+        r.healthy_epoch = r.epoch
+        if self.logger is not None:
+            self.logger.infof("router: replica %s joined the ring (epoch %d)",
+                              r.name, r.epoch)
+
+    def _gauges(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_router_ring_size", len(self.ring))
+            self.metrics.set_gauge("app_router_replicas_known", len(self._replicas))
+
+    # -- readers ---------------------------------------------------------------
+
+    def get(self, name: str) -> Replica | None:
+        with self._lock:
+            return self._replicas.get(name)
+
+    def replicas(self) -> dict[str, Replica]:
+        with self._lock:
+            return dict(self._replicas)
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return [r.to_dict() for _, r in sorted(self._replicas.items())]

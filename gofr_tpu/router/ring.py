@@ -1,0 +1,92 @@
+"""Consistent-hash ring over replica names (the router's placement function).
+
+Each replica contributes ``vnodes`` virtual points (blake2b of
+``"{name}#{i}"``) on a 64-bit circle; a request's shard key — the stable
+prompt-prefix chain key from ``tpu.prefix.chain_key`` — lands on the first
+point clockwise, and that point's replica is the HOME replica. Removing a
+replica moves only the keys that lived on its points (≈1/N of the space)
+onto their successors; every other key keeps its home — the property that
+makes a restart window survivable without a full cache reshuffle
+(GSPMD's shard-by-key framing, PAPERS.md 2105.04663, applied to the
+request plane).
+
+``lookup`` returns the DISTINCT replicas in ring order from the key's
+successor: ``[0]`` is the home replica, the tail is the deterministic
+spillover order the QoS policy walks when the home replica is shedding or
+restarting (docs/routing.md).
+
+Thread-safety: membership is mutated by the router's gossip thread while
+request handler threads look keys up, so every method takes the ring's
+own lock. A lookup racing a membership change may see the pre- or
+post-change ring — either is a valid routing decision; what the lock
+rules out is tearing (indexing a points list the mutator just rebound
+shorter mid-iteration).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+
+_MASK = (1 << 64) - 1
+
+
+def hash_point(data: bytes) -> int:
+    """Uniform 64-bit ring position (blake2b, process-stable)."""
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+
+class HashRing:
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self._points: list[tuple[int, str]] = []  # sorted (point, name)
+        self._members: set[str] = set()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._members
+
+    def members(self) -> list[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def add(self, name: str) -> None:
+        with self._lock:
+            if name in self._members:
+                return
+            self._members.add(name)
+            for i in range(self.vnodes):
+                bisect.insort(self._points, (hash_point(f"{name}#{i}".encode()), name))
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            if name not in self._members:
+                return
+            self._members.discard(name)
+            self._points = [(p, n) for p, n in self._points if n != name]
+
+    def lookup(self, key: int, n: int | None = None) -> list[str]:
+        """Distinct replicas in ring order from ``key``'s successor point:
+        ``[0]`` is the home replica, the rest the spillover order. ``n``
+        caps the list (None = every member, home first)."""
+        with self._lock:
+            if not self._points:
+                return []
+            want = len(self._members) if n is None else max(0, min(int(n), len(self._members)))
+            out: list[str] = []
+            seen: set[str] = set()
+            i = bisect.bisect_left(self._points, (key & _MASK,))
+            for step in range(len(self._points)):
+                _, name = self._points[(i + step) % len(self._points)]
+                if name not in seen:
+                    seen.add(name)
+                    out.append(name)
+                    if len(out) >= want:
+                        break
+            return out

@@ -35,6 +35,58 @@ class ServiceResponse:
     def ok(self) -> bool:
         return 200 <= self.status_code < 300
 
+    def close(self) -> None:  # symmetry with StreamedServiceResponse
+        pass
+
+
+class StreamedServiceResponse:
+    """Headers-first response for ``request(..., stream=True)``: status and
+    headers are available immediately, the body arrives incrementally
+    through ``iter_content`` — the shape SSE/chunked proxying needs
+    (router data plane, docs/routing.md). The caller MUST exhaust
+    ``iter_content`` or call ``close()``; the underlying connection is
+    held until then, and ``close()`` mid-stream aborts the upstream
+    transfer (client-cancel propagation)."""
+
+    def __init__(self, resp: "httpx.Response"):
+        self._resp = resp
+        self.status_code = resp.status_code
+        self.headers = dict(resp.headers)
+        self._closed = False
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status_code < 300
+
+    def iter_content(self, chunk_size: int | None = None):
+        """Body chunks AS THEY ARRIVE (``iter_raw`` — a fixed chunk_size
+        would buffer until full, which breaks SSE frame latency; the
+        request pinned identity encoding so raw == decoded). Closes on
+        exhaustion and on generator teardown, so a ``break`` releases the
+        connection too."""
+        try:
+            yield from self._resp.iter_raw(chunk_size)
+        finally:
+            self.close()
+
+    def read(self) -> bytes:
+        """Materialize the remaining body (spillover decisions need the
+        error envelope of a non-streamed 4xx/5xx) and close."""
+        try:
+            return self._resp.read()
+        finally:
+            self.close()
+
+    def json(self) -> Any:
+        import json
+
+        return json.loads(self.read())
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._resp.close()
+
 
 class HTTPService:
     """Base client (terminal element of the decorator chain)."""
@@ -47,7 +99,13 @@ class HTTPService:
         self._client = httpx.Client(timeout=timeout)
 
     def request(self, method: str, path: str, params: dict | None = None,
-                body: bytes | None = None, headers: dict[str, str] | None = None) -> ServiceResponse:
+                body: bytes | None = None, headers: dict[str, str] | None = None,
+                stream: bool = False) -> "ServiceResponse | StreamedServiceResponse":
+        """``stream=False`` (default) reads the full body and returns a
+        ``ServiceResponse``. ``stream=True`` returns headers-first
+        (``StreamedServiceResponse``); the span/metrics/log then cover
+        dispatch-to-headers, not the body transfer — the caller owns the
+        connection until it exhausts ``iter_content`` or ``close()``s."""
         url = f"{self.base_url}/{path.lstrip('/')}"
         headers = dict(headers or {})
         span = None
@@ -60,6 +118,17 @@ class HTTPService:
             headers.setdefault("traceparent", parent.traceparent())
         start = time.perf_counter()
         try:
+            if stream:
+                # identity, FORCED over any caller value (case variants
+                # included): iter_content hands out RAW chunks for frame
+                # latency, so the wire must not be content-coded
+                for k in [k for k in headers if k.lower() == "accept-encoding"]:
+                    del headers[k]
+                headers["accept-encoding"] = "identity"
+                req = self._client.build_request(method, url, params=params,
+                                                 content=body, headers=headers)
+                result = StreamedServiceResponse(self._client.send(req, stream=True))
+                return result
             resp = self._client.request(method, url, params=params, content=body, headers=headers)
             result = ServiceResponse(resp.status_code, resp.content, dict(resp.headers))
             return result
@@ -179,6 +248,7 @@ class Retry:
                         resp = self._inner.request(method, path, **kw)
                         if resp.status_code < 500:
                             return resp
+                        resp.close()  # a streamed 5xx must not leak its connection
                         last_exc = ServiceError(f"server error {resp.status_code}")
                     except ServiceError as e:
                         last_exc = e

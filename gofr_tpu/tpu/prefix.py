@@ -43,9 +43,44 @@ every later request that hits the same chain node.
 
 from __future__ import annotations
 
+import hashlib
 import heapq
 
 import numpy as np
+
+_ROOT = 0
+
+
+def chain_key(parent_key: int, tokens: bytes) -> int:
+    """Stable chain key for the page holding ``tokens`` under ``parent_key``.
+
+    blake2b over the parent key's 8 little-endian bytes + the page's raw
+    token bytes, NOT Python's builtin ``hash``: bytes hashing is
+    PYTHONHASHSEED-salted, so builtin-hash keys differ across processes and
+    could neither shard a consistent-hash ring (router/ring.py computes
+    these same keys router-side) nor survive a replica restart. 64-bit
+    digest: collisions land in the ``_get`` ancestry check like any other
+    dict-slot collision."""
+    h = hashlib.blake2b(parent_key.to_bytes(8, "little"), digest_size=8)
+    h.update(tokens)
+    return int.from_bytes(h.digest(), "little")
+
+
+def chain_keys(toks, page_size: int) -> list[int]:
+    """Chain keys of every FULL page of ``toks`` — the same page-granular
+    token-bytes walk ``PrefixCache.lookup``/``insert`` perform, exposed so
+    the data-plane router derives shard keys identical to the keys the
+    replica's cache stores (docs/routing.md)."""
+    arr = np.asarray(toks)
+    p = int(page_size)
+    n_full = int(arr.shape[0]) // p
+    buf = np.ascontiguousarray(arr[: n_full * p], dtype=np.int32)
+    keys: list[int] = []
+    key = _ROOT
+    for i in range(n_full):
+        key = chain_key(key, buf[i * p:(i + 1) * p].tobytes())
+        keys.append(key)
+    return keys
 
 
 class _Node:
@@ -62,9 +97,6 @@ class _Node:
         self.host = None              # host payload (tuple of per-plane arrays)
         self.host_nbytes = 0
         self.pending = False          # device upload dispatched, not yet folded
-
-
-_ROOT = 0
 
 
 class PrefixCache:
@@ -111,9 +143,9 @@ class PrefixCache:
         self._clock += 1
         return self._clock
 
-    @staticmethod
-    def _child_key(parent_key: int, tokens: bytes) -> int:
-        return hash((parent_key, tokens))
+    # stable (process-independent) digest — see module-level ``chain_key``;
+    # router/ring.py shards on these exact values
+    _child_key = staticmethod(chain_key)
 
     def _page_bytes_of(self, toks: np.ndarray) -> np.ndarray:
         """One contiguous int32 copy of the full-page region of ``toks`` —

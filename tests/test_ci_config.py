@@ -138,6 +138,23 @@ def test_kernel_autotune_suite_is_in_quick_tier():
     assert "paged_decode_q" in text and "Autotuner" in text
 
 
+def test_router_suite_is_in_quick_tier():
+    """ISSUE 7 satellite: the router units — stable chain keys (subprocess
+    PYTHONHASHSEED regression), ring, registry state machine, routing
+    plans — are CPU-trivial and must ride the `-m quick` CI job; the
+    multi-replica drills stay in the process tier (unmarked, tier-1)."""
+    path = REPO / "tests" / "test_router.py"
+    assert path.exists(), "tests/test_router.py missing"
+    text = path.read_text()
+    assert "pytest.mark.quick" in text, "router units must be quick-marked"
+    assert "test_router.py" not in QUICK_EXEMPT, (
+        "test_router.py must not be exempted from the quick tier"
+    )
+    # both halves are present: the stable-key regression and the drills
+    assert "PYTHONHASHSEED" in text and "chain_key" in text
+    assert "def test_two_replica" in text and "def test_replica_kill" in text
+
+
 def test_ci_has_py310_compat_gate():
     """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
     syntax (same-quote nested f-strings) passes every 3.12 job silently and

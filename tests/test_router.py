@@ -1,0 +1,598 @@
+"""Prefix-affinity data-plane router (ISSUE 7; docs/routing.md).
+
+Quick tier: stable chain keys (subprocess regression across PYTHONHASHSEED
+values — the tentpole-prerequisite bugfix), the consistent-hash ring, the
+gossip-fed registry state machine, and QoS-aware routing plans. Process
+tier: TWO real replica Apps (tiny llama, paged prefix cache) behind a
+router App on one broker — affinity routing beats random routing on
+prefix hit-token ratio, and a chaos-killed replica spills high classes /
+sheds low classes at the router with zero failed high-class requests,
+then re-enters the ring at its bumped epoch.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.pubsub.inmemory import InMemoryBroker
+from gofr_tpu.router import Router, RouterPolicy
+from gofr_tpu.router.registry import ReplicaRegistry
+from gofr_tpu.router.ring import HashRing
+from gofr_tpu.tpu import prefix
+from gofr_tpu.tpu.prefix import PrefixCache
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.mark.quick
+class TestStableChainKeys:
+    def test_keys_equal_across_processes_with_different_hash_seeds(self):
+        """The ISSUE 7 prerequisite regression: chain keys derived in two
+        interpreters with different PYTHONHASHSEED values must be equal —
+        builtin ``hash(bytes)`` is seed-salted and was neither shardable
+        nor restart-stable."""
+        script = ("import numpy as np; from gofr_tpu.tpu import prefix; "
+                  "print(prefix.chain_keys(np.arange(64), 16))")
+        outs = []
+        for seed in ("0", "424242"):
+            env = {**os.environ, "PYTHONHASHSEED": seed}
+            run = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                                 env=env, capture_output=True, text=True, timeout=120)
+            assert run.returncode == 0, run.stderr
+            outs.append(run.stdout.strip())
+        assert outs[0] and outs[0] == outs[1]
+
+    def test_router_side_keys_match_the_cache_walk(self):
+        """``chain_keys`` (router side) must produce the exact node keys the
+        replica's PrefixCache stores — that identity IS the affinity."""
+        c = PrefixCache(4)
+        toks = np.arange(13)  # 3 full pages + a remainder the walk ignores
+        c.insert(toks, [1, 2, 3])
+        walked = [k for k, _ in c.lookup_tiered(toks)]
+        assert walked == prefix.chain_keys(toks, 4)
+        assert len(walked) == 3
+
+    def test_ancestry_feeds_the_digest(self):
+        # identical page tokens under different parents are distinct chains
+        page = np.arange(4, dtype=np.int32).tobytes()
+        assert prefix.chain_key(prefix._ROOT, page) != prefix.chain_key(1, page)
+        # and the digest is a stable value, not an id()-flavored accident
+        assert prefix.chain_key(0, b"") == prefix.chain_key(0, b"")
+
+
+@pytest.mark.quick
+class TestHashRing:
+    def test_lookup_is_deterministic_and_home_first_distinct(self):
+        r1, r2 = HashRing(16), HashRing(16)
+        for n in ("a", "b", "c"):
+            r1.add(n)
+            r2.add(n)
+        for key in range(0, 2**64, 2**60):
+            order = r1.lookup(key)
+            assert order == r2.lookup(key)
+            assert sorted(order) == ["a", "b", "c"]  # distinct, all members
+        assert r1.lookup(123, n=1) == r1.lookup(123)[:1]
+
+    def test_removal_moves_only_the_removed_replicas_keys(self):
+        ring = HashRing(32)
+        for n in ("a", "b", "c"):
+            ring.add(n)
+        keys = [prefix.chain_key(0, bytes([i])) for i in range(200)]
+        before = {k: ring.lookup(k, 1)[0] for k in keys}
+        ring.remove("b")
+        for k, home in before.items():
+            if home != "b":
+                assert ring.lookup(k, 1)[0] == home  # unaffected keys stay put
+            else:
+                assert ring.lookup(k, 1)[0] in ("a", "c")
+        ring.add("b")  # re-adding restores the original assignment exactly
+        assert {k: ring.lookup(k, 1)[0] for k in keys} == before
+
+    def test_empty_and_single_member(self):
+        ring = HashRing(8)
+        assert ring.lookup(1) == []
+        ring.add("only")
+        assert ring.lookup(1) == ["only"]
+        assert len(ring) == 1 and "only" in ring
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+@pytest.mark.quick
+class TestReplicaRegistry:
+    def _reg(self, ttl_s=3.0, jitter_s=0.0):
+        clock = _Clock()
+        reg = ReplicaRegistry(HashRing(8), ttl_s=ttl_s, jitter_s=jitter_s, now=clock)
+        return reg, clock
+
+    def test_up_admits_and_shedding_keeps_membership(self):
+        reg, _ = self._reg()
+        reg.observe({"replica": "a", "url": "u", "status": "UP", "epoch": 0})
+        assert "a" in reg.ring and "a" in reg.full
+        reg.observe({"replica": "a", "status": "DEGRADED", "shedding": True})
+        # shedding is a spillover signal, NOT a membership change: one
+        # overloaded replica must not shift every key
+        assert "a" in reg.ring and reg.get("a").shedding
+
+    def test_restart_window_drops_and_requires_epoch_bump(self):
+        # REAL engine timing: _restarts bumps BEFORE the window opens, so
+        # the restarting gossip already carries the post-rebuild epoch —
+        # the gate must compare against the last epoch seen HEALTHY
+        reg, clock = self._reg()
+        reg.observe({"replica": "a", "status": "UP", "epoch": 0})
+        reg.observe({"replica": "a", "status": "DEGRADED", "restarting": True, "epoch": 1})
+        assert "a" not in reg.ring
+        assert "a" in reg.full  # restart-window member keeps its keys
+        # UP at the last HEALTHY epoch (a dying gossip tick racing the
+        # drop, or a replayed message): not proof of a finished rebuild
+        clock.t += 0.1
+        reg.observe({"replica": "a", "status": "UP", "epoch": 0})
+        assert "a" not in reg.ring
+        # UP at the bumped epoch the window gossiped: rebuild finished
+        reg.observe({"replica": "a", "status": "UP", "epoch": 1})
+        assert "a" in reg.ring
+
+    def test_rejoin_jitter_delays_readmission(self):
+        # long TTL: the clock jump below must exercise the jitter window,
+        # not gossip-silence expiry
+        reg, clock = self._reg(ttl_s=100.0, jitter_s=5.0)
+        reg.observe({"replica": "a", "status": "UP", "epoch": 0})
+        reg.observe({"replica": "a", "status": "DEGRADED", "restarting": True, "epoch": 0})
+        delay = reg.get("a").readmit_at
+        assert 0.0 <= delay <= 5.0
+        reg.observe({"replica": "a", "status": "UP", "epoch": 1})
+        if delay > 0:
+            assert "a" not in reg.ring  # not yet: anti-stampede window
+        clock.t = 5.0
+        reg.sweep()
+        assert "a" in reg.ring
+        # deterministic per (name, epoch): a re-run computes the same delay
+        assert reg._jitter(reg.get("a")) == reg._jitter(reg.get("a"))
+
+    def test_gossip_silence_expires_membership_and_keys(self):
+        reg, clock = self._reg(ttl_s=2.0)
+        reg.observe({"replica": "a", "status": "UP"})
+        clock.t = 5.0
+        reg.sweep()
+        assert "a" not in reg.ring
+        assert "a" not in reg.full  # silent replicas give up their keys
+        # fresh gossip re-admits without an epoch requirement
+        reg.observe({"replica": "a", "status": "UP"})
+        assert "a" in reg.ring and "a" in reg.full
+
+    def test_terminal_down_leaves_both_rings(self):
+        reg, _ = self._reg()
+        reg.observe({"replica": "a", "status": "UP"})
+        reg.observe({"replica": "a", "status": "DOWN"})
+        assert "a" not in reg.ring and "a" not in reg.full
+
+    def test_restart_window_ending_in_down_gives_up_keys(self):
+        # engine exhausts its restart budget: the app stays alive and keeps
+        # gossiping DOWN — the member must not hold its keys hostage
+        reg, _ = self._reg()
+        reg.observe({"replica": "a", "status": "UP", "epoch": 0})
+        reg.observe({"replica": "a", "status": "DEGRADED", "restarting": True})
+        assert "a" in reg.full  # transient window: keys kept
+        reg.observe({"replica": "a", "status": "DOWN", "restarting": False})
+        assert "a" not in reg.full  # persistent DOWN: keys move for good
+        reg.observe({"replica": "a", "status": "UP", "epoch": 1})
+        assert "a" in reg.ring and "a" in reg.full  # and it can come back
+
+    def test_static_seed_is_ttl_exempt(self):
+        reg, clock = self._reg(ttl_s=1.0)
+        reg.add_static("s", "http://s")
+        clock.t = 100.0
+        reg.sweep()
+        assert "s" in reg.ring
+
+
+@pytest.mark.quick
+class TestRoutePlans:
+    def _router(self, **kw):
+        container = new_mock_container()
+        kw.setdefault("page_size", 4)
+        kw.setdefault("jitter_s", 0.0)
+        kw.setdefault("replicas", {"a": "http://a", "b": "http://b"})
+        return Router(container, policy=RouterPolicy(**kw))
+
+    def _key_homed(self, router, name):
+        for i in range(512):
+            key = prefix.chain_key(0, bytes([i % 251, i // 251]))
+            if router.registry.full.lookup(key, 1)[0] == name:
+                return key
+        raise AssertionError(f"no key homed on {name}")
+
+    def test_healthy_home_first_spillable_gets_successor(self):
+        router = self._router()
+        key = self._key_homed(router, "a")
+        p = router.plan(key, "interactive")
+        assert p.home == "a" and [t.name for t in p.targets] == ["a", "b"]
+        p = router.plan(key, "batch")  # below ROUTER_SPILL_CLASSES: no spare
+        assert [t.name for t in p.targets] == ["a"] and p.shed is None
+
+    def test_restarting_home_spills_high_and_sheds_low(self):
+        router = self._router()
+        router.registry.observe({"replica": "a", "url": "http://a",
+                                 "status": "DEGRADED", "restarting": True,
+                                 "epoch": 0, "retry_after": 7.5})
+        key = self._key_homed(router, "a")
+        high = router.plan(key, "interactive")
+        assert high.shed is None and [t.name for t in high.targets] == ["b"]
+        low = router.plan(key, "batch")
+        assert low.targets == [] and low.shed == ("restart", 7.5)
+
+    def test_shedding_home_spills_high_and_sheds_low(self):
+        router = self._router()
+        router.registry.observe({"replica": "b", "url": "http://b",
+                                 "status": "DEGRADED", "shedding": True,
+                                 "retry_after": 2.0})
+        key = self._key_homed(router, "b")
+        assert [t.name for t in router.plan(key, "interactive").targets] == ["a"]
+        assert router.plan(key, "batch").shed == ("shedding", 2.0)
+
+    def test_empty_ring_sheds_everything(self):
+        router = self._router(replicas={})
+        p = router.plan(12345, "interactive")
+        assert p.targets == [] and p.shed is not None
+
+    def test_unknown_class_resolves_to_default_and_spills(self):
+        router = self._router()
+        key = self._key_homed(router, "a")
+        p = router.plan(key, "no-such-class")
+        assert p.qos_class == "default" and p.spillable
+
+    def test_shard_key_hashes_only_the_keyed_prefix(self):
+        # the shard key of a long prompt equals the key of its first
+        # key_pages pages — deeper pages must not change (or cost) anything
+        router = self._router()
+        rng = np.random.RandomState(5)
+        head = rng.randint(1, 99, size=4).tolist()
+        long = head + rng.randint(1, 99, size=40).tolist()
+        assert router.shard_key(long) == router.shard_key(head)
+        assert router.shard_key(long) == prefix.chain_keys(np.asarray(head), 4)[0]
+
+    def test_proxied_response_keeps_full_content_type(self):
+        # Content-Type parameters (charset, multipart boundary) must survive
+        # the hop verbatim in the passthrough headers
+        router = self._router()
+        key = self._key_homed(router, "a")
+        p = router.plan(key, "interactive")
+
+        class _Resp:
+            status_code = 200
+            headers = {"content-type": "text/plain; charset=latin-1",
+                       "retry-after": "3", "transfer-encoding": "chunked"}
+
+            def read(self):
+                return b"\xe9"
+
+            def close(self):
+                pass
+
+        out = router._finish(p, p.targets[0], _Resp())
+        assert out.body == b"\xe9" and out.status_code == 200
+        assert out.headers["content-type"] == "text/plain; charset=latin-1"
+        assert out.headers["retry-after"] == "3"
+        assert "transfer-encoding" not in out.headers  # hop-by-hop stripped
+
+    def test_random_mode_is_seeded_and_ignores_affinity(self):
+        r1 = self._router(mode="random", seed=11)
+        r2 = self._router(mode="random", seed=11)
+        keys = [prefix.chain_key(0, bytes([i])) for i in range(32)]
+        picks1 = [r1.plan(k).targets[0].name for k in keys]
+        picks2 = [r2.plan(k).targets[0].name for k in keys]
+        assert picks1 == picks2
+        assert set(picks1) == {"a", "b"}  # actually scatters
+
+
+# -- process tier: two replica apps + a router app over one broker ---------------
+
+
+def _hits(app) -> float:
+    m = app.container.metrics.get("app_tpu_prefix_hit_tokens")
+    return sum(m._values.values()) if m is not None else 0.0
+
+
+def _make_replica(broker, name):
+    import jax.numpy as jnp
+
+    from gofr_tpu.http.streaming import StreamingResponse
+    from gofr_tpu.models import LlamaConfig, ModelSpec
+    from tests.test_http_server import make_app
+
+    app = make_app()
+    app.container.pubsub = broker
+    spec = ModelSpec("llama", LlamaConfig.tiny(), task="generate", dtype=jnp.float32)
+    app.serve_model("lm", spec, slots=2, max_len=64, decode_chunk=2,
+                    kv_layout="paged", page_size=16, total_pages=20,
+                    prefix_cache=True)
+    app.enable_qos()  # restart windows answer 503 + Retry-After, not queue
+
+    def generate(ctx):
+        body = ctx.bind(dict)
+        return ctx.generate("lm", body["prompt"],
+                            max_new_tokens=int(body.get("max_new_tokens", 2)),
+                            timeout=120)
+
+    def generate_stream(ctx):
+        body = ctx.bind(dict)
+        it = ctx.generate("lm", body["prompt"],
+                          max_new_tokens=int(body.get("max_new_tokens", 8)),
+                          stream=True, timeout=120)
+        return StreamingResponse(it, event="token")
+
+    app.post("/generate", generate)
+    app.post("/generate/stream", generate_stream)
+    app.enable_router_gossip(name=name, interval_s=0.05)
+    return app
+
+
+def _make_router_app(broker, **policy_kw):
+    from tests.test_http_server import make_app
+
+    app = make_app({"APP_ENV": "DEBUG"})
+    app.container.pubsub = broker
+    policy_kw.setdefault("page_size", 16)
+    policy_kw.setdefault("ttl_s", 2.0)
+    policy_kw.setdefault("jitter_s", 0.0)
+    router = Router(app.container, policy=RouterPolicy(**policy_kw))
+    router.bind(app)
+    return app, router
+
+
+def _wait_ring(router, want, deadline_s=30.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        router.registry.sweep()
+        if sorted(router.ring.members()) == sorted(want):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"ring never became {want}: {router.ring.members()}")
+
+
+def _tenant_prompt(rng, shared):
+    return shared + rng.randint(1, 500, size=5).tolist()
+
+
+def test_two_replica_affinity_beats_random_hit_ratio():
+    """Acceptance drill, arm 1: with affinity routing a repeat tenant's warm
+    requests land on the replica holding their cached prefix — the
+    hit-token ratio must be STRICTLY above the random-routing arm's."""
+    import httpx
+
+    from tests.test_http_server import AppHarness
+
+    broker = InMemoryBroker()
+    a, b = _make_replica(broker, "a"), _make_replica(broker, "b")
+    rapp, router = _make_router_app(broker)
+    with AppHarness(a), AppHarness(b), AppHarness(rapp) as hr:
+        _wait_ring(router, ["a", "b"])
+        rng = np.random.RandomState(0)
+        with httpx.Client(base_url=hr.base, timeout=180) as client:
+
+            def run_arm(mode):
+                router.policy.mode = mode
+                hit0 = _hits(a) + _hits(b)
+                toks = 0
+                for _tenant in range(4):
+                    shared = rng.randint(1, 500, size=16).tolist()  # one page
+                    for _rep in range(5):
+                        p = _tenant_prompt(rng, shared)
+                        toks += len(p)
+                        r = client.post("/generate",
+                                        json={"prompt": p, "max_new_tokens": 2})
+                        assert r.status_code == 201, r.text
+                return (_hits(a) + _hits(b) - hit0) / toks
+
+            affinity_ratio = run_arm("affinity")
+            random_ratio = run_arm("random")
+        assert affinity_ratio > random_ratio, (affinity_ratio, random_ratio)
+        assert affinity_ratio > 0.4  # 4 of 5 per tenant hit a 16/21 prefix
+        view = router.debug_view()
+        assert view["stats"]["requests"] == 40
+        assert view["stats"]["shed"] == 0
+
+
+def test_replica_kill_spills_high_sheds_low_then_epoch_readmits(tmp_path):
+    """Acceptance drill, arm 2: chaos kills replica b's engine mid-stream;
+    while b's restart window is latch-held open the router spills
+    high-class b-homed traffic to a (zero failures) and sheds low-class at
+    the router with Retry-After; releasing the latch restarts b, whose
+    epoch-bumped gossip re-admits it to the ring."""
+    import httpx
+
+    from gofr_tpu.fleet import chaos
+    from tests.test_http_server import AppHarness
+
+    broker = InMemoryBroker()
+    a = _make_replica(broker, "a")
+    latch = tmp_path / "release-restart"
+    with chaos.override(
+            f"engine.step:raise,at_step=3;engine.restart:hold,file={latch},timeout=120"):
+        b = _make_replica(broker, "b")  # chaos arms at engine build: only b
+    rapp, router = _make_router_app(broker)
+    rng = np.random.RandomState(1)
+
+    def prompt_homed(name):
+        while True:
+            p = rng.randint(1, 500, size=21).tolist()
+            if router.registry.full.lookup(router.shard_key(p), 1)[0] == name:
+                return p
+
+    with AppHarness(a), AppHarness(b), AppHarness(rapp) as hr:
+        _wait_ring(router, ["a", "b"])
+        with httpx.Client(base_url=hr.base, timeout=180) as client:
+            pb = prompt_homed("b")
+            # mid-traffic kill: a b-homed SSE stream long enough that the
+            # at_step=3 raise lands inside it; the error arrives IN BAND
+            # through the router's raw streaming passthrough
+            events = []
+            with client.stream("POST", "/generate/stream",
+                               json={"prompt": pb, "max_new_tokens": 40}) as r:
+                assert r.status_code == 200
+                assert r.headers["content-type"].startswith("text/event-stream")
+                for line in r.iter_lines():
+                    if line.startswith("event: "):
+                        events.append(line.split("event: ", 1)[1])
+            assert "error" in events and "done" not in events
+
+            # gossip flips b restarting → it leaves the ring (keys intact)
+            deadline = time.time() + 30
+            while time.time() < deadline and "b" in router.ring:
+                time.sleep(0.02)
+            assert "b" not in router.ring
+            assert "b" in router.registry.full  # restart window keeps keys
+
+            # high class homed on b: spilled to a, ZERO failures
+            for _ in range(5):
+                r = client.post("/generate",
+                                json={"prompt": prompt_homed("b"), "max_new_tokens": 2},
+                                headers={"X-QoS-Class": "interactive"})
+                assert r.status_code == 201, r.text
+            # low class homed on b: shed AT the router, Retry-After intact
+            r = client.post("/generate",
+                            json={"prompt": pb, "max_new_tokens": 2},
+                            headers={"X-QoS-Class": "batch"})
+            assert r.status_code == 503, r.text
+            assert "Retry-After" in r.headers
+            # a-homed traffic is untouched by b's window
+            r = client.post("/generate",
+                            json={"prompt": prompt_homed("a"), "max_new_tokens": 2},
+                            headers={"X-QoS-Class": "batch"})
+            assert r.status_code == 201, r.text
+
+            # release the held restart: b rebuilds, bumps its epoch, and the
+            # ring re-admits it at the bumped epoch
+            latch.write_text("")
+            deadline = time.time() + 60
+            while time.time() < deadline and "b" not in router.ring:
+                router.registry.sweep()
+                time.sleep(0.02)
+            assert "b" in router.ring
+            assert router.registry.get("b").epoch >= 1
+
+            # and b actually serves its home keys again
+            r = client.post("/generate",
+                            json={"prompt": pb, "max_new_tokens": 2},
+                            headers={"X-QoS-Class": "interactive"})
+            assert r.status_code == 201, r.text
+        view = router.debug_view()
+        assert any(d["outcome"].startswith("shed:") for d in view["decisions"])
+        m = rapp.container.metrics.get("app_router_shed_total")
+        assert m is not None and sum(m._values.values()) >= 1
+        # the high-class b-homed wave was accounted as SPILL off b with the
+        # restart-window reason (counted at the landing, labeled by home)
+        sp = rapp.container.metrics.get("app_router_spilled_total")
+        spills = {ls: v for ls, v in sp._values.items()}
+        assert sum(v for ls, v in spills.items()
+                   if dict(ls).get("replica") == "b"
+                   and dict(ls).get("reason") == "restart") >= 5, spills
+
+
+@pytest.mark.quick
+def test_gossip_reporter_snapshot_tracks_engine_state():
+    """Quick-adjacent sanity on the replica side of the drill: the reporter
+    derives status/epoch/restarting from the engines it fronts."""
+    from gofr_tpu.router.gossip import GossipReporter
+
+    container = new_mock_container()
+
+    class _Engine:
+        _restarting = False
+        _restarts = 0
+
+        def health_check(self):
+            return {"status": "UP"}
+
+    eng = _Engine()
+    container.register_engine("m", eng)
+    rep = GossipReporter(container, name="r0", url="http://r0", interval_s=9.0)
+    snap = rep.snapshot()
+    assert snap["replica"] == "r0" and snap["status"] == "UP"
+    assert snap["epoch"] == 0 and not snap["restarting"]
+    eng._restarting = True
+    eng._restarts = 2
+    assert rep.snapshot()["restarting"] and rep.snapshot()["epoch"] == 2
+    # published snapshots arrive on the broker for any subscribed router
+    rep.publish_once()
+    msg = container.pubsub.subscribe(rep.topic, group="t", timeout=1.0)
+    assert msg is not None and json.loads(msg.value)["replica"] == "r0"
+
+
+@pytest.mark.quick
+def test_forwarded_headers_merge_xff_and_inject_traceparent():
+    """The hop must MERGE the existing X-Forwarded-For chain (HTTPRequest
+    stores lowercase header keys) and replace traceparent with the
+    router's own span so the replica parents under this hop."""
+    from gofr_tpu.http.request import HTTPRequest
+    from gofr_tpu.tracing import MemoryExporter, Tracer
+
+    container = new_mock_container()
+    router = Router(container, policy=RouterPolicy(page_size=4))
+    req = HTTPRequest(method="POST", path="/generate", query_string="debug=1",
+                      headers={"X-Forwarded-For": "203.0.113.9", "Host": "edge",
+                               "traceparent": "00-" + "9" * 32 + "-" + "8" * 16 + "-01",
+                               "X-QoS-Class": "interactive"},
+                      body=b"{}", path_params={}, remote="10.0.0.2")
+    # the raw query string is forwardable (the proxy appends it verbatim)
+    assert req.query_string == "debug=1"
+    span = Tracer(MemoryExporter()).start_span("hop", set_current=False)
+    out = router._forward_headers(req, span)
+    xff = [v for k, v in out.items() if k.lower() == "x-forwarded-for"]
+    assert xff == ["203.0.113.9, 10.0.0.2"]  # merged, no duplicate key
+    assert out["traceparent"] == span.traceparent()  # router span wins
+    assert not any(k.lower() == "host" for k in out)  # hop-by-hop stripped
+    assert any(k.lower() == "x-qos-class" for k in out)  # QoS class rides on
+
+
+@pytest.mark.quick
+def test_replayed_stale_gossip_is_ignored_at_boot():
+    """A durable broker (pubsub/file.py) replays topic history to a fresh
+    router consumer group: snapshots older than any liveness window must
+    not admit their (possibly dead) URLs — only fresh gossip counts."""
+    broker = InMemoryBroker()
+    container = new_mock_container()
+    container.pubsub = broker
+    router = Router(container, policy=RouterPolicy(page_size=4, jitter_s=0.0))
+    broker.publish(router.policy.topic, {
+        "replica": "dead", "url": "http://old:1", "status": "UP",
+        "epoch": 0, "ts": time.time() - 3600})
+    broker.publish(router.policy.topic, {
+        "replica": "live", "url": "http://live:1", "status": "UP",
+        "epoch": 0, "ts": time.time()})
+    router.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and "live" not in router.ring:
+            time.sleep(0.01)
+        assert "live" in router.ring
+        assert "dead" not in router.ring and router.registry.get("dead") is None
+    finally:
+        router.stop()
+
+
+@pytest.mark.quick
+def test_router_metrics_and_debug_view_shapes():
+    """The /debug/router payload and metric families the docs promise."""
+    container = new_mock_container()
+    router = Router(container, policy=RouterPolicy(
+        page_size=4, jitter_s=0.0, replicas={"a": "http://a"}))
+    view = router.debug_view()
+    assert view["ring"] == ["a"] and view["ring_size"] == 1
+    assert view["stats"]["affinity_hit_ratio"] is None
+    assert view["replicas"][0]["name"] == "a"
+    g = container.metrics.get("app_router_ring_size")
+    assert g is not None

@@ -134,6 +134,49 @@ def test_http_trace_stitches_engine_timeline_and_debug_endpoints():
         assert data["engines"]["lm"]["status"] in ("UP", "DEGRADED")
 
 
+def test_router_hop_stitches_one_trace_across_replica():
+    """ISSUE 7 satellite: a generate proxied through the data-plane router
+    (gofr_tpu.router) yields ONE trace — the router forwards traceparent so
+    the replica's server span (and its engine children) parent under the
+    router's span, and the replica's X-Trace-Id survives the hop."""
+    from gofr_tpu.router import Router, RouterPolicy
+    from gofr_tpu.tracing import MemoryExporter, Tracer
+
+    replica = make_app()
+    replica.container.tracer = Tracer(MemoryExporter())
+    spec = ModelSpec("llama", LlamaConfig.tiny(), task="generate", dtype=jnp.float32)
+    replica.serve_model("lm", spec, slots=2, max_len=32)
+    replica.post("/generate", lambda ctx: ctx.generate(
+        "lm", ctx.bind(dict)["prompt"], max_new_tokens=2, timeout=120))
+
+    rapp = make_app()
+    rapp.container.tracer = Tracer(MemoryExporter())
+    router = Router(rapp.container,
+                    policy=RouterPolicy(page_size=16, jitter_s=0.0))
+    router.bind(rapp)
+
+    inbound = "00-" + "c" * 32 + "-" + "d" * 16 + "-01"
+    with AppHarness(replica) as hrep, AppHarness(rapp) as hr:
+        router.registry.add_static("lm0", hrep.base)
+        with httpx.Client(base_url=hr.base, timeout=180) as client:
+            r = client.post("/generate", json={"prompt": [1, 2, 3]},
+                            headers={"traceparent": inbound})
+        assert r.status_code == 201, r.text
+        # the replica's X-Trace-Id passes through the proxy response
+        assert r.headers["X-Trace-Id"] == "c" * 32
+
+    router_spans = {s.name: s for s in rapp.container.tracer._exporter.spans}
+    rspan = router_spans["POST /generate"]
+    assert rspan.trace_id == "c" * 32 and rspan.parent_id == "d" * 16
+    replica_spans = {s.name: s for s in replica.container.tracer._exporter.spans}
+    pspan = replica_spans["POST /generate"]
+    assert pspan.trace_id == "c" * 32
+    assert pspan.parent_id == rspan.span_id  # replica parents under the hop
+    for name in ("engine.queue_wait", "engine.prefill", "engine.decode"):
+        assert replica_spans[name].trace_id == "c" * 32, name
+    router.stop()
+
+
 def test_debug_endpoints_gated_outside_debug_env(lm_app):
     with AppHarness(lm_app) as h, httpx.Client(base_url=h.base, timeout=60) as client:
         assert client.get("/debug/requests").status_code == 404

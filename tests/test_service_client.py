@@ -2,6 +2,7 @@
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import pytest
@@ -127,3 +128,85 @@ def test_traceparent_propagation(backend):
 def test_health_check(backend):
     client = new_http_service(backend, None, None)
     assert client.health_check()["status"] == "UP"
+
+
+# -- streamed responses (ISSUE 7 satellite: SSE proxying needs body chunks
+# as they arrive, and a client cancel must abort the upstream transfer) ---------
+
+
+class StreamBackend(BaseHTTPRequestHandler):
+    """Writes one SSE frame, BLOCKS on ``release``, then writes the rest —
+    so a test can prove the client saw frame one while frame two did not
+    yet exist (incremental delivery, not full-body buffering)."""
+
+    release = threading.Event()
+    write_error: list = []
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.end_headers()
+        self.wfile.write(b"data: one\n\n")
+        self.wfile.flush()
+        StreamBackend.release.wait(timeout=10)
+        try:
+            self.wfile.write(b"data: two\n\n")
+            self.wfile.flush()
+            # keep writing: a closed peer RSTs and a later flush raises —
+            # one buffered write could slip out before the RST lands
+            for _ in range(50):
+                self.wfile.write(b"x" * 65536)
+                self.wfile.flush()
+                time.sleep(0.01)
+        except OSError as e:
+            StreamBackend.write_error.append(repr(e))
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def stream_backend():
+    StreamBackend.release = threading.Event()
+    StreamBackend.write_error = []
+    srv = HTTPServer(("127.0.0.1", 0), StreamBackend)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    StreamBackend.release.set()
+    srv.shutdown()
+
+
+def test_streamed_response_chunks_arrive_incrementally(stream_backend):
+    client = new_http_service(stream_backend, None, None)
+    resp = client.request("GET", "/stream", stream=True)
+    assert resp.status_code == 200 and resp.ok
+    assert resp.headers["content-type"] == "text/event-stream"
+    it = resp.iter_content()
+    got = b""
+    while b"one" not in got:
+        got += next(it)
+    # the server has not produced frame two yet: seeing frame one NOW
+    # proves request() returned headers-first instead of reading the body
+    assert b"two" not in got
+    StreamBackend.release.set()
+    for chunk in it:
+        got += chunk
+    assert b"two" in got
+    client.close()
+
+
+def test_streamed_response_close_aborts_upstream(stream_backend):
+    client = new_http_service(stream_backend, None, None)
+    resp = client.request("GET", "/stream", stream=True)
+    first = next(resp.iter_content())
+    assert b"one" in first
+    resp.close()  # client cancel mid-stream (idempotent; closes the conn)
+    resp.close()
+    StreamBackend.release.set()
+    deadline = time.time() + 5
+    while not StreamBackend.write_error and time.time() < deadline:
+        time.sleep(0.02)
+    # the server's next write hit a dead connection: the transfer was
+    # aborted, not silently drained into a ghost
+    assert StreamBackend.write_error
+    client.close()
