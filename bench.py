@@ -38,6 +38,12 @@ Env knobs:
                               a tenant-skewed shared-prefix workload, prefix-
                               affinity vs random routing (aggregate req/s,
                               warm-TTFT p50, prefix hit-token ratio per arm)
+    GOFR_BENCH_SLO            1 = also run the heavy-tailed SLO workload
+                              (lognormal prompt/output lengths, bursty
+                              arrivals, zipf tenant skew mapped onto QoS
+                              classes) and report per-class SLO attainment
+                              + burn-rate peaks from metrics/slo.py in
+                              extra.slo (ROADMAP O5(b))
     GOFR_BENCH_PIPELINE       device pipeline depth (default 2; 1 = sync, up to 4)
     GOFR_BENCH_OVERLAP_AB     1 = also measure the mixed-arrival workload (paced
                               arrivals of short + chunked-long prompts) with the
@@ -805,6 +811,85 @@ def main() -> None:
                 router_ab["affinity"]["hit_token_ratio"]
                 - router_ab["random"]["hit_token_ratio"], 4)
         extra["router"] = router_ab
+
+    # heavy-tailed SLO workload (ISSUE 9, ROADMAP O5(b)): lognormal prompt/
+    # output lengths, bursty arrivals (hot bursts separated by idle gaps),
+    # and the PR 7 zipf tenant skew mapped onto QoS classes, judged by the
+    # live per-class SLO engine (container.slo) — the standing evaluation
+    # is "did each class MEET its objective", not a single req/s number.
+    # Reported: per-class fast-window attainment/burn at the end of the
+    # wave plus the PEAK burn rate observed per class along the way.
+    if os.environ.get("GOFR_BENCH_SLO") == "1" and container.slo is not None:
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        s_classes = ("interactive", "default", "batch")
+        s_tenants = 6
+        n_slo = max(12, n_requests // 2)
+        s_weights = np.array([1.0 / (i + 1) for i in range(s_tenants)])
+        s_draws = rng.choice(s_tenants, size=n_slo,
+                             p=s_weights / s_weights.sum())
+        # heavy tails: lognormal around the headline lengths, clipped into
+        # the engine's window budget (the p99 prompt is ~2x the median)
+        max_plen = max(prompt_len,
+                       min(2 * prompt_len, cfg.max_seq_len - max_new - 8))
+        s_plens = np.clip(rng.lognormal(np.log(prompt_len), 0.5, n_slo)
+                          .astype(int), 8, max_plen)
+        s_nlens = np.clip(rng.lognormal(np.log(max_new), 0.5, n_slo)
+                          .astype(int), 2, max_new)
+        skw = dict(engine_kw(*best))
+        skw.update(max_len=max_plen + max_new + 8,
+                   prefill_buckets=sorted({prompt_len, max_plen}))
+        burst = max(4, best[0] // 2)
+        try:
+            s_engine = GenerateEngine(llama, cfg, params, container, **skw)
+            burn_peaks: dict = {}
+            try:
+                s_engine.warmup()
+                s_engine.start()
+                t0 = time.monotonic()
+                done = 0
+                while done < n_slo:
+                    hi = min(done + burst, n_slo)
+                    s_reqs = []
+                    for i in range(done, hi):
+                        p = rng.randint(1, cfg.vocab_size,
+                                        size=int(s_plens[i])).tolist()
+                        s_reqs.append(s_engine.submit(
+                            p, max_new_tokens=int(s_nlens[i]), timeout=timeout,
+                            qos_class=s_classes[s_draws[i] % len(s_classes)]))
+                    for r in s_reqs:
+                        r.result(timeout)
+                    done = hi
+                    if (done // burst) % 2 == 0:
+                        time.sleep(0.05)  # the cold gap after a hot burst
+                    for cname, objs in container.slo.snapshot().items():
+                        for entry in objs.values():
+                            b = entry["fast"]["burn_rate"]
+                            if b is not None:
+                                burn_peaks[cname] = max(
+                                    burn_peaks.get(cname, 0.0), b)
+                slo_elapsed = time.monotonic() - t0
+            finally:
+                s_engine.stop()
+            per_class = {
+                cname: {
+                    oname: {"attainment": entry["fast"]["attainment"],
+                            "burn_rate": entry["fast"]["burn_rate"],
+                            "budget_remaining": entry["budget_remaining"]}
+                    for oname, entry in objs.items() if entry["fast"]["total"]
+                }
+                for cname, objs in container.slo.snapshot().items()
+            }
+            extra["slo"] = {
+                "requests": n_slo,
+                "req_per_s": round(n_slo / slo_elapsed, 2),
+                "prompt_len_p99": int(np.percentile(s_plens, 99)),
+                "per_class": {c: v for c, v in per_class.items() if v},
+                "burn_peaks": {c: round(v, 2)
+                               for c, v in sorted(burn_peaks.items())},
+            }
+        except Exception as e:  # noqa: BLE001
+            extra["slo"] = f"error: {e}"[:160]
 
     # NB: on the CPU fallback the "device" compute runs on the same host
     # cores as the packing/readback, so overlap has nothing to hide behind

@@ -77,6 +77,10 @@ class App:
         self._sub_stop = threading.Event()
         self._gossip = None  # GossipReporter once enable_router_gossip runs
         self._cleanup: list[Callable[[], None]] = []
+        # one /debug/profile capture at a time (409 while held): concurrent
+        # jax.profiler.trace calls crash, and N stray curls must not pin N
+        # handler threads for N×seconds each
+        self._profile_busy = threading.Lock()
 
     # -- route registration (gofr.go:244-276) ----------------------------------
 
@@ -573,11 +577,49 @@ class App:
     def _debug_env(self) -> bool:
         return self.config.get_or_default("APP_ENV", "").upper() == "DEBUG"
 
+    def _profiler_port_base(self) -> int | None:
+        """Resolve PROFILER_PORT: an explicit port, ``auto`` (derived from
+        the serving port, so co-hosted replicas with distinct HTTP_PORTs
+        get distinct profiler ports for free), or <=0/garbage = disabled."""
+        raw = str(self.config.get_or_default("PROFILER_PORT", "9999")).strip().lower()
+        if raw == "auto":
+            return self.http_port + 1999  # default HTTP 8000 -> classic 9999
+        try:
+            base = int(raw)
+        except ValueError:
+            self.logger.warn(f"PROFILER_PORT {raw!r} is not a port or 'auto'; "
+                             "profiler server disabled")
+            return None
+        return base if base > 0 else None
+
+    @staticmethod
+    def _bindable_port(base: int, tries: int = 16) -> int | None:
+        """First bindable port in [base, base+tries): N replicas sharing a
+        host (and a PROFILER_PORT default) each walk to a free port instead
+        of the second-and-later ones logging a bind failure every boot."""
+        import socket
+
+        for port in range(base, base + tries):
+            try:
+                with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    s.bind(("0.0.0.0", port))
+                return port
+            except OSError:
+                continue
+        return None
+
     def _start_profiler_server(self) -> None:
         """jax.profiler gRPC server for live tensorboard/xprof attach, on
-        PROFILER_PORT (0 disables). DEBUG-gated like the pprof routes."""
-        port = self.config.get_int("PROFILER_PORT", 9999)
-        if port <= 0:
+        PROFILER_PORT (<=0 disables, 'auto' derives from the serving port;
+        a busy port retries upward). DEBUG-gated like the pprof routes."""
+        base = self._profiler_port_base()
+        if base is None:
+            return
+        port = self._bindable_port(base)
+        if port is None:
+            self.logger.warn(f"no free profiler port in [{base}, {base + 16}); "
+                             "profiler server disabled")
             return
         try:
             import jax
@@ -590,15 +632,24 @@ class App:
     async def _profile_handler(self, request: web.Request) -> web.Response:
         """GET /debug/profile?seconds=N → capture an xplane trace of whatever
         the engines/handlers are doing for N seconds; returns the trace dir
-        (open with tensorboard/xprof)."""
+        (open with tensorboard/xprof). Bounded so a stray curl can't pin the
+        process or fill disk: absurd N is a 400 (sane N still clamps to
+        [0.1, 60]), and only ONE capture runs at a time — 409 while busy."""
         try:
             seconds = float(request.query.get("seconds", "2"))
             if not math.isfinite(seconds):
                 raise ValueError(seconds)
-            seconds = min(max(seconds, 0.1), 60.0)
         except ValueError:
             return web.json_response(
                 {"error": {"message": "seconds must be a finite number"}}, status=400)
+        if seconds <= 0 or seconds > 300.0:
+            return web.json_response(
+                {"error": {"message": "seconds must be in (0, 300]"}}, status=400)
+        seconds = min(max(seconds, 0.1), 60.0)
+        if not self._profile_busy.acquire(blocking=False):
+            return web.json_response(
+                {"error": {"message": "a profile capture is already running"}},
+                status=409)
         out_root = self.config.get_or_default("PROFILER_DIR", "/tmp/gofr_tpu_profile")
 
         def capture() -> str:
@@ -616,6 +667,8 @@ class App:
             path = await loop.run_in_executor(self._executor, capture)
         except Exception as e:  # noqa: BLE001
             return web.json_response({"error": {"message": str(e)}}, status=500)
+        finally:
+            self._profile_busy.release()
         return web.json_response({"data": {"trace_dir": path, "seconds": seconds}})
 
     @staticmethod

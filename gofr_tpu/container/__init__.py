@@ -54,6 +54,8 @@ class Container:
         self.services: dict[str, Any] = {}
         self._engines: dict[str, Any] = {}
         self.qos = None  # AdmissionController once App.enable_qos runs
+        self.slo = None  # SLOEngine once _maybe_slo runs (SLO_ENABLED)
+        self.slo_capture = None  # CaptureWatcher once SLO_CAPTURE opts in
         self._remote_level_poller = None
         self._pubsub_hdr_support: tuple[Any, bool] | None = None  # per-broker probe cache
 
@@ -67,6 +69,7 @@ class Container:
         c.metrics.add_collect_hook(c._sample_tpu_metrics)
         c.tracer = tracer_from_config(config, c.logger, c.app_name)
         c._maybe_remote_log_level()
+        c._maybe_slo()
         c._maybe_sql()
         c._maybe_redis()
         c._maybe_pubsub()
@@ -170,13 +173,32 @@ class Container:
         m.new_counter("app_qos_admitted_total", "requests admitted by QoS")
         m.new_counter("app_qos_rejected_total",
                       "requests rejected by QoS (reason: rate/route_rate/key_rate/"
-                      "tenant_rate/queue/deadline/capacity/restart)")
+                      "tenant_rate/queue/deadline/capacity/restart/slo_burn)")
         m.new_counter("app_qos_shed_total", "requests shed under overload (503s)")
         m.new_gauge("app_qos_queue_depth", "queued requests per priority class")
         m.new_gauge("app_qos_predicted_wait_seconds",
                     "estimated queue wait per engine (EWMA step x backlog)")
         m.new_histogram("app_qos_queue_wait_seconds",
                         "time requests spent queued before reaching the device loop")
+        # SLO plane (metrics/slo.py, docs/observability.md): attainment and
+        # Google-SRE error-budget burn per (class, objective); refreshed by
+        # the SLOEngine collect hook on every scrape
+        m.new_gauge("app_slo_attainment",
+                    "fraction of samples meeting the objective (class, objective, window)")
+        m.new_gauge("app_slo_burn_rate",
+                    "error-budget burn rate; 1.0 = sustainable pace (class, objective, window)")
+        m.new_gauge("app_slo_budget_remaining",
+                    "slow-window error budget left, clamped to [0,1] (class, objective)")
+        m.new_counter("app_slo_captures_total",
+                      "anomaly bundles written by the burn-breach capture watcher")
+        m.new_counter("app_slo_captures_suppressed_total",
+                      "burn-breach captures suppressed by the token-bucket rate limit")
+        # router decision metrics (ISSUE 9 satellite: the affinity hit ratio
+        # used to live only in the /debug/router JSON view)
+        m.new_counter("app_router_decisions_total",
+                      "router routing decisions (replica; decision = home|spill|shed|error)")
+        m.new_gauge("app_router_affinity_hit_ratio",
+                    "home-replica hit fraction of routed requests since router start")
 
     def _sample_tpu_metrics(self, _registry=None) -> None:
         """Collect hook: live HBM gauges on every /metrics scrape (the
@@ -204,6 +226,22 @@ class Container:
         interval = self.config.get_float("REMOTE_LOG_FETCH_INTERVAL", 15.0)
         self._remote_level_poller = RemoteLevelPoller(self.logger, url, interval)
         self._remote_level_poller.start()
+
+    def _maybe_slo(self) -> None:
+        """Wire the SLO engine (on by default — it is pure bookkeeping over
+        samples the engines already record) and, only when the app opts in
+        via SLO_CAPTURE, the burn-breach anomaly capture watcher."""
+        if not self.config.get_bool("SLO_ENABLED", True):
+            return
+        from gofr_tpu.metrics.slo import CaptureWatcher, SLOEngine
+
+        self.slo = SLOEngine.from_config(
+            self.config, metrics=self.metrics, logger=self.logger)
+        self.metrics.add_collect_hook(self.slo.sample_gauges)
+        if self.config.get_bool("SLO_CAPTURE"):
+            self.slo_capture = CaptureWatcher.from_config(
+                self.config, self, self.slo)
+            self.slo.add_breach_listener(self.slo_capture.on_breach)
 
     def _maybe_sql(self) -> None:
         dialect = (self.config.get("DB_DIALECT") or "").lower()
@@ -399,6 +437,7 @@ class Container:
         check("clickhouse", self.clickhouse)
         check("tpu", self._tpu)
         check("qos", self.qos)
+        check("slo", self.slo)
         for name, engine in self._engines.items():
             check(f"model:{name}", engine)
         for name, svc in self.services.items():
@@ -437,5 +476,6 @@ def new_mock_container(config: dict[str, str] | None = None) -> Container:
     c = Container(DictConfig(config or {}), logger=MockLogger(level=Level.DEBUG))
     c._register_framework_metrics()
     c.metrics.add_collect_hook(c._sample_tpu_metrics)
+    c._maybe_slo()  # mock containers skip create(); SLO must still wire
     c.pubsub = InMemoryBroker()
     return c

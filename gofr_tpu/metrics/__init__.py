@@ -13,6 +13,11 @@ the engines record the SLO latency family (``app_tpu_{queue_wait,ttft,tpot,
 e2e}_seconds``, ``app_tpu_inflight_requests``) here, and the sibling
 ``metrics.flight`` module keeps the always-on ring of recent request
 timelines and device steps behind ``/debug/requests`` / ``/debug/engine``.
+
+Fleet federation (``metrics.federation``) reads the per-series state via the
+``series()`` accessors below and the sibling ``metrics.slo`` module derives
+per-class attainment/burn-rate from the same samples the SLO latency family
+records — both expose through this registry's collect hooks.
 """
 
 from __future__ import annotations
@@ -97,6 +102,11 @@ class Counter(_Metric):
     def value(self, **labels: str) -> float:
         return self._values.get(_labelset(labels), 0.0)
 
+    def series(self) -> list[tuple[LabelSet, float]]:
+        """Consistent (labelset, value) snapshot for federation digests."""
+        with self._lock:
+            return list(self._values.items())
+
 
 class UpDownCounter(Counter):
     kind = "gauge"  # prometheus has no up-down counter type
@@ -131,6 +141,11 @@ class Gauge(_Metric):
 
     def value(self, **labels: str) -> float:
         return self._values.get(_labelset(labels), 0.0)
+
+    def series(self) -> list[tuple[LabelSet, float]]:
+        """Consistent (labelset, value) snapshot for federation digests."""
+        with self._lock:
+            return list(self._values.items())
 
 
 class Histogram(_Metric):
@@ -177,6 +192,16 @@ class Histogram(_Metric):
 
     def sum(self, **labels: str) -> float:
         return self._sums.get(_labelset(labels), 0.0)
+
+    def series(self) -> list[tuple[LabelSet, list[int], float, int]]:
+        """Consistent (labelset, per-bucket counts, sum, total) snapshot.
+        Counts are NON-cumulative and aligned to ``self.buckets``;
+        ``total - sum(counts)`` is the +Inf overflow tail. This is the
+        merge-safe form federation ships: bucket counts from replicas with
+        identical ladders add element-wise, unlike percentiles."""
+        with self._lock:
+            return [(ls, list(c), self._sums[ls], self._totals[ls])
+                    for ls, c in self._counts.items()]
 
 
 class Registry:

@@ -1,0 +1,361 @@
+"""Fleet metrics federation: compact replica digests, correct merges.
+
+Each replica's ``GossipReporter`` attaches a digest built by ``digest()``
+to its periodic snapshot (router/gossip.py) — a JSON-safe dict of the
+high-signal counters and latency histograms plus the SLO snapshot and
+inflight count. The payload is deliberately small (a handful of families,
+raw bucket counts, no exposition text): the same small-payload lesson the
+gRPC/TensorFlow microbenchmarks drew for frequent cross-process state
+transfer (PAPERS.md, 1804.01138). The router stores the last digest per
+replica (``Replica.digest``) and serves two fleet views from it:
+
+- ``fleet_text()`` → Prometheus exposition for the router's ``/metrics``:
+  per-replica series carry a ``replica`` label; aggregate series carry no
+  replica label. Counters aggregate by summing; histograms aggregate by
+  element-wise bucket-count addition ONLY when every replica shares the
+  same bucket ladder (otherwise only per-replica series are emitted);
+  percentiles are NEVER aggregated — a fleet pXX must be read off the
+  merged buckets (``histogram_quantile``), because the average of
+  per-replica percentiles is not a percentile of anything.
+- ``aggregate_slo()`` → exact fleet attainment/burn per (class, objective,
+  window) by summing the good/total counts the SLO snapshot carries —
+  again a merge of counts, never an average of ratios.
+
+Everything here is pure data-plumbing over the ``series()`` accessors in
+``gofr_tpu.metrics``; no locks, no I/O, trivially testable.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterable, Mapping
+
+from gofr_tpu.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    LabelSet,
+    Registry,
+    _fmt_labels,
+    _fmt_value,
+)
+
+__all__ = [
+    "DIGEST_COUNTERS",
+    "DIGEST_GAUGES",
+    "DIGEST_HISTOGRAMS",
+    "aggregate_slo",
+    "digest",
+    "fleet_text",
+    "histogram_quantile",
+]
+
+# the high-signal families worth shipping every gossip interval; anything
+# else stays scrape-only on the replica's own /metrics port
+DIGEST_COUNTERS: tuple[str, ...] = (
+    "app_tpu_tokens_total",
+    "app_qos_shed_total",
+    "app_qos_rejected_total",
+    "app_tpu_engine_restarts",
+)
+DIGEST_HISTOGRAMS: tuple[str, ...] = (
+    "app_tpu_ttft_seconds",
+    "app_tpu_tpot_seconds",
+    "app_tpu_e2e_seconds",
+    "app_tpu_queue_wait_seconds",
+)
+DIGEST_GAUGES: tuple[str, ...] = (
+    "app_tpu_inflight_requests",
+)
+
+
+def _ls_to_json(ls: LabelSet) -> list[list[str]]:
+    return [[k, v] for k, v in ls]
+
+
+def _ls_from_json(pairs: Iterable[Iterable[str]]) -> LabelSet:
+    return tuple(sorted((str(k), str(v)) for k, v in pairs))
+
+
+def digest(registry: Registry, *, slo=None, inflight: int | None = None,
+           counters: Iterable[str] = DIGEST_COUNTERS,
+           histograms: Iterable[str] = DIGEST_HISTOGRAMS,
+           gauges: Iterable[str] = DIGEST_GAUGES) -> dict[str, Any]:
+    """Compact, JSON-safe snapshot of one replica's federated state."""
+    out: dict[str, Any] = {"v": 1, "counters": {}, "hists": {}, "gauges": {}}
+    for name in counters:
+        m = registry.get(name)
+        if isinstance(m, Counter):
+            series = m.series()
+            if series:
+                out["counters"][name] = [
+                    [_ls_to_json(ls), v] for ls, v in series]
+    for name in histograms:
+        m = registry.get(name)
+        if isinstance(m, Histogram):
+            series = m.series()
+            if series:
+                out["hists"][name] = {
+                    "buckets": list(m.buckets),
+                    "series": [[_ls_to_json(ls), counts, s, total]
+                               for ls, counts, s, total in series],
+                }
+    for name in gauges:
+        m = registry.get(name)
+        if isinstance(m, Gauge):
+            series = m.series()
+            if series:
+                out["gauges"][name] = [[_ls_to_json(ls), v] for ls, v in series]
+    if slo is not None:
+        out["slo"] = slo.snapshot()
+    if inflight is not None:
+        out["inflight"] = int(inflight)
+    return out
+
+
+def histogram_quantile(buckets: Iterable[float], counts: Iterable[int],
+                       total: int, q: float) -> float | None:
+    """Estimate the q-quantile (q in [0, 1]) from NON-cumulative bucket
+    counts, returning the upper bound of the bucket the rank lands in —
+    the only legal way to get a fleet pXX (merge counts first, then read
+    the quantile; averaging per-replica percentiles is statistically
+    meaningless). Returns None with no samples, and +inf when the rank
+    falls in the overflow tail above the last finite bucket."""
+    total = int(total)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0
+    for b, c in zip(buckets, counts):
+        cum += c
+        if cum >= rank:
+            return float(b)
+    return math.inf
+
+
+# -- merge + exposition --------------------------------------------------------
+
+
+def _merge_counters(name: str, digests: Mapping[str, Mapping[str, Any]]):
+    """-> (aggregate {ls: value}, per-replica [(replica, ls, value)])."""
+    agg: dict[LabelSet, float] = {}
+    per: list[tuple[str, LabelSet, float]] = []
+    for replica in sorted(digests):
+        for pairs, v in digests[replica].get("counters", {}).get(name, []):
+            ls = _ls_from_json(pairs)
+            agg[ls] = agg.get(ls, 0.0) + float(v)
+            per.append((replica, ls, float(v)))
+    return agg, per
+
+
+def _merge_hists(name: str, digests: Mapping[str, Mapping[str, Any]]):
+    """-> (shared buckets | None, aggregate {ls: [counts, sum, total]},
+    per-replica [(replica, ls, buckets, counts, sum, total)]). The
+    aggregate is None-keyed out (empty) when replicas disagree on the
+    bucket ladder — summing mismatched buckets would silently corrupt
+    every derived quantile, so we refuse and keep per-replica series."""
+    ladders = set()
+    per: list[tuple[str, LabelSet, tuple, list[int], float, int]] = []
+    for replica in sorted(digests):
+        h = digests[replica].get("hists", {}).get(name)
+        if not h:
+            continue
+        buckets = tuple(float(b) for b in h.get("buckets", ()))
+        ladders.add(buckets)
+        for pairs, counts, s, total in h.get("series", []):
+            per.append((replica, _ls_from_json(pairs), buckets,
+                        [int(c) for c in counts], float(s), int(total)))
+    shared = next(iter(ladders)) if len(ladders) == 1 else None
+    agg: dict[LabelSet, list] = {}
+    if shared is not None:
+        for _, ls, _, counts, s, total in per:
+            cur = agg.get(ls)
+            if cur is None:
+                agg[ls] = [list(counts), s, total]
+            else:
+                for i, c in enumerate(counts):
+                    cur[0][i] += c
+                cur[1] += s
+                cur[2] += total
+    return shared, agg, per
+
+
+def _with_replica(ls: LabelSet, replica: str) -> LabelSet:
+    return tuple(sorted(ls + (("replica", replica),)))
+
+
+def _hist_lines(name: str, ls: LabelSet, buckets, counts, total_sum, total,
+                lines: list[str]) -> None:
+    cum = 0
+    for b, c in zip(buckets, counts):
+        cum += c
+        le = 'le="' + _fmt_value(b) + '"'
+        lines.append(f"{name}_bucket{_fmt_labels(ls, le)} {cum}")
+    inf = 'le="+Inf"'
+    lines.append(f"{name}_bucket{_fmt_labels(ls, inf)} {total}")
+    lines.append(f"{name}_sum{_fmt_labels(ls)} {_fmt_value(total_sum)}")
+    lines.append(f"{name}_count{_fmt_labels(ls)} {total}")
+
+
+def fleet_text(digests: Mapping[str, Mapping[str, Any]],
+               states: Mapping[str, Mapping[str, Any]] | None = None) -> str:
+    """Prometheus exposition for the router's fleet ``/metrics``: aggregate
+    series (no replica label) + per-replica series (``replica=...``), plus
+    registry-state gauges and the per-replica SLO attainment/burn gauges
+    derived from the digests' SLO snapshots."""
+    lines: list[str] = []
+
+    names = sorted({n for d in digests.values()
+                    for n in d.get("counters", {})})
+    for name in names:
+        agg, per = _merge_counters(name, digests)
+        lines.append(f"# TYPE {name} counter")
+        for ls in sorted(agg):
+            lines.append(f"{name}{_fmt_labels(ls)} {_fmt_value(agg[ls])}")
+        for replica, ls, v in per:
+            lines.append(
+                f"{name}{_fmt_labels(_with_replica(ls, replica))} {_fmt_value(v)}")
+
+    names = sorted({n for d in digests.values() for n in d.get("hists", {})})
+    for name in names:
+        shared, agg, per = _merge_hists(name, digests)
+        lines.append(f"# TYPE {name} histogram")
+        if shared is not None:
+            for ls in sorted(agg):
+                counts, s, total = agg[ls]
+                _hist_lines(name, ls, shared, counts, s, total, lines)
+        for replica, ls, buckets, counts, s, total in per:
+            _hist_lines(name, _with_replica(ls, replica), buckets, counts,
+                        s, total, lines)
+
+    names = sorted({n for d in digests.values() for n in d.get("gauges", {})})
+    for name in names:
+        lines.append(f"# TYPE {name} gauge")
+        for replica in sorted(digests):
+            for pairs, v in digests[replica].get("gauges", {}).get(name, []):
+                ls = _with_replica(_ls_from_json(pairs), replica)
+                lines.append(f"{name}{_fmt_labels(ls)} {_fmt_value(v)}")
+
+    _slo_lines(digests, lines)
+    _state_lines(digests, states or {}, lines)
+    return "\n".join(lines) + "\n"
+
+
+def _slo_lines(digests: Mapping[str, Mapping[str, Any]],
+               lines: list[str]) -> None:
+    """Per-replica + exact-merged aggregate SLO gauges. Attainment merges
+    as sum(good)/sum(total) — counts, never an average of ratios."""
+    have = any(d.get("slo") for d in digests.values())
+    if not have:
+        return
+    fleet = aggregate_slo(digests)
+    lines.append("# TYPE app_slo_attainment gauge")
+    att: list[str] = []
+    burn: list[str] = []
+    for cname in sorted(fleet):
+        for oname in sorted(fleet[cname]):
+            entry = fleet[cname][oname]
+            for w in ("fast", "slow"):
+                win = entry[w]
+                ls: LabelSet = tuple(sorted(
+                    (("class", cname), ("objective", oname), ("window", w))))
+                if win["attainment"] is not None:
+                    att.append(
+                        f"app_slo_attainment{_fmt_labels(ls)} "
+                        f"{_fmt_value(win['attainment'])}")
+                if win["burn_rate"] is not None:
+                    burn.append(
+                        f"app_slo_burn_rate{_fmt_labels(ls)} "
+                        f"{_fmt_value(win['burn_rate'])}")
+    for replica in sorted(digests):
+        snap = digests[replica].get("slo") or {}
+        for cname in sorted(snap):
+            for oname in sorted(snap[cname]):
+                entry = snap[cname][oname]
+                for w in ("fast", "slow"):
+                    win = entry.get(w) or {}
+                    ls = tuple(sorted((("class", cname), ("objective", oname),
+                                       ("window", w), ("replica", replica))))
+                    if win.get("attainment") is not None:
+                        att.append(
+                            f"app_slo_attainment{_fmt_labels(ls)} "
+                            f"{_fmt_value(win['attainment'])}")
+                    if win.get("burn_rate") is not None:
+                        burn.append(
+                            f"app_slo_burn_rate{_fmt_labels(ls)} "
+                            f"{_fmt_value(win['burn_rate'])}")
+    lines.extend(att)
+    lines.append("# TYPE app_slo_burn_rate gauge")
+    lines.extend(burn)
+
+
+def _state_lines(digests: Mapping[str, Mapping[str, Any]],
+                 states: Mapping[str, Mapping[str, Any]],
+                 lines: list[str]) -> None:
+    if not states and not any("inflight" in d for d in digests.values()):
+        return
+    if states:
+        lines.append("# TYPE app_fleet_replica_up gauge")
+        for replica in sorted(states):
+            st = states[replica]
+            up = 1 if str(st.get("status", "")).upper() == "UP" else 0
+            ls: LabelSet = (("replica", replica),)
+            lines.append(f"app_fleet_replica_up{_fmt_labels(ls)} {up}")
+        lines.append("# TYPE app_fleet_replica_epoch gauge")
+        for replica in sorted(states):
+            ls = (("replica", replica),)
+            lines.append(
+                f"app_fleet_replica_epoch{_fmt_labels(ls)} "
+                f"{int(states[replica].get('epoch', 0) or 0)}")
+    inflight = {r: d["inflight"] for r, d in digests.items()
+                if isinstance(d.get("inflight"), int)}
+    if inflight:
+        lines.append("# TYPE app_fleet_replica_inflight gauge")
+        for replica in sorted(inflight):
+            ls = (("replica", replica),)
+            lines.append(
+                f"app_fleet_replica_inflight{_fmt_labels(ls)} "
+                f"{inflight[replica]}")
+
+
+def aggregate_slo(digests: Mapping[str, Mapping[str, Any]]) -> dict[str, Any]:
+    """Exact fleet SLO roll-up: per (class, objective, window), sum the
+    good/total counts from every replica's snapshot and recompute
+    attainment/burn from the sums. Target is taken as the max across
+    replicas (the conservative bound if configs momentarily disagree)."""
+    acc: dict[tuple[str, str], dict[str, Any]] = {}
+    for d in digests.values():
+        snap = d.get("slo") or {}
+        for cname, objs in snap.items():
+            for oname, entry in objs.items():
+                key = (cname, oname)
+                cur = acc.setdefault(key, {
+                    "target": 0.0,
+                    "fast": {"good": 0, "total": 0},
+                    "slow": {"good": 0, "total": 0},
+                })
+                cur["target"] = max(cur["target"], float(entry.get("target", 0.0)))
+                for w in ("fast", "slow"):
+                    win = entry.get(w) or {}
+                    cur[w]["good"] += int(win.get("good", 0) or 0)
+                    cur[w]["total"] += int(win.get("total", 0) or 0)
+    out: dict[str, Any] = {}
+    for (cname, oname), cur in acc.items():
+        entry: dict[str, Any] = {"target": cur["target"]}
+        budget = 1.0 - cur["target"]
+        for w in ("fast", "slow"):
+            good, total = cur[w]["good"], cur[w]["total"]
+            att = good / total if total else None
+            burn = ((1.0 - att) / budget
+                    if att is not None and budget > 0 else None)
+            entry[w] = {
+                "good": good, "total": total,
+                "attainment": round(att, 6) if att is not None else None,
+                "burn_rate": round(burn, 4) if burn is not None else None,
+            }
+        slow_burn = entry["slow"]["burn_rate"]
+        entry["budget_remaining"] = (
+            round(max(0.0, min(1.0, 1.0 - slow_burn)), 4)
+            if slow_burn is not None else None)
+        out.setdefault(cname, {})[oname] = entry
+    return out

@@ -93,6 +93,7 @@ class QoSPolicy:
     tenant_rps: float = 0.0                 # QOS_TENANT_RPS (per X-Tenant-ID)
     max_queue: int = 0                      # QOS_MAX_QUEUE (backlog shed; 0 = off)
     shed_window_s: float = 10.0             # QOS_SHED_WINDOW_S (DEGRADED window)
+    shed_on_burn: bool = False              # QOS_SHED_ON_BURN (SLO pressure signal)
     class_header: str = "X-QoS-Class"       # QOS_CLASS_HEADER
     tenant_header: str = "X-Tenant-ID"      # QOS_TENANT_HEADER
 
@@ -147,6 +148,7 @@ class QoSPolicy:
         kw["tenant_rps"] = config.get_float("QOS_TENANT_RPS", 0.0)
         kw["max_queue"] = config.get_int("QOS_MAX_QUEUE", 0)
         kw["shed_window_s"] = config.get_float("QOS_SHED_WINDOW_S", 10.0)
+        kw["shed_on_burn"] = config.get_bool("QOS_SHED_ON_BURN")
         kw["class_header"] = config.get_or_default("QOS_CLASS_HEADER", "X-QoS-Class")
         kw["tenant_header"] = config.get_or_default("QOS_TENANT_HEADER", "X-Tenant-ID")
         kw.update(overrides)
@@ -295,6 +297,18 @@ class AdmissionController:
             raise ServiceUnavailable(
                 "engine restarting after a device fault; retry later",
                 retry_after=wait)
+        if self.policy.shed_on_burn:
+            # SLO pressure signal (metrics/slo.py, QOS_SHED_ON_BURN): while
+            # a strictly higher-priority class is burning its fast-window
+            # error budget, lower classes are shed — the freed capacity is
+            # exactly what the burning class needs (docs/qos.md)
+            slo = getattr(engine, "slo", None)
+            if slo is not None and slo.should_shed(cls.name):
+                wait = self._ewma_step or 1.0
+                self._reject(cls, "slo_burn", 503, wait)
+                raise ServiceUnavailable(
+                    f"class {cls.name!r} shed while a higher class burns "
+                    "its SLO error budget; retry later", retry_after=wait)
         if self.policy.max_queue and engine._backlog() >= self.policy.max_queue:
             wait = self.predicted_wait(engine) or 1.0
             self._reject(cls, "queue", 503, wait)
@@ -337,7 +351,7 @@ class AdmissionController:
                 retry_after: float) -> None:
         self.metrics.increment_counter("app_qos_rejected_total", 1,
                                        reason=reason, qos_class=cls.name)
-        if reason in ("queue", "deadline", "capacity", "restart"):
+        if reason in ("queue", "deadline", "capacity", "restart", "slo_burn"):
             # overload-driven (we turned away feasible work because of
             # load), as opposed to a client exceeding its rate budget —
             # this is what flips health to DEGRADED for the shed window

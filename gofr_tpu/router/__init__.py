@@ -154,6 +154,10 @@ class Router:
         self._lock = threading.Lock()
         self._decisions: deque = deque(maxlen=256)
         self._stats = {"requests": 0, "home": 0, "spill": 0, "shed": 0}
+        # per-replica decision counts for /debug/fleet + the federated
+        # app_router_decisions_total metric (ISSUE 9: the affinity ratio
+        # used to live only in the /debug/router JSON view)
+        self._per_replica: dict[str, dict[str, int]] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -392,12 +396,29 @@ class Router:
             return c
 
     def _record(self, p: RoutePlan, sent: str | None, outcome: str) -> None:
+        if outcome.startswith("shed"):
+            decision = "shed"
+        elif outcome == "error":
+            decision = "error"
+        else:
+            decision = "home" if sent == p.home else "spill"
         with self._lock:  # debug_view iterates this deque under the lock
             self._decisions.append({
                 "t": round(time.time(), 3), "key": f"{p.key:016x}",
                 "qos_class": p.qos_class, "home": p.home, "sent": sent,
                 "outcome": outcome,
             })
+            counts = self._per_replica.setdefault(
+                sent or p.home or "none",
+                {"home": 0, "spill": 0, "shed": 0, "error": 0})
+            counts[decision] += 1
+            home = self._stats["home"]
+            routed = home + self._stats["spill"]
+        m = self.container.metrics
+        m.increment_counter("app_router_decisions_total", 1,
+                            replica=sent or p.home or "none", decision=decision)
+        if routed:
+            m.set_gauge("app_router_affinity_hit_ratio", home / routed)
 
     # -- gossip subscription ---------------------------------------------------
 
@@ -473,11 +494,74 @@ class Router:
         for method, route_path in routes or (("POST", "/generate"),
                                              ("POST", "/generate/stream")):
             app.add_route(method, route_path, self.handle)
+        # fleet-aggregated Prometheus exposition (metrics/federation.py):
+        # one scrape answers for the whole fleet — per-replica labels +
+        # correctly-merged aggregates (the router app's own registry still
+        # serves its local /metrics on METRICS_PORT as usual)
+        fleet = lambda _ctx: Passthrough(  # noqa: E731
+            self.fleet_metrics_text().encode(),
+            status_code=200, content_type="text/plain")
+        app.get("/metrics", fleet)
+        app.get("/metrics/fleet", fleet)
         if app._debug_env():
             # same envelope as /debug/requests and /debug/engine
             app.get("/debug/router", lambda _ctx: Raw({"data": self.debug_view()}))
+            app.get("/debug/fleet", lambda _ctx: Raw({"data": self.fleet_view()}))
         app.on_cleanup(self.stop)  # the gossip thread dies with the app
         return self.start()
+
+    def digests(self) -> dict[str, dict[str, Any]]:
+        """Last known metrics/SLO digest per replica (gossip-fed)."""
+        return {name: r.digest for name, r in self.registry.replicas().items()
+                if isinstance(r.digest, dict)}
+
+    def fleet_metrics_text(self) -> str:
+        """Fleet-aggregated Prometheus exposition over the gossiped
+        digests: aggregate series without a replica label, per-replica
+        series with one; counters summed, histogram buckets merged,
+        percentiles never averaged (read them off the merged buckets)."""
+        from gofr_tpu.metrics import federation
+
+        self.registry.sweep()
+        states = {name: {"status": r.status, "epoch": r.epoch}
+                  for name, r in self.registry.replicas().items()}
+        return federation.fleet_text(self.digests(), states)
+
+    def fleet_view(self) -> dict[str, Any]:
+        """The /debug/fleet payload: registry state (UP/shedding/restart,
+        epoch) joined with each replica's gossiped attainment, burn rate
+        and inflight, plus the exact fleet-level per-class SLO roll-up and
+        the router's own decision counters — one endpoint answering "is
+        the fleet healthy and who is burning budget"."""
+        from gofr_tpu.metrics import federation
+
+        self.registry.sweep()
+        with self._lock:
+            stats = dict(self._stats)
+            per_replica = {n: dict(c) for n, c in self._per_replica.items()}
+        routed = stats["home"] + stats["spill"]
+        stats["affinity_hit_ratio"] = (
+            round(stats["home"] / routed, 4) if routed else None)
+        digests = {}
+        replicas = []
+        for name, r in sorted(self.registry.replicas().items()):
+            d = r.to_dict()
+            if isinstance(r.digest, dict):
+                digests[name] = r.digest
+                d["inflight"] = r.digest.get("inflight")
+                d["slo"] = _slo_brief(r.digest.get("slo"))
+            counts = per_replica.get(name)
+            if counts:
+                sent = counts["home"] + counts["spill"]
+                d["decisions"] = counts
+                d["affinity_hit_ratio"] = (
+                    round(counts["home"] / sent, 4) if sent else None)
+            replicas.append(d)
+        return {
+            "replicas": replicas,
+            "classes": federation.aggregate_slo(digests),
+            "stats": stats,
+        }
 
     def debug_view(self) -> dict[str, Any]:
         """The /debug/router payload: ring membership, per-replica state,
@@ -496,3 +580,22 @@ class Router:
             "stats": stats,
             "decisions": decisions,
         }
+
+
+def _slo_brief(snap: dict | None) -> dict[str, Any] | None:
+    """Compact per-replica SLO summary for /debug/fleet: fast-window
+    attainment/burn + remaining budget per (class, objective) — the full
+    windows stay available on the replica's own /metrics."""
+    if not isinstance(snap, dict):
+        return None
+    out: dict[str, Any] = {}
+    for cname, objs in snap.items():
+        for oname, entry in (objs or {}).items():
+            fast = entry.get("fast") or {}
+            if fast.get("total"):
+                out.setdefault(cname, {})[oname] = {
+                    "attainment": fast.get("attainment"),
+                    "burn_rate": fast.get("burn_rate"),
+                    "budget_remaining": entry.get("budget_remaining"),
+                }
+    return out or None

@@ -18,6 +18,10 @@ Snapshot schema (one JSON object per message):
     shedding    QoS shed within its window (AdmissionController.shedding)
     retry_after backoff hint (s) for router-side sheds while unavailable
     seq, ts     per-reporter sequence + wall clock (debug only)
+    digest      compact metrics/SLO digest (metrics/federation.py) for the
+                router's fleet ``/metrics`` + ``/debug/fleet``; attached
+                every ``ROUTER_GOSSIP_DIGEST_EVERY``-th publish (default
+                every publish; 0 disables the digest entirely)
 
 ``stop()`` publishes a terminal ``DOWN`` so graceful shutdown leaves the
 ring immediately instead of waiting out the router's gossip TTL.
@@ -46,6 +50,7 @@ class GossipReporter:
         self.interval_s = (float(interval_s) if interval_s is not None
                            else conf.get_float("ROUTER_GOSSIP_INTERVAL_S", 1.0))
         self.retry_after_s = float(retry_after_s)
+        self.digest_every = conf.get_int("ROUTER_GOSSIP_DIGEST_EVERY", 1)
         self._seq = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -72,12 +77,25 @@ class GossipReporter:
         qos = self.container.qos
         shedding = bool(qos.shedding) if qos is not None else False
         self._seq += 1
-        return {
+        snap: dict[str, Any] = {
             "replica": self.name, "url": self.url, "status": status,
             "epoch": epoch, "restarting": restarting, "shedding": shedding,
             "retry_after": self.retry_after_s, "seq": self._seq,
             "ts": time.time(),
         }
+        if self.digest_every > 0 and self._seq % self.digest_every == 0:
+            try:
+                from gofr_tpu.metrics import federation
+
+                snap["digest"] = federation.digest(
+                    self.container.metrics,
+                    slo=getattr(self.container, "slo", None),
+                    inflight=sum(
+                        int(getattr(e, "_inflight_requests", 0))
+                        for e in self.container.engines.values()))
+            except Exception as e:  # noqa: BLE001 - liveness gossip outranks the digest
+                self.container.logger.warnf("gossip digest build failed: %r", e)
+        return snap
 
     def publish_once(self, status: str | None = None) -> None:
         snap = self.snapshot()

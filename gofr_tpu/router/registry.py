@@ -50,6 +50,10 @@ class Replica:
     drop_epoch: int = -1           # healthy_epoch at drop time (epoch-gate base)
     drop_at: float = 0.0
     readmit_at: float = 0.0
+    # last metrics/SLO digest gossiped by the replica (federation.digest
+    # schema); kept across digest-less publishes so a throttled
+    # ROUTER_GOSSIP_DIGEST_EVERY still leaves the fleet views populated
+    digest: dict[str, Any] | None = field(default=None, repr=False)
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -120,6 +124,9 @@ class ReplicaRegistry:
                 r.retry_after = float(msg.get("retry_after") or 0.0)
             except (TypeError, ValueError):
                 r.retry_after = 0.0
+            dig = msg.get("digest")
+            if isinstance(dig, dict):
+                r.digest = dig
             r.last_seen = self._now()
             if r.in_ring and r.status == "UP" and not r.restarting:
                 # the epoch-gate base: the engine bumps its restart counter

@@ -183,6 +183,10 @@ class _EngineBase:
         # timelines + device steps served at /debug/requests, /debug/engine
         self.tracer = getattr(container, "tracer", None)
         self.flight = getattr(container, "flight", None)
+        # SLO engine (metrics/slo.py): fed from the exact callsites that
+        # record the raw latency histograms, so attainment and the
+        # histograms can never disagree about what was measured
+        self.slo = getattr(container, "slo", None)
         self._obs_lock = threading.Lock()
         self._inflight_requests = 0
         # QoS-capable queue: pure FIFO (byte-for-byte queue.Queue behavior)
@@ -432,11 +436,18 @@ class _EngineBase:
         if rt is not None:
             rt.close_all(error)
         e2e = now - req.enqueued_at
+        if self.slo is not None:
+            # availability counts EVERY outcome (errors, timeouts, sheds all
+            # burn budget); the e2e latency objective, like the histogram
+            # below, judges completed work only
+            self.slo.observe_outcome(kw.get("_qos_class"), error is None)
         if error is None:
             # completed work only: a timeout/shed storm must not drag the
             # served-latency SLO histogram toward its own failure mode
             self.metrics.record_histogram(
                 "app_tpu_e2e_seconds", e2e, qos_class=kw.get("_qos_class") or "none")
+            if self.slo is not None:
+                self.slo.observe(kw.get("_qos_class"), "e2e", e2e)
         if self.flight is None:
             return
         admitted = kw.get("_admitted_at")
@@ -492,6 +503,9 @@ class _EngineBase:
             req.kw["_first_token_at"] = ft
             self.metrics.record_histogram(
                 "app_tpu_ttft_seconds", ft - req.enqueued_at)
+            if self.slo is not None:
+                self.slo.observe(req.kw.get("_qos_class"), "ttft",
+                                 ft - req.enqueued_at)
 
     def _record_step(self, kind: str, seconds: float, occupancy: float, signature: tuple) -> None:
         # called at COMPLETION (dequeue) time under the unified pipeline:
@@ -2676,6 +2690,9 @@ class GenerateEngine(_EngineBase):
             # job), so tpot isolates the per-token device-loop cost
             self.metrics.record_histogram(
                 "app_tpu_tpot_seconds", (now - ft) / (len(tokens) - 1))
+            if self.slo is not None:
+                self.slo.observe(s.request.kw.get("_qos_class"), "tpot",
+                                 (now - ft) / (len(tokens) - 1))
         rt = s.request.kw.get("_rt")
         if rt is not None:
             attrs: dict[str, Any] = {"tokens": len(tokens), "finish.reason": finish}

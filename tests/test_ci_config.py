@@ -155,6 +155,25 @@ def test_router_suite_is_in_quick_tier():
     assert "def test_two_replica" in text and "def test_replica_kill" in text
 
 
+def test_slo_suite_is_in_quick_tier():
+    """ISSUE 9 satellite: the SLO plane — window/burn arithmetic, the
+    federation merge semantics (never average percentiles), the capture
+    rate limit (fake clocks), and the two-replica federation drill — is
+    pure bookkeeping over injectable clocks, CPU-trivial by construction,
+    and must ride the `-m quick` CI job on every push."""
+    path = REPO / "tests" / "test_slo.py"
+    assert path.exists(), "tests/test_slo.py missing"
+    text = path.read_text()
+    assert "pytest.mark.quick" in text, "SLO units must be quick-marked"
+    assert "test_slo.py" not in QUICK_EXEMPT, (
+        "test_slo.py must not be exempted from the quick tier"
+    )
+    # the tentpole's three pieces are all covered: burn math + health,
+    # router-side federation, and the rate-limited anomaly capture
+    assert "burn" in text and "federation" in text
+    assert "CaptureWatcher" in text and "def test_two_replica" in text
+
+
 def test_ci_has_py310_compat_gate():
     """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
     syntax (same-quote nested f-strings) passes every 3.12 job silently and

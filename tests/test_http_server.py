@@ -327,6 +327,19 @@ def test_profile_route_gated_on_debug_env():
             produced = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
             assert produced, "profiler produced no trace files"
             assert c.get("/debug/profile", params={"seconds": "nan3"}).status_code == 400
+            # absurd N is rejected outright (400), not silently clamped
+            assert c.get("/debug/profile", params={"seconds": "1e9"}).status_code == 400
+            assert c.get("/debug/profile", params={"seconds": "0"}).status_code == 400
+            assert c.get("/debug/profile", params={"seconds": "-5"}).status_code == 400
+            # one capture at a time: 409 while another is running
+            assert app._profile_busy.acquire(blocking=False)
+            try:
+                r = c.get("/debug/profile", params={"seconds": "0.3"})
+                assert r.status_code == 409, r.text
+            finally:
+                app._profile_busy.release()
+            r = c.get("/debug/profile", params={"seconds": "0.2"})
+            assert r.status_code == 200, r.text  # lock released after capture
     finally:
         shutil.rmtree(out_dir, ignore_errors=True)
 

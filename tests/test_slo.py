@@ -1,0 +1,575 @@
+"""Fleet-wide SLO plane (ISSUE 9; docs/observability.md).
+
+Quick tier, no TPU: window-ring arithmetic against hand-computed values,
+burn-rate → DEGRADED health and recovery, config-driven objective parsing,
+metrics federation merge semantics (counters summed, histogram buckets
+merged only on identical ladders, percentiles NEVER averaged), the
+token-bucket rate limit on trigger-fired anomaly capture, the router's
+affinity/decision metrics, profiler-port collision handling, and the
+acceptance drill: two in-process replicas gossiping digests to a router
+whose /metrics and /debug/fleet views show per-replica AND exactly-merged
+aggregate attainment, with a breach flipping health and firing exactly one
+capture bundle.
+"""
+
+import json
+import socket
+
+import pytest
+
+from gofr_tpu.config import DictConfig
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.metrics import Registry, federation
+from gofr_tpu.metrics.slo import (
+    CaptureWatcher,
+    Objective,
+    SLOEngine,
+    SLOTracker,
+    _WindowRing,
+)
+from gofr_tpu.router import Router, RoutePlan, RouterPolicy
+from gofr_tpu.router.gossip import GossipReporter
+
+
+class _Clock:
+    """Injectable monotonic clock: SLO windows and capture token buckets
+    must be testable without sleeping."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- window math ---------------------------------------------------------------
+
+
+@pytest.mark.quick
+class TestWindowRing:
+    def test_counts_match_hand_computed(self):
+        ring = _WindowRing(60.0, buckets=6)
+        clock = _Clock()
+        for i in range(10):
+            ring.observe(i < 8, clock())
+            clock.advance(1.0)
+        good, total = ring.stats(clock())
+        assert (good, total) == (8, 10)
+
+    def test_old_buckets_age_out_without_writes(self):
+        ring = _WindowRing(60.0, buckets=6)
+        clock = _Clock()
+        for _ in range(10):
+            ring.observe(True, clock())
+        clock.advance(61.0)  # a full window later, with zero traffic
+        assert ring.stats(clock()) == (0, 0)
+
+    def test_memory_is_bounded_by_bucket_count(self):
+        ring = _WindowRing(60.0, buckets=6)
+        clock = _Clock()
+        for _ in range(100_000):
+            ring.observe(True, clock())
+            clock.advance(0.001)
+        assert len(ring._good) == 6 and len(ring._total) == 6
+
+    def test_recycled_slot_resets(self):
+        ring = _WindowRing(6.0, buckets=6)  # 1s-wide buckets
+        clock = _Clock()
+        ring.observe(False, clock())
+        clock.advance(6.0)  # same slot index mod n, new epoch
+        ring.observe(True, clock())
+        good, total = ring.stats(clock())
+        assert (good, total) == (1, 1)  # the old bad sample is gone
+
+
+@pytest.mark.quick
+class TestBurnArithmetic:
+    def test_burn_rate_is_bad_fraction_over_budget(self):
+        tr = SLOTracker(Objective("c", "ttft", 0.9, 1.0), 60.0, 3600.0)
+        # 80/100 good against a 0.9 target: bad fraction 0.2, budget 0.1
+        assert tr.burn(80, 100) == pytest.approx(2.0)
+        assert tr.burn(100, 100) == pytest.approx(0.0)
+        assert tr.burn(0, 0) is None  # no samples, no verdict
+        degenerate = SLOTracker(Objective("c", "ttft", 1.0, 1.0), 60.0, 3600.0)
+        assert degenerate.burn(1, 2) is None  # zero budget
+
+    def test_budget_remaining_clamps_to_zero(self):
+        clock = _Clock()
+        eng = SLOEngine([Objective("c", "ttft", 0.9, 1.0)],
+                        default_class="c", check_interval_s=0.0, now=clock)
+        for _ in range(10):
+            eng.observe("c", "ttft", 5.0)  # every sample blows the budget
+        entry = eng.snapshot()["c"]["ttft"]
+        assert entry["fast"]["attainment"] == 0.0
+        assert entry["fast"]["burn_rate"] == pytest.approx(10.0)
+        assert entry["budget_remaining"] == 0.0  # clamped, never negative
+
+
+# -- the engine ----------------------------------------------------------------
+
+
+def _engine(clock, **kw):
+    kw.setdefault("min_samples", 10)
+    kw.setdefault("burn_threshold", 2.0)
+    kw.setdefault("check_interval_s", 0.0)
+    objectives = [Objective("interactive", "ttft", 0.98, 0.25),
+                  Objective("interactive", "availability", 0.99),
+                  Objective("batch", "ttft", 0.98, 30.0)]
+    rank = {"interactive": 0, "batch": 1}
+    return SLOEngine(objectives, default_class="batch", rank=rank,
+                     now=clock, **kw)
+
+
+@pytest.mark.quick
+class TestSLOEngine:
+    def test_burn_flips_health_degraded_with_structured_reason_then_recovers(self):
+        clock = _Clock()
+        eng = _engine(clock)
+        for _ in range(9):
+            eng.observe("interactive", "ttft", 5.0)
+        assert eng.health_check()["status"] == "UP"  # below min_samples
+        eng.observe("interactive", "ttft", 5.0)
+        h = eng.health_check()
+        assert h["status"] == "DEGRADED"
+        (b,) = [x for x in h["details"]["burning"] if x["objective"] == "ttft"]
+        assert b["class"] == "interactive" and b["window"] == "fast"
+        assert b["burn_rate"] == pytest.approx(50.0)  # 100% bad / 2% budget
+        # recovery: enough good samples pull the fast burn under threshold
+        for _ in range(490):
+            eng.observe("interactive", "ttft", 0.01)
+        entry = eng.snapshot()["interactive"]["ttft"]
+        assert entry["fast"]["attainment"] == pytest.approx(0.98)
+        assert entry["fast"]["burn_rate"] == pytest.approx(1.0)
+        assert eng.health_check()["status"] == "UP"
+
+    def test_a_single_slow_request_never_pages(self):
+        clock = _Clock()
+        eng = _engine(clock)
+        eng.observe("interactive", "ttft", 99.0)
+        assert eng.breaches() == []  # min_samples gates the alert
+
+    def test_unknown_class_folds_into_default(self):
+        clock = _Clock()
+        eng = _engine(clock)
+        eng.observe("mystery", "ttft", 1.0)
+        eng.observe(None, "ttft", 1.0)
+        assert eng.snapshot()["batch"]["ttft"]["fast"]["total"] == 2
+
+    def test_should_shed_only_when_a_strictly_higher_class_burns(self):
+        clock = _Clock()
+        eng = _engine(clock)
+        for _ in range(20):
+            eng.observe("interactive", "ttft", 5.0)
+        assert eng.burning_classes() == {"interactive"}
+        assert eng.should_shed("batch")          # lower priority: shed
+        assert not eng.should_shed("interactive")  # never shed by own burn
+
+    def test_availability_objective_counts_outcomes(self):
+        clock = _Clock()
+        eng = _engine(clock)
+        for i in range(20):
+            eng.observe_outcome("interactive", i % 2 == 0)
+        win = eng.snapshot()["interactive"]["availability"]["fast"]
+        assert (win["good"], win["total"]) == (10, 20)
+        assert eng.health_check()["status"] == "DEGRADED"
+
+    def test_sample_gauges_exports_the_three_families(self):
+        clock = _Clock()
+        reg = Registry()
+        reg.new_gauge("app_slo_attainment")
+        reg.new_gauge("app_slo_burn_rate")
+        reg.new_gauge("app_slo_budget_remaining")
+        eng = _engine(clock, metrics=reg)
+        for i in range(10):
+            eng.observe("interactive", "ttft", 0.01 if i < 9 else 5.0)
+        eng.sample_gauges(reg)
+        labels = {"class": "interactive", "objective": "ttft"}
+        att = reg.get("app_slo_attainment").value(window="fast", **labels)
+        assert att == pytest.approx(0.9)
+        burn = reg.get("app_slo_burn_rate").value(window="fast", **labels)
+        assert burn == pytest.approx(5.0)
+        assert reg.get("app_slo_budget_remaining").value(**labels) == 0.0
+        # an idle class publishes nothing (not a fake 100%)
+        assert reg.get("app_slo_attainment").value(
+            window="fast", **{"class": "batch", "objective": "ttft"}) == 0.0
+
+    def test_breach_listener_is_throttled_by_check_interval(self):
+        clock = _Clock()
+        calls = []
+        eng = _engine(clock, check_interval_s=5.0)
+        eng.add_breach_listener(calls.append)
+        for _ in range(50):
+            eng.observe("interactive", "ttft", 9.0)
+        # the first observe ran a check below min_samples (no breach yet);
+        # every later same-instant observe was throttled
+        assert calls == []
+        clock.advance(5.0)
+        for _ in range(50):
+            eng.observe("interactive", "ttft", 9.0)
+        assert len(calls) == 1  # one notification despite 50 breaching observes
+        clock.advance(5.0)
+        eng.observe("interactive", "ttft", 9.0)
+        assert len(calls) == 2
+
+    def test_from_config_objective_parsing(self):
+        conf = DictConfig({
+            "SLO_TARGET": "0.9",
+            "SLO_INTERACTIVE_TTFT_MS": "250",
+            "SLO_INTERACTIVE_TPOT_MS": "0",     # 0 disables the pair
+            "SLO_BATCH_TARGET": "0.5",
+            "SLO_DEFAULT_AVAILABILITY": "0",    # out of (0,1): disabled
+            "SLO_MIN_SAMPLES": "3",
+        })
+        eng = SLOEngine.from_config(conf)
+        tr = eng._trackers[("interactive", "ttft")]
+        assert tr.objective.threshold_s == pytest.approx(0.25)
+        assert tr.objective.target == pytest.approx(0.9)
+        assert ("interactive", "tpot") not in eng._trackers
+        assert ("default", "availability") not in eng._trackers
+        assert eng._trackers[("batch", "ttft")].objective.target == 0.5
+        assert eng.min_samples == 3
+        assert eng.default_class == "default"
+
+
+# -- federation: the merges that must be done right ----------------------------
+
+
+def _digest_pair(obs0, obs1, buckets=(0.01, 0.1, 1.0)):
+    """Two single-histogram registries → digest dict keyed by replica."""
+    digs = {}
+    for name, obs in (("r0", obs0), ("r1", obs1)):
+        reg = Registry()
+        reg.new_counter("app_tpu_tokens_total")
+        reg.new_histogram("app_tpu_ttft_seconds", buckets=buckets)
+        for v in obs:
+            reg.get("app_tpu_ttft_seconds").observe(v, model="m")
+            reg.increment_counter("app_tpu_tokens_total", 1, model="m")
+        digs[name] = federation.digest(reg)
+    return digs
+
+
+@pytest.mark.quick
+class TestFederation:
+    def test_counters_sum_and_keep_per_replica_series(self):
+        digs = _digest_pair([0.005] * 3, [0.005] * 7)
+        text = federation.fleet_text(digs)
+        assert 'app_tpu_tokens_total{model="m"} 10' in text        # aggregate
+        assert 'app_tpu_tokens_total{model="m",replica="r0"} 3' in text
+        assert 'app_tpu_tokens_total{model="m",replica="r1"} 7' in text
+
+    def test_histogram_buckets_merge_elementwise(self):
+        digs = _digest_pair([0.005] * 4, [0.5] * 6)
+        text = federation.fleet_text(digs)
+        # aggregate cumulative buckets: 4 ≤ 0.01, 4 ≤ 0.1, 10 ≤ 1.0
+        assert 'app_tpu_ttft_seconds_bucket{model="m",le="0.01"} 4' in text
+        assert 'app_tpu_ttft_seconds_bucket{model="m",le="1"} 10' in text
+        assert 'app_tpu_ttft_seconds_count{model="m"} 10' in text
+        assert 'app_tpu_ttft_seconds_count{model="m",replica="r1"} 6' in text
+
+    def test_mismatched_ladders_refuse_an_aggregate(self):
+        d0 = _digest_pair([0.005], [], buckets=(0.01, 1.0))["r0"]
+        d1 = _digest_pair([], [0.5], buckets=(0.25, 2.0))["r1"]
+        text = federation.fleet_text({"r0": d0, "r1": d1})
+        # per-replica series survive; no aggregate (unlabeled) series exists
+        assert 'app_tpu_ttft_seconds_count{model="m",replica="r0"} 1' in text
+        assert 'app_tpu_ttft_seconds_count{model="m"} ' not in text
+
+    def test_percentiles_are_never_averaged(self):
+        # r0: 100 fast requests (p50 = 0.005); r1: 100 slow (p50 = 1.0).
+        # The fleet p50 read off the MERGED buckets is 0.005-bucket fast —
+        # half the fleet's requests were fast. The average of per-replica
+        # p50s (0.5025) is a number about nothing.
+        buckets = (0.005, 0.1, 1.0)
+        q = federation.histogram_quantile
+        r0_counts, r1_counts = [100, 0, 0], [0, 0, 100]
+        p50_r0 = q(buckets, r0_counts, 100, 0.5)
+        p50_r1 = q(buckets, r1_counts, 100, 0.5)
+        merged = [a + b for a, b in zip(r0_counts, r1_counts)]
+        p50_fleet = q(buckets, merged, 200, 0.5)
+        assert p50_fleet == pytest.approx(0.005)
+        assert (p50_r0 + p50_r1) / 2 == pytest.approx(0.5025)
+        assert p50_fleet != (p50_r0 + p50_r1) / 2
+        # overflow tail: a rank above the last finite bucket reads +inf
+        assert q(buckets, [0, 0, 0], 10, 0.5) == float("inf")
+        assert q(buckets, [1, 0, 0], 0, 0.5) is None
+
+    def test_aggregate_slo_merges_counts_not_ratios(self):
+        clock = _Clock()
+        e0, e1 = _engine(clock), _engine(clock)
+        e0.observe("interactive", "ttft", 9.0)   # 1 bad of 2 → 0.5
+        e0.observe("interactive", "ttft", 0.01)
+        for _ in range(18):                       # 18 good → 1.0
+            e1.observe("interactive", "ttft", 0.01)
+        fleet = federation.aggregate_slo(
+            {"r0": {"slo": e0.snapshot()}, "r1": {"slo": e1.snapshot()}})
+        win = fleet["interactive"]["ttft"]["fast"]
+        assert (win["good"], win["total"]) == (19, 20)
+        assert win["attainment"] == pytest.approx(0.95)  # NOT (0.5+1.0)/2
+
+
+# -- trigger-fired anomaly capture ---------------------------------------------
+
+
+@pytest.mark.quick
+class TestCaptureWatcher:
+    def _watcher(self, tmp_path, clock, **kw):
+        container = new_mock_container()
+        eng = _engine(clock)
+        kw.setdefault("min_interval_s", 600.0)
+        w = CaptureWatcher(container, eng, out_dir=str(tmp_path),
+                           now=clock, clock=clock, **kw)
+        return container, eng, w
+
+    def test_rate_limit_allows_one_then_suppresses_then_refills(self, tmp_path):
+        clock = _Clock()
+        container, _, w = self._watcher(tmp_path, clock)
+        breach = [{"class": "interactive", "objective": "ttft",
+                   "window": "fast", "burn_rate": 50.0}]
+        path = w.on_breach(breach)
+        assert path is not None
+        assert w.on_breach(breach) is None  # bucket empty → suppressed
+        assert w.on_breach(breach) is None
+        taken = container.metrics.get("app_slo_captures_total")
+        sup = container.metrics.get("app_slo_captures_suppressed_total")
+        assert sum(v for _, v in taken.series()) == 1
+        assert sum(v for _, v in sup.series()) == 2
+        clock.advance(600.0)  # one token refilled
+        assert w.on_breach(breach) is not None
+        assert len(list(tmp_path.glob("slo-capture-*"))) == 2
+
+    def test_burst_allows_consecutive_captures(self, tmp_path):
+        clock = _Clock()
+        _, _, w = self._watcher(tmp_path, clock, burst=2)
+        breach = [{"class": "c", "objective": "ttft"}]
+        assert w.on_breach(breach) is not None
+        assert w.on_breach(breach) is not None
+        assert w.on_breach(breach) is None
+
+    def test_bundle_contains_reason_slo_and_flight_state(self, tmp_path):
+        clock = _Clock()
+        container, eng, w = self._watcher(tmp_path, clock)
+        for _ in range(10):
+            eng.observe("interactive", "ttft", 9.0)
+        path = w.on_breach(eng.breaches())
+        with open(f"{path}/bundle.json") as f:
+            data = json.load(f)
+        assert data["reason"][0]["class"] == "interactive"
+        assert data["slo"]["interactive"]["ttft"]["fast"]["total"] == 10
+        assert "requests" in data["flight"] and "steps" in data["flight"]
+        assert "engines" in data
+
+    def test_from_config_knobs(self, tmp_path):
+        conf = DictConfig({"SLO_CAPTURE_DIR": str(tmp_path),
+                           "SLO_CAPTURE_MIN_INTERVAL_S": "30",
+                           "SLO_CAPTURE_BURST": "3"})
+        clock = _Clock()
+        w = CaptureWatcher.from_config(
+            conf, new_mock_container(), _engine(clock), now=clock, clock=clock)
+        assert w.out_dir == str(tmp_path)
+        assert w.min_interval_s == 30.0 and w.burst == 3
+
+    def test_capture_dir_falls_back_to_profiler_dir(self, tmp_path):
+        conf = DictConfig({"PROFILER_DIR": str(tmp_path)})
+        clock = _Clock()
+        w = CaptureWatcher.from_config(
+            conf, new_mock_container(), _engine(clock), now=clock, clock=clock)
+        assert w.out_dir == str(tmp_path)
+
+
+# -- router decision metrics (satellite 3) -------------------------------------
+
+
+@pytest.mark.quick
+def test_router_decision_counts_and_affinity_ratio_are_real_metrics():
+    container = new_mock_container()
+    router = Router(container, policy=RouterPolicy(
+        page_size=4, jitter_s=0.0, replicas={"a": "http://a", "b": "http://b"}))
+    p = RoutePlan(key=1, qos_class="default", spillable=True,
+                  home="a", targets=[])
+    for _ in range(3):
+        with router._lock:
+            router._stats["home"] += 1
+        router._record(p, sent="a", outcome="200")
+    with router._lock:
+        router._stats["spill"] += 1
+    router._record(p, sent="b", outcome="200")
+    router._record(p, sent=None, outcome="shed:down")
+    c = container.metrics.get("app_router_decisions_total")
+    by = {ls: v for ls, v in c.series()}
+    assert by[(("decision", "home"), ("replica", "a"))] == 3
+    assert by[(("decision", "spill"), ("replica", "b"))] == 1
+    # a shed never reached a replica: attributed to the planned home
+    assert by[(("decision", "shed"), ("replica", "a"))] == 1
+    g = container.metrics.get("app_router_affinity_hit_ratio")
+    assert g.value() == pytest.approx(0.75)
+    view = router.fleet_view()
+    per = {d["name"]: d for d in view["replicas"]}
+    assert per["a"]["decisions"]["home"] == 3
+    assert per["a"]["affinity_hit_ratio"] == pytest.approx(1.0)
+    assert view["stats"]["affinity_hit_ratio"] == pytest.approx(0.75)
+
+
+# -- profiler port satellites --------------------------------------------------
+
+
+@pytest.mark.quick
+class TestProfilerPorts:
+    def _app(self, **conf):
+        from gofr_tpu import app as appmod
+
+        config = {"APP_NAME": "t", **conf}
+        return appmod.App(config=DictConfig(config),
+                          container=new_mock_container(config))
+
+    def test_auto_derives_from_http_port(self):
+        app = self._app(PROFILER_PORT="auto", HTTP_PORT="8042")
+        assert app._profiler_port_base() == 8042 + 1999
+
+    def test_zero_and_garbage_disable(self):
+        assert self._app(PROFILER_PORT="0")._profiler_port_base() is None
+        assert self._app(PROFILER_PORT="-1")._profiler_port_base() is None
+        assert self._app(PROFILER_PORT="teapot")._profiler_port_base() is None
+
+    def test_bindable_port_walks_past_a_busy_one(self):
+        from gofr_tpu.app import App
+
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("0.0.0.0", 0))
+            s.listen(1)
+            busy = s.getsockname()[1]
+            port = App._bindable_port(busy, tries=4)
+            assert port is not None and busy < port < busy + 4
+
+
+# -- the acceptance drill: two replicas behind a router ------------------------
+
+
+@pytest.mark.quick
+def test_two_replica_federation_breach_capture_and_recovery(tmp_path):
+    common = {"SLO_MIN_SAMPLES": "5", "SLO_BURN_THRESHOLD": "2",
+              "SLO_CHECK_INTERVAL_S": "0"}
+    r0 = new_mock_container({**common, "APP_NAME": "r0",
+                             "SLO_CAPTURE": "true",
+                             "SLO_CAPTURE_DIR": str(tmp_path),
+                             "SLO_CAPTURE_MIN_INTERVAL_S": "3600"})
+    r1 = new_mock_container({**common, "APP_NAME": "r1"})
+    assert r0.slo is not None and r0.slo_capture is not None
+    assert r1.slo_capture is None  # capture is strictly opt-in
+
+    # asymmetric traffic so the exact merge is distinguishable from an
+    # average of ratios: r0 1/2 good (0.5), r1 18/18 good (1.0)
+    r0.slo.observe("interactive", "ttft", 10.0)  # > the 2s objective
+    r0.slo.observe("interactive", "ttft", 0.01)
+    for _ in range(18):
+        r1.slo.observe("interactive", "ttft", 0.01)
+
+    rep0 = GossipReporter(r0, name="r0", url="http://r0")
+    rep1 = GossipReporter(r1, name="r1", url="http://r1")
+    router = Router(new_mock_container(),
+                    policy=RouterPolicy(page_size=4, jitter_s=0.0))
+    router.registry.observe(rep0.snapshot())  # digest rides the snapshot
+    router.registry.observe(rep1.snapshot())
+
+    text = router.fleet_metrics_text()
+    agg = ('app_slo_attainment{class="interactive",objective="ttft",'
+           'window="fast"} 0.95')
+    assert agg in text  # 19/20, NOT the 0.75 average of per-replica ratios
+    assert ('app_slo_attainment{class="interactive",objective="ttft",'
+            'replica="r0",window="fast"} 0.5') in text
+    assert 'replica="r1"' in text
+    assert 'app_fleet_replica_up{replica="r0"} 1' in text
+    assert 'app_fleet_replica_inflight{replica="r0"} 0' in text
+
+    view = router.fleet_view()
+    win = view["classes"]["interactive"]["ttft"]["fast"]
+    assert (win["good"], win["total"]) == (19, 20)
+    per = {d["name"]: d for d in view["replicas"]}
+    assert per["r0"]["slo"]["interactive"]["ttft"]["attainment"] == 0.5
+    assert per["r1"]["slo"]["interactive"]["ttft"]["attainment"] == 1.0
+    assert per["r1"]["inflight"] == 0
+
+    # drive r0 past its TTFT objective: burn flips health DEGRADED with a
+    # structured reason and fires exactly ONE rate-limited capture bundle
+    for _ in range(10):
+        r0.slo.observe("interactive", "ttft", 30.0)
+    h = r0.health()["services"]["slo"]
+    assert h["status"] == "DEGRADED"
+    assert any(b["class"] == "interactive" for b in h["details"]["burning"])
+    bundles = sorted(tmp_path.glob("slo-capture-*"))
+    assert len(bundles) == 1, bundles
+    bundle = json.loads((bundles[0] / "bundle.json").read_text())
+    assert bundle["reason"] and "slo" in bundle and "flight" in bundle
+    sup = r0.metrics.get("app_slo_captures_suppressed_total")
+    assert sum(v for _, v in sup.series()) >= 1
+    # the breach rides the next gossip into the router's fleet view
+    router.registry.observe(rep0.snapshot())
+    burn = (router.fleet_view()["classes"]["interactive"]["ttft"]
+            ["fast"]["burn_rate"])
+    assert burn is not None and burn >= 2.0
+
+    # recovery: good traffic pulls the fast burn back under threshold,
+    # health returns to UP, and the rate limit held at one bundle
+    for _ in range(800):
+        r0.slo.observe("interactive", "ttft", 0.01)
+    assert r0.slo.health_check()["status"] == "UP"
+    router.registry.observe(rep0.snapshot())
+    att = (router.fleet_view()["classes"]["interactive"]["ttft"]
+           ["fast"]["attainment"])
+    assert att is not None and att > 0.97
+    assert len(list(tmp_path.glob("slo-capture-*"))) == 1
+
+
+@pytest.mark.quick
+def test_gossip_digest_every_throttles_but_registry_keeps_last(tmp_path):
+    r0 = new_mock_container({"ROUTER_GOSSIP_DIGEST_EVERY": "2"})
+    rep = GossipReporter(r0, name="r0", url="http://r0")
+    router = Router(new_mock_container(),
+                    policy=RouterPolicy(page_size=4, jitter_s=0.0))
+    s1 = rep.snapshot()
+    assert "digest" not in s1  # seq 1 % 2 != 0
+    s2 = rep.snapshot()
+    assert "digest" in s2
+    router.registry.observe(s2)
+    router.registry.observe(rep.snapshot())  # seq 3: digest-less publish
+    # the registry keeps the last digest across digest-less publishes
+    assert router.registry.get("r0").digest is not None
+    assert "r0" in router.digests()
+
+
+# -- QoS shed-on-burn (pressure signal) ----------------------------------------
+
+
+@pytest.mark.quick
+def test_qos_sheds_lower_class_while_a_higher_class_burns():
+    from gofr_tpu.http.errors import ServiceUnavailable
+    from gofr_tpu.qos import AdmissionController, QoSPolicy
+
+    container = new_mock_container({"QOS_ENABLED": "true",
+                                    "QOS_SHED_ON_BURN": "true",
+                                    "SLO_MIN_SAMPLES": "5",
+                                    "SLO_BURN_THRESHOLD": "2",
+                                    "SLO_CHECK_INTERVAL_S": "0"})
+    policy = QoSPolicy.from_config(container.config)
+    assert policy.shed_on_burn
+    ctrl = AdmissionController(policy, container.metrics, container.logger)
+    for _ in range(10):
+        container.slo.observe("interactive", "ttft", 99.0)
+
+    class _Eng:
+        slo = container.slo
+        _restarting = False
+
+        def _backlog(self):
+            return 0
+
+    with pytest.raises(ServiceUnavailable):
+        ctrl.admit_engine(_Eng(), "batch", None)
+    # the burning class itself is never shed by its own burn
+    ctrl.admit_engine(_Eng(), "interactive", None)
+    c = container.metrics.get("app_qos_rejected_total")
+    assert any(dict(ls).get("reason") == "slo_burn" and v == 1
+               for ls, v in c.series())
