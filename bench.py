@@ -44,6 +44,16 @@ Env knobs:
                               classes) and report per-class SLO attainment
                               + burn-rate peaks from metrics/slo.py in
                               extra.slo (ROADMAP O5(b))
+    GOFR_BENCH_STORM          1 = also run the cancel/retry-storm drill
+                              (ISSUE 10, ROADMAP O5(b)): doomed-deadline
+                              submissions must shed pre-slot with
+                              deadline_exceeded, chaos-scheduled client
+                              disconnects mid-decode must leak zero
+                              slots/pages (assert_page_refs_consistent
+                              after drain), and a synthetic 5xx retry
+                              storm through the shared RetryBudget must
+                              keep amplification <= the budget fraction;
+                              results in extra.storm
     GOFR_BENCH_PIPELINE       device pipeline depth (default 2; 1 = sync, up to 4)
     GOFR_BENCH_OVERLAP_AB     1 = also measure the mixed-arrival workload (paced
                               arrivals of short + chunked-long prompts) with the
@@ -890,6 +900,96 @@ def main() -> None:
             }
         except Exception as e:  # noqa: BLE001
             extra["slo"] = f"error: {e}"[:160]
+
+    # cancel/retry-storm drill (ISSUE 10, closes ROADMAP O5(b)): the three
+    # robustness contracts, judged with hard assertions rather than rates —
+    #   (1) doomed work (deadline already expired at submission) is shed
+    #       BEFORE taking a slot, with DeadlineExceeded/deadline_exceeded;
+    #   (2) a chaos-scheduled client-disconnect storm mid-decode reclaims
+    #       every slot and KV page (assert_page_refs_consistent after
+    #       drain — zero leaks is the pass bar, not "mostly freed");
+    #   (3) a synthetic 5xx retry storm through the shared RetryBudget
+    #       amplifies by at most the budget fraction (Envoy-style cap).
+    if os.environ.get("GOFR_BENCH_STORM") == "1":
+        from gofr_tpu.fleet import chaos
+        from gofr_tpu.http.errors import DeadlineExceeded
+        from gofr_tpu.service.budget import RetryBudget
+        from gofr_tpu.testutil import assert_page_refs_consistent
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        n_storm = max(12, n_requests // 2)
+        st_kw = dict(engine_kw(*best))
+        # the leak check is only meaningful on the paged layout — force it
+        # (assert_page_refs_consistent is a documented no-op on slot KV)
+        st_kw.update(kv_layout="paged", page_size=st_kw.get("page_size", 128))
+        try:
+            st_engine = GenerateEngine(llama, cfg, params, container, **st_kw)
+            try:
+                st_engine.warmup()
+                st_engine.start()
+                # (1) doomed-deadline shed: effective timeout <= 0 must be
+                # rejected pre-slot, never queued to time out later
+                shed = 0
+                for _ in range(max(4, n_storm // 4)):
+                    p = rng.randint(1, cfg.vocab_size, size=prompt_len).tolist()
+                    try:
+                        st_engine.submit(p, max_new_tokens=max_new, timeout=0.0)
+                    except DeadlineExceeded:
+                        shed += 1
+                # (2) disconnect storm: every 2nd request's "client" goes
+                # away mid-decode (deterministic chaos schedule), its
+                # Request is cancelled cooperatively, and after the wave
+                # drains the page table must balance exactly
+                cancelled = 0
+                with chaos.override("client.disconnect:drop,every=2"):
+                    t0 = time.monotonic()
+                    live = []
+                    for _ in range(n_storm):
+                        p = rng.randint(1, cfg.vocab_size,
+                                        size=prompt_len).tolist()
+                        r = st_engine.submit(p, max_new_tokens=max_new,
+                                             timeout=timeout)
+                        live.append((r, chaos.fire("client.disconnect")))
+                    time.sleep(0.05)  # let decode get under way
+                    for r, gone in live:
+                        if gone:
+                            r.cancel("client_disconnect")
+                            cancelled += 1
+                    for r, gone in live:
+                        if not gone:
+                            r.result(timeout)
+                    storm_elapsed = time.monotonic() - t0
+                deadline_t = time.monotonic() + 10.0
+                while any(s is not None
+                          for s in getattr(st_engine, "slots", [])) and \
+                        time.monotonic() < deadline_t:
+                    time.sleep(0.02)
+                assert_page_refs_consistent(st_engine)
+            finally:
+                st_engine.stop()
+            # (3) retry amplification under a storm where EVERY attempt
+            # fails: with fraction f the budget must cap retries at
+            # max(min_retries, f * window originals)
+            frac, n_orig = 0.2, 200
+            rb = RetryBudget(fraction=frac, min_retries=3, window_s=60.0)
+            for _ in range(n_orig):
+                rb.note_request()
+            granted = sum(1 for _ in range(n_orig) if rb.try_spend())
+            cap = max(3, int(frac * n_orig))
+            if granted > cap:
+                raise AssertionError(
+                    f"retry budget leaked: {granted} retries > cap {cap}")
+            extra["storm"] = {
+                "requests": n_storm,
+                "req_per_s": round(n_storm / storm_elapsed, 2),
+                "deadline_shed_pre_slot": shed,
+                "disconnect_cancelled": cancelled,
+                "page_refs_consistent": True,
+                "retry_amplification": round(granted / n_orig, 3),
+                "retry_budget_fraction": frac,
+            }
+        except Exception as e:  # noqa: BLE001
+            extra["storm"] = f"error: {e}"[:160]
 
     # NB: on the CPU fallback the "device" compute runs on the same host
     # cores as the packing/readback, so overlap has nothing to hide behind

@@ -28,7 +28,8 @@ from gofr_tpu.config import DictConfig, EnvConfig
 from gofr_tpu.container import Container
 from gofr_tpu.fleet.chaos import fire as chaos_fire
 from gofr_tpu.context import Context
-from gofr_tpu.http.errors import RequestTimeout
+from gofr_tpu import deadline
+from gofr_tpu.http.errors import DeadlineExceeded, RequestTimeout
 from gofr_tpu.http.middleware import (
     SPAN_KEY,
     cors_middleware,
@@ -344,6 +345,12 @@ class App:
             # resolved by the QoS middleware; ctx.generate/infer pick it up
             # so handlers need no QoS-awareness to schedule correctly
             req.context()["qos_class"] = qos_class
+        # request-lifetime plane (docs/resilience.md): the client's absolute
+        # deadline, converted once to the monotonic domain; ctx.generate
+        # folds the remaining budget into the engine timeout
+        deadline.set_deadline(
+            req.context(),
+            deadline.parse_deadline_ms(req.headers.get(deadline.DEADLINE_HEADER)))
         return req
 
     def _wrap(self, handler: Handler):
@@ -353,18 +360,50 @@ class App:
             req = await self._materialize(request)
             ctx = Context(req, self.container, span=request.get(SPAN_KEY))
             result, err = None, None
+            # effective budget: the server-side request_timeout and the
+            # client's propagated deadline, whichever is tighter. An
+            # already-expired deadline is shed here, before the handler
+            # (and any engine submit) runs at all.
+            remaining = deadline.remaining(req.context())
+            deadline_bound = False
+            if remaining is not None and remaining <= 0:
+                self.container.metrics.increment_counter(
+                    "app_request_deadline_exceeded_total", 1, where="edge")
+                err = DeadlineExceeded("request deadline already expired")
+                remaining = None
+            budget = self.request_timeout if self.request_timeout > 0 else None
+            if remaining is not None and (budget is None or remaining < budget):
+                budget, deadline_bound = remaining, True
             try:
-                if is_coro:
-                    coro = handler(ctx)
-                else:
-                    loop = asyncio.get_running_loop()
-                    coro = loop.run_in_executor(self._executor, handler, ctx)
-                if self.request_timeout > 0:
-                    result = await asyncio.wait_for(coro, timeout=self.request_timeout)
-                else:
-                    result = await coro
+                if err is None:
+                    if is_coro:
+                        coro = handler(ctx)
+                    else:
+                        loop = asyncio.get_running_loop()
+                        coro = loop.run_in_executor(self._executor, handler, ctx)
+                    if budget is not None:
+                        result = await asyncio.wait_for(coro, timeout=budget)
+                    else:
+                        result = await coro
             except asyncio.TimeoutError:
-                err = RequestTimeout()
+                if deadline_bound:
+                    # the CLIENT's clock ran out, not ours: 504, and any
+                    # engine work this context submitted is cancelled so
+                    # slots/pages stop burning for an answer nobody reads
+                    self.container.metrics.increment_counter(
+                        "app_request_deadline_exceeded_total", 1, where="edge")
+                    ctx.cancel_inflight("deadline")
+                    err = DeadlineExceeded()
+                else:
+                    ctx.cancel_inflight("timeout")
+                    err = RequestTimeout()
+            except asyncio.CancelledError:
+                # client closed the socket mid-handler: propagate to every
+                # engine Request this context submitted (cooperative
+                # cancellation, docs/resilience.md), then let aiohttp
+                # finish tearing the transport down
+                ctx.cancel_inflight("client_disconnect")
+                raise
             except Exception as e:  # noqa: BLE001
                 err = e
                 if not hasattr(e, "status_code"):
@@ -405,6 +444,11 @@ class App:
                 item = await loop.run_in_executor(self._executor, next, stream.iterator, sentinel)
                 if item is sentinel:
                     break
+                # chaos point "client.disconnect" (drop action): the storm
+                # drill's deterministic mid-stream client hangup — exercises
+                # the REAL disconnect path below, not a shortcut around it
+                if chaos_fire("client.disconnect"):
+                    raise ConnectionResetError("chaos: injected client disconnect")
                 await resp.write(stream.encode_sse(item))
             await resp.write(StreamingResponse.sse_done())
         except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
@@ -445,6 +489,8 @@ class App:
                 chunk = await loop.run_in_executor(self._executor, next, stream.iterator, sentinel)
                 if chunk is sentinel:
                     break
+                if chaos_fire("client.disconnect"):
+                    raise ConnectionResetError("chaos: injected client disconnect")
                 if chunk:
                     await resp.write(chunk)
         except (ConnectionResetError, ConnectionError, asyncio.CancelledError):

@@ -173,7 +173,7 @@ class Container:
         m.new_counter("app_qos_admitted_total", "requests admitted by QoS")
         m.new_counter("app_qos_rejected_total",
                       "requests rejected by QoS (reason: rate/route_rate/key_rate/"
-                      "tenant_rate/queue/deadline/capacity/restart/slo_burn)")
+                      "tenant_rate/queue/deadline_exceeded/capacity/restart/slo_burn)")
         m.new_counter("app_qos_shed_total", "requests shed under overload (503s)")
         m.new_gauge("app_qos_queue_depth", "queued requests per priority class")
         m.new_gauge("app_qos_predicted_wait_seconds",
@@ -199,6 +199,18 @@ class Container:
                       "router routing decisions (replica; decision = home|spill|shed|error)")
         m.new_gauge("app_router_affinity_hit_ratio",
                     "home-replica hit fraction of routed requests since router start")
+        # request-lifetime plane (ISSUE 10, docs/resilience.md): deadline
+        # propagation, retry budgets, and hedged dispatch
+        m.new_counter("app_request_deadline_exceeded_total",
+                      "requests shed because their deadline could not be met "
+                      "(where = edge|qos|engine|router)")
+        m.new_counter("app_retry_budget_spent_total",
+                      "retries granted by the shared Envoy-style retry budget")
+        m.new_counter("app_retry_budget_exhausted_total",
+                      "retries DENIED because the budget window was spent")
+        m.new_counter("app_router_hedged_total",
+                      "hedged dispatches fired by the router "
+                      "(winner = primary|hedge|none)")
 
     def _sample_tpu_metrics(self, _registry=None) -> None:
         """Collect hook: live HBM gauges on every /metrics scrape (the

@@ -18,7 +18,8 @@ if TYPE_CHECKING:
 
 
 class Context:
-    __slots__ = ("request", "container", "responder", "span", "_values")
+    __slots__ = ("request", "container", "responder", "span", "_values",
+                 "_engine_requests")
 
     def __init__(self, request: Any, container: "Container", responder: Any = None, span: Span | None = None):
         self.request = request
@@ -26,6 +27,10 @@ class Context:
         self.responder = responder
         self.span = span
         self._values: dict[str, Any] = {}
+        # engine Requests submitted through this context, so the transport
+        # can cancel them all when the client disconnects mid-handler
+        # (docs/resilience.md); populated via the _on_submit engine hook
+        self._engine_requests: list[Any] = []
 
     # -- request passthrough ---------------------------------------------------
 
@@ -115,6 +120,29 @@ class Context:
 
     # -- model inference (the TPU-native capability) ---------------------------
 
+    def deadline_remaining(self) -> float | None:
+        """Seconds left in the request's propagated deadline (can be <= 0
+        once expired); None when the request carries none. Parsed at the
+        transport edge from ``X-Request-Deadline-Ms`` or the gRPC
+        deadline (gofr_tpu/deadline.py, docs/resilience.md)."""
+        from gofr_tpu import deadline as _deadline
+
+        req = self.request
+        req_ctx = req.context() if hasattr(req, "context") else {}
+        return _deadline.remaining(req_ctx)
+
+    def cancel_inflight(self, reason: str = "client_disconnect") -> int:
+        """Cancel every engine Request submitted through this context —
+        the transport calls this when the client goes away, so slots and
+        paged KV are reclaimed instead of computing for a ghost. Returns
+        the number of requests flagged."""
+        n = 0
+        for r in self._engine_requests:
+            if not r.cancelled:
+                r.cancel(reason)
+                n += 1
+        return n
+
     def _qos_kw(self, kw: dict[str, Any]) -> dict[str, Any]:
         """Inject the request's QoS priority class (resolved by the QoS
         middleware/interceptor from the class header) into engine kwargs,
@@ -124,12 +152,24 @@ class Context:
         engine device loop runs on another thread, where contextvars can't
         reach, so the span travels explicitly and the engine stitches its
         queue_wait/prefill/decode children under it."""
+        from gofr_tpu import deadline as _deadline
+
         if self.span is not None and "_parent_span" not in kw:
             kw["_parent_span"] = self.span
-        if "qos_class" in kw or "_qos_class" in kw:
-            return kw
         req = self.request
         req_ctx = req.context() if hasattr(req, "context") else {}
+        # the propagated deadline becomes the engine timeout: the QoS
+        # predicted-wait check then sheds doomed work pre-slot with 504
+        # (docs/resilience.md). An explicit handler timeout can only
+        # tighten the budget, never extend past the client's deadline.
+        rem = _deadline.remaining(req_ctx)
+        if rem is not None:
+            t = kw.get("timeout")
+            kw["timeout"] = rem if t is None else min(t, rem)
+        # track the submitted Request so a client disconnect can cancel it
+        kw.setdefault("_on_submit", self._engine_requests.append)
+        if "qos_class" in kw or "_qos_class" in kw:
+            return kw
         cls = req_ctx.get("qos_class")
         if not cls and hasattr(req, "param"):
             # gRPC metadata fallback — the CONFIGURED class header (gRPC

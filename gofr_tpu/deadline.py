@@ -1,0 +1,73 @@
+"""Request-lifetime plane: one absolute deadline carried end to end.
+
+A client (or the router on its behalf) stamps an absolute wall-clock
+deadline on the request as ``X-Request-Deadline-Ms`` (unix epoch
+milliseconds); gRPC callers get the same effect from the native RPC
+deadline. Every tier converts the wire form ONCE at ingress to a
+*monotonic* deadline — immune to wall-clock steps — and hands the
+remaining budget down:
+
+- the HTTP edge parses the header into the per-request context
+  (``app._materialize``) and sheds already-expired work with 504;
+- the gRPC edge reads ``servicer_context.time_remaining()`` into the
+  same context slot;
+- the router re-stamps the header shrunk by ``DEADLINE_HOP_MARGIN_MS``
+  before proxying, so a replica never starts work its caller cannot
+  wait for;
+- ``Context`` folds the remaining budget into the engine timeout, so
+  the QoS predicted-wait check sheds doomed work with
+  504/``deadline_exceeded`` before it ever takes a slot.
+
+See docs/resilience.md for the full model.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+# absolute deadline, unix epoch milliseconds
+DEADLINE_HEADER = "X-Request-Deadline-Ms"
+
+# per-request context slot: monotonic seconds (time.monotonic() domain)
+CTX_KEY = "deadline_at"
+
+
+def parse_deadline_ms(value: Any) -> float | None:
+    """Wire form (absolute epoch ms) -> monotonic deadline in seconds,
+    or None when absent or malformed. A garbage deadline must never 500
+    the request — it degrades to 'no deadline'."""
+    if value is None or value == "":
+        return None
+    try:
+        wall_remaining = float(value) / 1000.0 - time.time()
+    except (TypeError, ValueError):
+        return None
+    return time.monotonic() + wall_remaining
+
+
+def header_value(deadline_at: float, margin_s: float = 0.0) -> str:
+    """Monotonic deadline -> the absolute epoch-ms wire form, shrunk by
+    ``margin_s`` (the router's per-hop safety margin: the upstream must
+    answer early enough for the proxy to still relay the response)."""
+    wall = time.time() + (deadline_at - time.monotonic()) - margin_s
+    return str(int(wall * 1000.0))
+
+
+def set_deadline(ctx: dict, deadline_at: float | None) -> None:
+    """Record a monotonic deadline on a per-request context dict."""
+    if deadline_at is not None:
+        ctx[CTX_KEY] = float(deadline_at)
+
+
+def deadline_of(ctx: dict) -> float | None:
+    return ctx.get(CTX_KEY)
+
+
+def remaining(ctx: dict, now: float | None = None) -> float | None:
+    """Remaining budget in seconds (can be <= 0 once expired); None when
+    the request carries no deadline."""
+    at = ctx.get(CTX_KEY)
+    if at is None:
+        return None
+    return at - (time.monotonic() if now is None else now)

@@ -1,7 +1,10 @@
 """Deterministic chaos injection (docs/testing.md chaos-point catalog).
 
 Production code declares *named fault points* — ``engine.step``,
-``engine.restart``, ``lockstep.announce``, ``pubsub.commit`` — and the
+``engine.restart``, ``lockstep.announce``, ``pubsub.commit``,
+``client.disconnect`` (drop = sever the response stream mid-flight so the
+cooperative-cancellation path must reclaim the slot/pages),
+``replica.slow`` (delay = stall ``_submit`` to widen hedge windows) — and the
 fault that fires there is injected from the outside via the ``GOFR_CHAOS``
 environment variable (or :func:`override` inside a test process). This is
 how the app-tier failure contracts are *proven* rather than asserted:

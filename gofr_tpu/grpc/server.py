@@ -84,14 +84,31 @@ class GofrGrpcInterceptor(grpc.ServerInterceptor):
                 )
         return handler
 
-    def _begin(self, request, method: str, metadata: dict[str, str]):
+    def _begin(self, request, method: str, metadata: dict[str, str],
+               servicer_context=None):
         container = self._container
         span = container.tracer.start_span(
             f"grpc {method}", traceparent=metadata.get("traceparent"), kind="SERVER",
             set_current=False,
         )
         span.set_attribute("rpc.method", method)
-        ctx = Context(_GRPCRequestAdapter(request, metadata), container, span=span)
+        adapter = _GRPCRequestAdapter(request, metadata)
+        if servicer_context is not None:
+            # the client's RPC deadline joins the request-lifetime plane
+            # (docs/resilience.md): stored as a monotonic deadline on the
+            # request context, Context folds the remaining budget into the
+            # engine timeout — DEADLINE_EXCEEDED then reflects the CLIENT's
+            # budget, not only the server default
+            try:
+                tr = servicer_context.time_remaining()
+            except Exception:  # noqa: BLE001 - non-standard test doubles
+                tr = None
+            if tr is not None and tr < 3600 * 24 * 365:
+                from gofr_tpu import deadline as _deadline
+
+                _deadline.set_deadline(adapter.context(),
+                                       time.monotonic() + max(0.0, tr))
+        ctx = Context(adapter, container, span=span)
         token = _grpc_ctx.set(ctx)
         return span, token
 
@@ -117,7 +134,7 @@ class GofrGrpcInterceptor(grpc.ServerInterceptor):
         container = self._container
 
         def wrapped(request, servicer_context):
-            span, token = self._begin(request, method, metadata)
+            span, token = self._begin(request, method, metadata, servicer_context)
             start = time.perf_counter()
             status = 0
             try:
@@ -142,7 +159,7 @@ class GofrGrpcInterceptor(grpc.ServerInterceptor):
         container = self._container
 
         def wrapped(request, servicer_context):
-            span, token = self._begin(request, method, metadata)
+            span, token = self._begin(request, method, metadata, servicer_context)
             start = time.perf_counter()
             status = 0
             sent = 0
@@ -180,7 +197,10 @@ def _grpc_code_of(e: Exception) -> grpc.StatusCode:
         return grpc.StatusCode.RESOURCE_EXHAUSTED
     if sc == 503:
         return grpc.StatusCode.UNAVAILABLE
-    if sc == 408:
+    if sc in (408, 504):
+        # 408 = server-side timeout, 504 = the client's propagated deadline
+        # was unmeetable (sheds with reason deadline_exceeded) — both are
+        # DEADLINE_EXCEEDED on the wire
         return grpc.StatusCode.DEADLINE_EXCEEDED
     return grpc.StatusCode.INTERNAL
 

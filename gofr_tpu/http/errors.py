@@ -124,6 +124,20 @@ class ServiceUnavailable(HTTPError):
         return "service unavailable"
 
 
+class DeadlineExceeded(HTTPError):
+    """The request's propagated deadline (``X-Request-Deadline-Ms`` or
+    the gRPC deadline) is already unmeetable: either expired outright or
+    the predicted queue wait exceeds the remaining budget. 504 — unlike
+    408 (server-side timeout) and 503 (server refuses work it COULD do
+    later), a 504 tells the caller its own clock ran out: retrying the
+    same deadline is pointless. Not retryable, so no ``retry_after``."""
+
+    status_code = 504
+
+    def default_message(self) -> str:
+        return "deadline exceeded"
+
+
 def retry_after_hint(seconds: float) -> str:
     """One formatting site for every transport's retry hint (HTTP
     ``Retry-After`` header, gRPC ``retry-after`` trailing metadata):

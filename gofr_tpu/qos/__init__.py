@@ -40,7 +40,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
-from gofr_tpu.http.errors import ServiceUnavailable, TooManyRequests
+from gofr_tpu.http.errors import (DeadlineExceeded, ServiceUnavailable,
+                                  TooManyRequests)
 from gofr_tpu.qos.limiter import KeyedBuckets, TokenBucket
 from gofr_tpu.qos.scheduler import QoSQueue
 
@@ -282,10 +283,12 @@ class AdmissionController:
                      timeout: float | None) -> PriorityClass:
         """Tier-3 gate, called by ``_EngineBase._submit``: backlog cap,
         per-class concurrency cap, then the deadline check — if the
-        predicted queue wait already exceeds the request's deadline it is
-        rejected NOW (503 + Retry-After) instead of burning a slot and
-        timing out later. Returns the resolved class (capacity acquired;
-        released by the request's done callback via ``track``)."""
+        predicted queue wait already exceeds the request's remaining
+        budget (propagated deadline or explicit timeout) it is rejected
+        NOW with 504/``deadline_exceeded`` instead of burning a slot and
+        timing out later (docs/resilience.md). Returns the resolved
+        class (capacity acquired; released by the request's done
+        callback via ``track``)."""
         cls = self.policy.resolve(cls_name)
         if getattr(engine, "_restarting", False):
             # shed-during-restart: the device loop is inside its crash-
@@ -316,10 +319,16 @@ class AdmissionController:
                                      retry_after=wait)
         predicted = self.predicted_wait(engine)
         if timeout and predicted > timeout:
-            self._reject(cls, "deadline", 503, predicted)
-            raise ServiceUnavailable(
+            # the request-lifetime plane (docs/resilience.md): the caller's
+            # budget — propagated deadline or explicit timeout — cannot be
+            # met even before a slot is taken. 504/DEADLINE_EXCEEDED, not
+            # 503: retrying the same deadline is pointless, so no hint.
+            self._reject(cls, "deadline_exceeded", 504, predicted)
+            self.metrics.increment_counter(
+                "app_request_deadline_exceeded_total", 1, where="qos")
+            raise DeadlineExceeded(
                 f"predicted queue wait {predicted:.2f}s exceeds deadline "
-                f"{timeout:.2f}s", retry_after=predicted)
+                f"{timeout:.2f}s")
         if cls.max_concurrency:
             with self._lock:
                 if self._inflight[cls.name] >= cls.max_concurrency:
@@ -351,7 +360,8 @@ class AdmissionController:
                 retry_after: float) -> None:
         self.metrics.increment_counter("app_qos_rejected_total", 1,
                                        reason=reason, qos_class=cls.name)
-        if reason in ("queue", "deadline", "capacity", "restart", "slo_burn"):
+        if reason in ("queue", "deadline_exceeded", "capacity", "restart",
+                      "slo_burn"):
             # overload-driven (we turned away feasible work because of
             # load), as opposed to a client exceeding its rate budget —
             # this is what flips health to DEGRADED for the shed window

@@ -46,7 +46,8 @@ from typing import Any
 
 import numpy as np
 
-from gofr_tpu.http.errors import ServiceUnavailable
+from gofr_tpu import deadline as _deadline
+from gofr_tpu.http.errors import DeadlineExceeded, ServiceUnavailable
 from gofr_tpu.http.responses import Passthrough, Raw
 from gofr_tpu.http.streaming import RawStreamingResponse
 from gofr_tpu.qos import QoSPolicy
@@ -54,6 +55,7 @@ from gofr_tpu.router.gossip import DEFAULT_TOPIC, GossipReporter
 from gofr_tpu.router.registry import Replica, ReplicaRegistry
 from gofr_tpu.router.ring import HashRing, hash_point
 from gofr_tpu.service import ServiceError
+from gofr_tpu.service.budget import RetryBudget
 from gofr_tpu.tpu import prefix
 
 __all__ = ["GossipReporter", "HashRing", "Replica", "ReplicaRegistry",
@@ -85,6 +87,9 @@ class RouterPolicy:
     group: str = ""                      # ROUTER_GOSSIP_GROUP ('' = unique per router)
     replicas: dict[str, str] = field(default_factory=dict)  # ROUTER_REPLICAS static seed
     seed: int = 0                        # ROUTER_SEED (random-mode determinism)
+    # request-lifetime plane (docs/resilience.md)
+    hedge_after_ms: float = 0.0          # ROUTER_HEDGE_AFTER_MS (0 = hedging off)
+    hop_margin_ms: float = 50.0          # DEADLINE_HOP_MARGIN_MS (per-hop shrink)
 
     @classmethod
     def from_config(cls, config, **overrides: Any) -> "RouterPolicy":
@@ -102,6 +107,8 @@ class RouterPolicy:
             "topic": config.get_or_default("ROUTER_GOSSIP_TOPIC", DEFAULT_TOPIC),
             "group": config.get_or_default("ROUTER_GOSSIP_GROUP", ""),
             "seed": config.get_int("ROUTER_SEED", 0),
+            "hedge_after_ms": config.get_float("ROUTER_HEDGE_AFTER_MS", 0.0),
+            "hop_margin_ms": config.get_float("DEADLINE_HOP_MARGIN_MS", 50.0),
         }
         spill = config.get_or_default("ROUTER_SPILL_CLASSES", "interactive,default")
         kw["spill_classes"] = tuple(s.strip() for s in spill.split(",") if s.strip())
@@ -149,6 +156,11 @@ class Router:
         for name, url in self.policy.replicas.items():
             self.registry.add_static(name, url)
         self._rng = random.Random(self.policy.seed)
+        # shared retry budget (service/budget.py): spills and hedges both
+        # spend from it, so a fleet-wide 5xx blip decays instead of the
+        # router amplifying it with one extra attempt per request
+        self.budget = RetryBudget.from_config(container.config,
+                                              metrics=container.metrics)
         self._clients: dict[str, Any] = {}
         self._retired: list[Any] = []  # displaced clients, closed at stop()
         self._lock = threading.Lock()
@@ -284,8 +296,21 @@ class Router:
         p = self.plan(key, cls_name)
         m = self.container.metrics
         m.increment_counter("app_router_requests_total", 1, qos_class=p.qos_class)
+        self.budget.note_request()  # originals fund the retry/hedge budget
         with self._lock:
             self._stats["requests"] += 1
+        # request-lifetime plane: a request whose propagated deadline is
+        # already spent is shed HERE — proxying it would only make a
+        # replica compute an answer nobody can receive
+        req_ctx = req.context() if hasattr(req, "context") else {}
+        dl = _deadline.deadline_of(req_ctx)
+        if dl is not None and dl - time.monotonic() <= 0:
+            m.increment_counter("app_request_deadline_exceeded_total", 1,
+                                where="router")
+            self._record(p, sent=None, outcome="shed:deadline_exceeded")
+            with self._lock:
+                self._stats["shed"] += 1
+            raise DeadlineExceeded("request deadline expired at the router")
         if p.shed is not None:
             reason, retry_after = p.shed
             m.increment_counter("app_router_shed_total", 1,
@@ -296,10 +321,14 @@ class Router:
             raise ServiceUnavailable(
                 f"home replica unavailable ({reason}); retry later",
                 retry_after=retry_after)
-        headers = self._forward_headers(req, ctx.span)
+        headers = self._forward_headers(req, ctx.span, deadline_at=dl)
         path = req.path + (f"?{req.query_string}" if getattr(req, "query_string", "") else "")
+        if (self.policy.hedge_after_ms > 0 and p.spillable
+                and len(p.targets) >= 2):
+            return self._handle_hedged(p, req, path, headers)
         last_error: Exception | None = None
         moved_reason: str | None = None  # why the HOME was abandoned mid-loop
+        budget_spent = False  # ran out of retry budget mid-spill
         for i, rep in enumerate(p.targets):
             client = self._client(rep)
             try:
@@ -309,9 +338,18 @@ class Router:
                 last_error = e
                 if rep.name == p.home:
                     moved_reason = "error"
+                if i + 1 < len(p.targets) and not self.budget.try_spend():
+                    # a spill is a retry: without budget, fail fast instead
+                    # of feeding the storm one extra attempt per request
+                    budget_spent = True
+                    break
                 continue
             if resp.status_code == 429 or resp.status_code >= 500:
                 if i + 1 < len(p.targets):
+                    if not self.budget.try_spend():
+                        # budget exhausted: the replica's own 429/5xx
+                        # (Retry-After intact) passes through unspilled
+                        return self._finish(p, rep, resp, moved_reason)
                     # replica-side overload/failure: spill to the next ring
                     # replica (spillable classes have successors planned)
                     resp.close()
@@ -321,14 +359,111 @@ class Router:
                 # terminal target: the replica's own 429/503 (Retry-After
                 # intact) or 5xx passes through — never remapped
             return self._finish(p, rep, resp, moved_reason)
-        self._record(p, sent=None, outcome="error")
+        reason = "retry_budget" if budget_spent else "error"
+        self._record(p, sent=None,
+                     outcome="shed:retry_budget" if budget_spent else "error")
         with self._lock:
             self._stats["shed"] += 1
         m.increment_counter("app_router_shed_total", 1,
-                            qos_class=p.qos_class, reason="error")
+                            qos_class=p.qos_class, reason=reason)
         raise ServiceUnavailable(
             f"no replica accepted the request ({last_error})",
             retry_after=self.policy.retry_after_s)
+
+    def _handle_hedged(self, p: RoutePlan, req, path, headers):
+        """Hedged dispatch for spillable classes (ROUTER_HEDGE_AFTER_MS):
+        fire the home replica; when it stays silent past the hedge window
+        — or answers 429/5xx — fire the ring successor, budget allowing.
+        First good responder wins; the loser's response is closed as it
+        arrives, which aborts its upstream transfer so the replica's
+        disconnect path cancels the generation and frees the slot/pages
+        (cooperative cancellation, docs/resilience.md)."""
+        import queue as _q
+
+        m = self.container.metrics
+        results: _q.Queue = _q.Queue()
+
+        def fire(idx: int, rep: Replica) -> None:
+            try:
+                resp = self._client(rep).request(
+                    req.method, path, body=req.body or None,
+                    headers=headers, stream=True)
+            except Exception as e:  # noqa: BLE001 - reported via the queue
+                results.put((idx, rep, None, e))
+            else:
+                results.put((idx, rep, resp, None))
+
+        def spawn(idx: int) -> None:
+            threading.Thread(target=fire, args=(idx, p.targets[idx]),
+                             daemon=True, name="gofr-router-hedge").start()
+
+        spawn(0)
+        outstanding, next_idx = 1, 1
+        hedged = False          # did a hedge/spill actually fire?
+        budget_denied = False
+        hedge_wait = self.policy.hedge_after_ms / 1000.0
+        last_error: Exception | None = None
+        winner = None
+        while outstanding:
+            can_fire = next_idx < len(p.targets) and not budget_denied
+            try:
+                # only the FIRST silent window triggers a hedge; once all
+                # candidates are in flight we wait for whoever answers
+                wait = hedge_wait if (can_fire and not hedged) else None
+                idx, rep, resp, err = results.get(timeout=wait)
+            except _q.Empty:
+                if self.budget.try_spend():
+                    spawn(next_idx)
+                    next_idx += 1
+                    outstanding += 1
+                    hedged = True
+                else:
+                    budget_denied = True
+                continue
+            outstanding -= 1
+            if err is not None or resp.status_code == 429 or resp.status_code >= 500:
+                last_error = err if err is not None else ServiceError(
+                    f"server error {resp.status_code}")
+                if resp is not None:
+                    resp.close()
+                # a failed candidate is also a reason to try the successor
+                if can_fire and self.budget.try_spend():
+                    spawn(next_idx)
+                    next_idx += 1
+                    outstanding += 1
+                    hedged = True
+                continue
+            winner = (idx, rep, resp)
+            break
+        if hedged:
+            m.increment_counter(
+                "app_router_hedged_total", 1,
+                winner=("none" if winner is None
+                        else "primary" if winner[0] == 0 else "hedge"))
+        if winner is None:
+            self._record(p, sent=None, outcome="error")
+            with self._lock:
+                self._stats["shed"] += 1
+            m.increment_counter("app_router_shed_total", 1,
+                                qos_class=p.qos_class, reason="error")
+            raise ServiceUnavailable(
+                f"no replica accepted the request ({last_error})",
+                retry_after=self.policy.retry_after_s)
+        if outstanding:
+            # the loser is cancelled the moment it answers: close() aborts
+            # the upstream transfer mid-stream, so the losing replica's
+            # client-disconnect path reclaims its slot and pages
+            def drain(n: int) -> None:
+                for _ in range(n):
+                    _i, _rep, lresp, _e = results.get()
+                    if lresp is not None:
+                        lresp.close()
+
+            threading.Thread(target=drain, args=(outstanding,), daemon=True,
+                             name="gofr-router-hedge-drain").start()
+        idx, rep, resp = winner
+        moved = "hedge" if (hedged and idx > 0) else None
+        return self._finish(p, rep, resp, moved)
 
     def _finish(self, p: RoutePlan, rep: Replica, resp, moved_reason: str | None = None):
         m = self.container.metrics
@@ -361,9 +496,18 @@ class Router:
         return Passthrough(resp.read(), status_code=resp.status_code,
                            content_type=bare_type, headers=out_headers)
 
-    def _forward_headers(self, req, span) -> dict[str, str]:
+    def _forward_headers(self, req, span, deadline_at: float | None = None) -> dict[str, str]:
         headers = {k: v for k, v in (getattr(req, "headers", None) or {}).items()
                    if k.lower() not in _HOP_HEADERS}
+        if deadline_at is not None:
+            # re-stamp the absolute deadline SHRUNK by the hop margin: the
+            # replica must answer early enough for this proxy to still
+            # relay the response inside the client's budget
+            for k in [k for k in headers
+                      if k.lower() == _deadline.DEADLINE_HEADER.lower()]:
+                headers.pop(k)
+            headers[_deadline.DEADLINE_HEADER] = _deadline.header_value(
+                deadline_at, self.policy.hop_margin_ms / 1000.0)
         remote = getattr(req, "remote", "")
         if remote:
             # scan case-insensitively: HTTPRequest stores lowercase keys,

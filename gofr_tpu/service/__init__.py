@@ -11,6 +11,7 @@ injection, a structured log and an ``app_http_service_response`` histogram
 from __future__ import annotations
 
 import base64
+import random
 import threading
 import time
 from typing import Any
@@ -231,29 +232,79 @@ class _Wrapper:
 
 
 class Retry:
-    """Retry on transport error or 5xx (gofr `retry.go:95-109`)."""
+    """Retry on transport error or 5xx (gofr `retry.go:95-109`), with the
+    storm-safe refinements of docs/resilience.md:
 
-    def __init__(self, max_retries: int = 3, backoff: float = 0.05):
+    - *full jitter* on the exponential backoff — ``uniform(0, backoff *
+      2**attempt)`` — so synchronized callers don't re-converge on the
+      recovering upstream in lockstep waves;
+    - a ``Retry-After`` header on a 429/503 response overrides the
+      computed backoff (the server knows its recovery horizon better
+      than our exponent does), capped at the remaining deadline when the
+      outgoing request carries ``X-Request-Deadline-Ms``;
+    - an optional shared :class:`~gofr_tpu.service.budget.RetryBudget`:
+      each retry must win a token, and an exhausted budget fails fast
+      with the last error instead of amplifying the storm;
+    - requests whose propagated deadline has expired stop retrying —
+      a retry the caller cannot wait for is pure amplification.
+    """
+
+    def __init__(self, max_retries: int = 3, backoff: float = 0.05,
+                 budget: Any = None, rng: Any = None):
         self.max_retries = max_retries
         self.backoff = backoff
+        self.budget = budget
+        self._rng = rng if rng is not None else random.Random()
 
     def add_option(self, inner):
         opt = self
 
         class _Retry(_Wrapper):
             def request(self, method: str, path: str, **kw: Any) -> ServiceResponse:
+                from gofr_tpu import deadline as _deadline
+
+                headers = kw.get("headers") or {}
+                dl = _deadline.parse_deadline_ms(
+                    headers.get(_deadline.DEADLINE_HEADER))
+                if opt.budget is not None:
+                    opt.budget.note_request()
                 last_exc: Exception | None = None
                 for attempt in range(opt.max_retries + 1):
+                    retry_after: float | None = None
                     try:
                         resp = self._inner.request(method, path, **kw)
-                        if resp.status_code < 500:
+                        if resp.status_code in (429, 503):
+                            # httpx normalizes header keys to lowercase;
+                            # hand-built responses may not
+                            h = resp.headers or {}
+                            ra = h.get("Retry-After") or h.get("retry-after")
+                            try:
+                                retry_after = float(ra) if ra else None
+                            except (TypeError, ValueError):
+                                retry_after = None
+                        # a 429 WITH a Retry-After hint is retryable — the
+                        # server said exactly when; a bare 429 stays the
+                        # caller's problem (its rate budget, not ours)
+                        if resp.status_code < 500 and not (
+                                resp.status_code == 429 and retry_after is not None):
                             return resp
                         resp.close()  # a streamed 5xx must not leak its connection
                         last_exc = ServiceError(f"server error {resp.status_code}")
                     except ServiceError as e:
                         last_exc = e
-                    if attempt < opt.max_retries:
-                        time.sleep(opt.backoff * (2 ** attempt))
+                    if attempt >= opt.max_retries:
+                        break
+                    # full jitter unless the server named its own horizon
+                    sleep = (retry_after if retry_after is not None
+                             else opt._rng.uniform(0.0, opt.backoff * (2 ** attempt)))
+                    if dl is not None:
+                        remaining = dl - time.monotonic()
+                        if remaining <= 0:
+                            break  # the caller's budget is spent: stop amplifying
+                        sleep = min(sleep, remaining)
+                    if opt.budget is not None and not opt.budget.try_spend():
+                        break  # shared budget exhausted: fail fast, decay the storm
+                    time.sleep(max(0.0, sleep))
                 if isinstance(last_exc, ServiceError):
                     raise last_exc
                 raise ServiceError("retries exhausted")

@@ -55,7 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gofr_tpu.fleet import chaos
-from gofr_tpu.http.errors import RequestTimeout
+from gofr_tpu.http.errors import DeadlineExceeded, RequestTimeout
 from gofr_tpu.qos.scheduler import QoSQueue
 from gofr_tpu.tracing import RequestTrace, current_span
 from gofr_tpu.tpu.lockstep import TAG_CHUNK, TAG_DECODE, TAG_PREFILL, TAG_SPEC
@@ -96,8 +96,8 @@ class Request:
     _ids = itertools.count()
 
     __slots__ = ("id", "inputs", "kw", "enqueued_at", "deadline", "stream_q",
-                 "_done", "_result", "_error", "cancelled", "_complete_lock",
-                 "_callbacks")
+                 "_done", "_result", "_error", "cancelled", "cancel_reason",
+                 "_complete_lock", "_callbacks")
 
     def __init__(self, inputs: Any, kw: dict[str, Any], timeout: float | None, stream: bool = False):
         self.id = next(Request._ids)
@@ -112,6 +112,7 @@ class Request:
         self._error: Exception | None = None
         self._callbacks: list = []
         self.cancelled = False
+        self.cancel_reason: str | None = None
 
     def complete(self, result: Any = None, error: Exception | None = None) -> None:
         # Idempotent, first-writer-wins: stop()'s _fail_all can race a stuck
@@ -151,12 +152,26 @@ class Request:
             raise RuntimeError("request is not complete")
         return self._result, self._error
 
-    def cancel(self) -> None:
+    def cancel(self, reason: str = "cancelled") -> None:
+        """Cooperative: flags the request; the device loop reclaims the
+        slot/pages at its next bookkeeping pass. ``reason`` lands in the
+        flight-recorder timeline (``client_disconnect``, ``timeout``,
+        ``hedge_loser``, ...) — first caller wins."""
+        if not self.cancelled:
+            self.cancel_reason = reason
         self.cancelled = True
 
     def result(self, timeout: float | None = None) -> Any:
-        if not self._done.wait(timeout):
-            self.cancel()
+        # Unify on remaining budget: a request constructed with a deadline
+        # never blocks past it, even with no explicit wait — previously
+        # result() with its own timeout could outlive the deadline by the
+        # full wait (the double-timeout bug).
+        wait = timeout
+        if self.deadline is not None:
+            budget = max(0.0, self.deadline - time.monotonic())
+            wait = budget if wait is None else min(wait, budget)
+        if not self._done.wait(wait):
+            self.cancel("timeout")
             raise RequestTimeout()
         if self._error is not None:
             raise self._error
@@ -383,7 +398,22 @@ class _EngineBase:
         # cross the submit-thread → device-loop boundary); popped even when
         # tracing is off so a span object never lingers in request kw
         parent_span = kw.pop("_parent_span", None)
+        # optional caller hook: receives the Request the moment it exists,
+        # so transports can track in-flight work for disconnect-driven
+        # cancellation (Context._qos_kw, docs/resilience.md)
+        on_submit = kw.pop("_on_submit", None)
+        # chaos point "replica.slow" (fleet/chaos.py): a delay action here
+        # simulates a slow replica's admission path — the hedging drill's
+        # way of making one ring member consistently late
+        chaos.fire("replica.slow")
         eff_timeout = timeout if timeout is not None else self.default_timeout
+        if eff_timeout is not None and eff_timeout <= 0:
+            # the propagated deadline is already spent: shed pre-queue with
+            # 504 — computing tokens nobody can wait for helps no one
+            self.metrics.increment_counter(
+                "app_request_deadline_exceeded_total", 1, where="engine")
+            raise DeadlineExceeded(
+                "request deadline already expired at submission")
         qos, cls = self.qos, None
         if qos is not None:
             # admission BEFORE the request exists: backlog cap, per-class
@@ -395,6 +425,8 @@ class _EngineBase:
         req = Request(inputs, kw, eff_timeout, stream)
         if cls is not None:
             qos.track(req, cls)
+        if on_submit is not None:
+            on_submit(req)
         self._observe_submit(req, parent_span)
         self._queue.put(req)
         self.metrics.set_gauge("app_tpu_queue_depth", self._backlog())
@@ -473,6 +505,11 @@ class _EngineBase:
             # per-tier prefix-cache hit breakdown (hbm/host tokens + pages
             # swapped in from host DRAM) — docs/observability.md
             entry["prefix"] = prefix
+        if req.cancelled and req.cancel_reason:
+            # why the lifetime ended early (client_disconnect, timeout,
+            # hedge_loser, ...) — the /debug/requests timeline's answer to
+            # "who killed this request" (docs/resilience.md)
+            entry["cancel_reason"] = req.cancel_reason
         if error is not None:
             entry["error"] = type(error).__name__
         elif isinstance(result, dict) and "finish_reason" in result:
@@ -756,8 +793,8 @@ class _StreamIterator:
     def __next__(self) -> Any:
         return next(self._gen)
 
-    def cancel(self) -> None:
-        self._req.cancel()
+    def cancel(self, reason: str = "client_disconnect") -> None:
+        self._req.cancel(reason)
 
 
 class GenerateEngine(_EngineBase):

@@ -174,6 +174,28 @@ def test_slo_suite_is_in_quick_tier():
     assert "CaptureWatcher" in text and "def test_two_replica" in text
 
 
+def test_resilience_suite_is_in_quick_tier():
+    """ISSUE 10 satellite: the request-lifetime plane — deadline wire
+    form + per-hop shrink, the Request future's constructed-deadline
+    bound, retry-budget math (fake clock), Retry jitter/Retry-After/
+    deadline interplay (stub transport), router deadline shed +
+    budget-gated spill + hedged dispatch — is CPU-trivial by
+    construction and must ride the `-m quick` CI job on every push;
+    the paged-engine cancellation drills stay in tier-1 (unmarked)."""
+    path = REPO / "tests" / "test_resilience.py"
+    assert path.exists(), "tests/test_resilience.py missing"
+    text = path.read_text()
+    assert "pytest.mark.quick" in text, "resilience units must be quick-marked"
+    assert "test_resilience.py" not in QUICK_EXEMPT, (
+        "test_resilience.py must not be exempted from the quick tier"
+    )
+    # the tentpole's pieces are all covered: deadline propagation,
+    # budgeted retries, hedging, and cooperative cancellation
+    assert "RetryBudget" in text and "hedge" in text
+    assert "assert_page_refs_consistent" in text
+    assert "cancel_mid_decode" in text and "DEADLINE_HEADER" in text
+
+
 def test_ci_has_py310_compat_gate():
     """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
     syntax (same-quote nested f-strings) passes every 3.12 job silently and

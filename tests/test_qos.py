@@ -19,7 +19,8 @@ import pytest
 
 from gofr_tpu.config import DictConfig
 from gofr_tpu.container import new_mock_container
-from gofr_tpu.http.errors import ServiceUnavailable, TooManyRequests
+from gofr_tpu.http.errors import (DeadlineExceeded, ServiceUnavailable,
+                                  TooManyRequests)
 from gofr_tpu.qos import (
     AdmissionController,
     PriorityClass,
@@ -266,10 +267,11 @@ class TestAdmissionController:
 
         eng = FakeEngine()
         ctrl.observe_step(1.0)  # EWMA: 1s/step, 40 queued / 2 lanes = ~20s wait
-        with pytest.raises(ServiceUnavailable) as err:
+        with pytest.raises(DeadlineExceeded) as err:
             ctrl.admit_engine(eng, "interactive", timeout=5.0)
-        assert err.value.status_code == 503
-        assert err.value.retry_after and err.value.retry_after > 5.0
+        # doomed work is a DEADLINE failure (504), not an overload (503):
+        # waiting and retrying won't help THIS request, so no Retry-After
+        assert err.value.status_code == 504
         # no deadline -> no deadline rejection
         assert ctrl.admit_engine(eng, "interactive", None).name == "interactive"
 
@@ -483,8 +485,8 @@ class TestEngineQoSIntegration:
 
     def test_deadline_hopeless_work_rejected_not_timed_out(self, tiny_llama):
         """The acceptance property: a request whose predicted wait exceeds
-        its deadline is rejected AT SUBMIT with 503 + retry hint — it never
-        occupies a slot and never becomes a RequestTimeout."""
+        its deadline is rejected AT SUBMIT with 504 deadline_exceeded — it
+        never occupies a slot and never becomes a RequestTimeout."""
         cfg, params = tiny_llama
         c = new_mock_container()
         eng = make_engine(cfg, params, c)
@@ -494,12 +496,14 @@ class TestEngineQoSIntegration:
         eng._backlog = lambda: 10  # and 10 requests are already waiting
         try:
             t0 = time.monotonic()
-            with pytest.raises(ServiceUnavailable) as err:
+            with pytest.raises(DeadlineExceeded) as err:
                 eng.generate([1, 2, 3], max_new_tokens=2, timeout=2.0)
             assert time.monotonic() - t0 < 1.0, "rejection must be immediate"
-            assert err.value.retry_after > 2.0
+            assert err.value.status_code == 504
             assert c.metrics.get("app_qos_rejected_total").value(
-                reason="deadline", qos_class="default") == 1
+                reason="deadline_exceeded", qos_class="default") == 1
+            assert c.metrics.get(
+                "app_request_deadline_exceeded_total").value(where="qos") == 1
         finally:
             eng._backlog = lambda: 0
             eng.stop()

@@ -1,0 +1,558 @@
+"""End-to-end request-lifetime plane (ISSUE 10; docs/resilience.md).
+
+Quick tier: deadline wire-form parsing and the per-hop shrink, the
+Request future's constructed-deadline bound, Envoy-style retry-budget
+math on a fake clock, Retry full-jitter/Retry-After/deadline interplay
+against a stub transport, router-side deadline shed + budget-gated
+spill, and hedged dispatch (first good responder wins, the loser is
+closed so its replica cancels cooperatively). Engine tier (unmarked,
+tier-1): cancel-mid-decode reclaims the slot AND every KV page
+(testutil.assert_page_refs_consistent), and already-expired work is
+shed pre-slot with 504/deadline_exceeded.
+"""
+
+import random
+import time
+
+import pytest
+
+from gofr_tpu import deadline
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.fleet import chaos
+from gofr_tpu.http.errors import DeadlineExceeded, RequestTimeout, ServiceUnavailable
+from gofr_tpu.http.request import HTTPRequest
+from gofr_tpu.router import Router, RouterPolicy
+from gofr_tpu.service import Retry, ServiceError
+from gofr_tpu.service.budget import RetryBudget
+from gofr_tpu.tpu import prefix
+from gofr_tpu.tpu.engine import Request
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# -- deadline wire form ---------------------------------------------------------
+
+
+@pytest.mark.quick
+class TestDeadlineWire:
+    def test_garbage_degrades_to_no_deadline(self):
+        """A malformed deadline must never 500 the request."""
+        for junk in (None, "", "soon", "12,5", object()):
+            assert deadline.parse_deadline_ms(junk) is None
+
+    def test_roundtrip_preserves_remaining(self):
+        at = time.monotonic() + 5.0
+        back = deadline.parse_deadline_ms(deadline.header_value(at))
+        assert abs(back - at) < 0.1
+
+    def test_hop_margin_shrinks_the_budget(self):
+        """The router's re-stamp: each hop hands down LESS time than it
+        was given, so the replica finishes early enough to relay."""
+        at = time.monotonic() + 5.0
+        back = deadline.parse_deadline_ms(deadline.header_value(at, margin_s=1.0))
+        assert abs((at - back) - 1.0) < 0.1
+
+    def test_context_slot_helpers(self):
+        ctx: dict = {}
+        assert deadline.deadline_of(ctx) is None
+        assert deadline.remaining(ctx) is None
+        deadline.set_deadline(ctx, None)
+        assert deadline.CTX_KEY not in ctx  # None never pollutes the ctx
+        deadline.set_deadline(ctx, 100.0)
+        assert deadline.deadline_of(ctx) == 100.0
+        assert deadline.remaining(ctx, now=97.5) == 2.5
+
+
+# -- the Request future honors its constructed deadline -------------------------
+
+
+@pytest.mark.quick
+class TestRequestFuture:
+    def test_result_never_blocks_past_constructed_deadline(self):
+        """The double-timeout fix: ``result(30)`` on a request built with
+        ``timeout=0.15`` must raise at ~0.15s, not block for 30 — the
+        engine-side deadline is the binding one."""
+        req = Request([1], {}, timeout=0.15)
+        t0 = time.monotonic()
+        with pytest.raises(RequestTimeout):
+            req.result(timeout=30.0)
+        assert time.monotonic() - t0 < 5.0
+        assert req.cancelled and req.cancel_reason == "timeout"
+
+    def test_cancel_reason_first_caller_wins(self):
+        req = Request([1], {}, timeout=None)
+        req.cancel("client_disconnect")
+        req.cancel("timeout")  # late caller must not relabel the cause
+        assert req.cancel_reason == "client_disconnect"
+
+    def test_explicit_wait_still_binds_when_tighter(self):
+        req = Request([1], {}, timeout=60.0)
+        t0 = time.monotonic()
+        with pytest.raises(RequestTimeout):
+            req.result(timeout=0.05)
+        assert time.monotonic() - t0 < 5.0
+
+
+# -- Envoy-style retry budget ---------------------------------------------------
+
+
+@pytest.mark.quick
+class TestRetryBudget:
+    def test_min_retries_floor_on_idle_client(self):
+        clk = _Clock()
+        b = RetryBudget(fraction=0.2, min_retries=3, window_s=10.0, clock=clk)
+        assert b.allowed() == 3  # near-idle clients can still retry at all
+        assert [b.try_spend() for _ in range(4)] == [True, True, True, False]
+
+    def test_fraction_caps_the_aggregate(self):
+        clk = _Clock()
+        b = RetryBudget(fraction=0.2, min_retries=3, window_s=10.0, clock=clk)
+        for _ in range(100):
+            b.note_request()
+        assert b.allowed() == 20
+        granted = sum(1 for _ in range(100) if b.try_spend())
+        assert granted == 20  # amplification hard-capped at the fraction
+
+    def test_window_slide_refills(self):
+        clk = _Clock()
+        b = RetryBudget(fraction=0.5, min_retries=0, window_s=10.0, clock=clk)
+        b.note_request()
+        b.note_request()
+        assert b.try_spend() and not b.try_spend()
+        clk.t = 11.0  # the old retries (and originals) age out
+        b.note_request()
+        b.note_request()
+        assert b.try_spend()
+
+    def test_metrics_and_snapshot(self):
+        c = new_mock_container()
+        clk = _Clock()
+        b = RetryBudget(fraction=0.0, min_retries=1, window_s=10.0,
+                        metrics=c.metrics, clock=clk)
+        b.note_request()
+        assert b.try_spend() and not b.try_spend()
+        assert c.metrics.get("app_retry_budget_spent_total").value() == 1
+        assert c.metrics.get("app_retry_budget_exhausted_total").value() == 1
+        snap = b.snapshot()
+        assert snap["window_requests"] == 1 and snap["window_retries"] == 1
+
+
+# -- Retry middleware: jitter, Retry-After, deadline, budget --------------------
+
+
+class _Resp:
+    def __init__(self, status, headers=None):
+        self.status_code = status
+        self.headers = headers or {}
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+class _StubInner:
+    """Scripted transport: each entry is a response or an exception."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def request(self, method, path, **kw):
+        self.calls += 1
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+@pytest.mark.quick
+class TestRetryMiddleware:
+    def _sleeps(self, monkeypatch):
+        rec: list[float] = []
+        monkeypatch.setattr(time, "sleep", rec.append)
+        return rec
+
+    def test_full_jitter_bounded_by_exponential_envelope(self, monkeypatch):
+        sleeps = self._sleeps(monkeypatch)
+        inner = _StubInner([ServiceError("x"), ServiceError("x"), _Resp(200)])
+        client = Retry(max_retries=3, backoff=0.05,
+                       rng=random.Random(7)).add_option(inner)
+        assert client.request("GET", "/x").status_code == 200
+        assert inner.calls == 3
+        # uniform(0, backoff * 2**attempt): jittered, never the full wave
+        for i, s in enumerate(sleeps):
+            assert 0.0 <= s <= 0.05 * (2 ** i)
+
+    def test_retry_after_overrides_backoff(self, monkeypatch):
+        sleeps = self._sleeps(monkeypatch)
+        inner = _StubInner([_Resp(503, {"Retry-After": "0.07"}), _Resp(200)])
+        client = Retry(max_retries=2, backoff=5.0).add_option(inner)
+        assert client.request("GET", "/x").status_code == 200
+        assert sleeps == [0.07]  # the server's horizon, not our exponent
+
+    def test_429_with_hint_retries_bare_429_returns(self, monkeypatch):
+        self._sleeps(monkeypatch)
+        hinted = _StubInner([_Resp(429, {"retry-after": "0.01"}), _Resp(200)])
+        client = Retry(max_retries=2, backoff=0.01).add_option(hinted)
+        assert client.request("GET", "/x").status_code == 200
+        assert hinted.calls == 2
+        bare = _StubInner([_Resp(429)])
+        client = Retry(max_retries=2, backoff=0.01).add_option(bare)
+        # no hint: the caller's rate budget, not ours — returned verbatim
+        assert client.request("GET", "/x").status_code == 429
+        assert bare.calls == 1
+
+    def test_retry_after_capped_at_remaining_deadline(self, monkeypatch):
+        sleeps = self._sleeps(monkeypatch)
+        hdrs = {deadline.DEADLINE_HEADER:
+                deadline.header_value(time.monotonic() + 0.05)}
+        inner = _StubInner([_Resp(503, {"Retry-After": "9"}), _Resp(200)])
+        client = Retry(max_retries=2, backoff=0.01).add_option(inner)
+        client.request("GET", "/x", headers=hdrs)
+        assert len(sleeps) == 1 and sleeps[0] <= 0.06
+
+    def test_expired_deadline_stops_retrying(self, monkeypatch):
+        self._sleeps(monkeypatch)
+        hdrs = {deadline.DEADLINE_HEADER:
+                deadline.header_value(time.monotonic() - 1.0)}
+        inner = _StubInner([ServiceError("x"), _Resp(200)])
+        client = Retry(max_retries=3, backoff=0.01).add_option(inner)
+        with pytest.raises(ServiceError):
+            client.request("GET", "/x", headers=hdrs)
+        assert inner.calls == 1  # a retry nobody can wait for never fires
+
+    def test_budget_gates_retries_and_counts_originals(self, monkeypatch):
+        self._sleeps(monkeypatch)
+        clk = _Clock()
+        budget = RetryBudget(fraction=0.0, min_retries=1, window_s=10.0,
+                             clock=clk)
+        inner = _StubInner([ServiceError("x")] * 4)
+        client = Retry(max_retries=3, backoff=0.01,
+                       budget=budget).add_option(inner)
+        with pytest.raises(ServiceError):
+            client.request("GET", "/x")
+        assert inner.calls == 2  # 1 original + the single budgeted retry
+        assert budget.snapshot()["window_requests"] == 1
+
+
+# -- router: hop shrink, deadline shed, budget-gated spill, hedging -------------
+
+
+class _Ctx:
+    span = None
+
+    def __init__(self, req):
+        self.request = req
+
+    def header(self, name):
+        return (self.request.headers or {}).get(name.lower())
+
+
+def _http_req(headers=None, body=b"{}"):
+    return HTTPRequest(method="POST", path="/generate", query_string="",
+                       headers=headers or {}, body=body, path_params={},
+                       remote="10.0.0.9")
+
+
+class _ProxyResp:
+    def __init__(self, status, headers=None, body=b"{}", delay=0.0):
+        self.status_code = status
+        self.headers = {"content-type": "application/json", **(headers or {})}
+        self._body = body
+        self.delay = delay
+        self.closed = False
+
+    def read(self):
+        return self._body
+
+    def close(self):
+        self.closed = True
+
+
+class _ProxyClient:
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def request(self, method, path, **kw):
+        self.calls += 1
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        if item.delay:
+            time.sleep(item.delay)
+        return item
+
+
+@pytest.mark.quick
+class TestRouterLifetimePlane:
+    def _router(self, **kw):
+        kw.setdefault("page_size", 4)
+        kw.setdefault("jitter_s", 0.0)
+        kw.setdefault("replicas", {"a": "http://a", "b": "http://b"})
+        return Router(new_mock_container(), policy=RouterPolicy(**kw))
+
+    def _key_homed(self, router, name):
+        for i in range(512):
+            key = prefix.chain_key(0, bytes([i % 251, i // 251]))
+            if router.registry.full.lookup(key, 1)[0] == name:
+                return key
+        raise AssertionError(f"no key homed on {name}")
+
+    def _stub_clients(self, router, scripts):
+        clients = {name: _ProxyClient(script) for name, script in scripts.items()}
+        router._client = lambda rep: clients[rep.name]
+        return clients
+
+    def test_hop_restamp_shrinks_the_header(self):
+        router = self._router(hop_margin_ms=250.0)
+        at = time.monotonic() + 5.0
+        req = _http_req(headers={
+            deadline.DEADLINE_HEADER.lower(): deadline.header_value(at)})
+        out = router._forward_headers(req, None, deadline_at=at)
+        keys = [k for k in out
+                if k.lower() == deadline.DEADLINE_HEADER.lower()]
+        assert keys == [deadline.DEADLINE_HEADER]  # replaced, not duplicated
+        back = deadline.parse_deadline_ms(out[deadline.DEADLINE_HEADER])
+        assert abs((at - back) - 0.25) < 0.1  # shrunk by the hop margin
+
+    def test_expired_deadline_shed_at_router(self):
+        router = self._router()
+        req = _http_req()
+        deadline.set_deadline(req.context(), time.monotonic() - 1.0)
+        with pytest.raises(DeadlineExceeded):
+            router.handle(_Ctx(req))
+        m = router.container.metrics
+        assert m.get("app_request_deadline_exceeded_total").value(
+            where="router") == 1
+        assert router.debug_view()["stats"]["shed"] == 1
+
+    def test_budget_exhausted_spill_passes_replica_answer_through(self):
+        """With the budget spent, the home's own 429/503 (Retry-After
+        intact) goes back unspilled — no budget, no second attempt."""
+        router = self._router()
+        router.budget = RetryBudget(fraction=0.0, min_retries=0)
+        key = self._key_homed(router, "a")
+        clients = self._stub_clients(router, {
+            "a": [_ProxyResp(503, {"retry-after": "3"})], "b": []})
+        req = _http_req(body=b'{"prompt": "k%d"}' % key)
+        router.request_key = lambda r: key
+        out = router.handle(_Ctx(req))
+        assert out.status_code == 503
+        assert out.headers["retry-after"] == "3"
+        assert clients["b"].calls == 0
+
+    def test_transport_storm_without_budget_sheds_retry_budget(self):
+        router = self._router()
+        router.budget = RetryBudget(fraction=0.0, min_retries=0)
+        key = self._key_homed(router, "a")
+        clients = self._stub_clients(router, {
+            "a": [ServiceError("conn refused")], "b": []})
+        router.request_key = lambda r: key
+        with pytest.raises(ServiceUnavailable):
+            router.handle(_Ctx(_http_req()))
+        assert clients["b"].calls == 0  # the spill was denied, not attempted
+        m = router.container.metrics
+        assert m.get("app_router_shed_total").value(
+            qos_class="default", reason="retry_budget") == 1
+
+    def test_budgeted_spill_still_works(self):
+        router = self._router()
+        key = self._key_homed(router, "a")
+        clients = self._stub_clients(router, {
+            "a": [ServiceError("conn refused")], "b": [_ProxyResp(200)]})
+        router.request_key = lambda r: key
+        out = router.handle(_Ctx(_http_req()))
+        assert out.status_code == 200 and clients["b"].calls == 1
+
+    def test_hedge_fires_after_silence_and_closes_the_loser(self):
+        """Primary silent past the hedge window: the successor answers
+        first and wins; the primary's late response is closed (aborting
+        its upstream transfer = cooperative cancel at that replica)."""
+        router = self._router(hedge_after_ms=20.0)
+        key = self._key_homed(router, "a")
+        slow = _ProxyResp(200, body=b"slow", delay=0.4)
+        clients = self._stub_clients(router, {
+            "a": [slow], "b": [_ProxyResp(200, body=b"fast")]})
+        router.request_key = lambda r: key
+        out = router.handle(_Ctx(_http_req()))
+        assert out.body == b"fast"
+        assert clients["a"].calls == 1 and clients["b"].calls == 1
+        m = router.container.metrics
+        assert m.get("app_router_hedged_total").value(winner="hedge") == 1
+        t_end = time.monotonic() + 5.0
+        while not slow.closed and time.monotonic() < t_end:
+            time.sleep(0.01)
+        assert slow.closed, "the losing response must be closed (cancelled)"
+
+    def test_hedge_primary_fast_no_hedge_fired(self):
+        router = self._router(hedge_after_ms=50.0)
+        key = self._key_homed(router, "a")
+        clients = self._stub_clients(router, {
+            "a": [_ProxyResp(200, body=b"home")], "b": []})
+        router.request_key = lambda r: key
+        out = router.handle(_Ctx(_http_req()))
+        assert out.body == b"home" and clients["b"].calls == 0
+        m = router.container.metrics
+        assert m.get("app_router_hedged_total").value() == 0
+
+    def test_hedge_denied_by_budget_waits_for_primary(self):
+        router = self._router(hedge_after_ms=10.0)
+        router.budget = RetryBudget(fraction=0.0, min_retries=0)
+        key = self._key_homed(router, "a")
+        clients = self._stub_clients(router, {
+            "a": [_ProxyResp(200, body=b"home", delay=0.15)], "b": []})
+        router.request_key = lambda r: key
+        out = router.handle(_Ctx(_http_req()))
+        assert out.body == b"home"
+        assert clients["b"].calls == 0  # a hedge is a retry: budget-gated
+
+
+# -- chaos points + gRPC deadline ingress ---------------------------------------
+
+
+@pytest.mark.quick
+def test_client_disconnect_chaos_point_schedule():
+    """The storm drill's deterministic hangup schedule: every 2nd fire."""
+    with chaos.override("client.disconnect:drop,every=2"):
+        assert [chaos.fire("client.disconnect") for _ in range(4)] == \
+            [False, True, False, True]
+        assert chaos.fire("replica.slow") is False  # unarmed point is free
+
+
+@pytest.mark.quick
+def test_grpc_deadline_joins_the_request_context():
+    """The gRPC edge reads the client's RPC deadline off the servicer
+    context into the same monotonic slot the HTTP header feeds."""
+    from gofr_tpu.grpc import server as gsrv
+
+    ic = gsrv.GofrGrpcInterceptor(new_mock_container())
+
+    class _SC:
+        def time_remaining(self):
+            return 1.5
+
+    span, token = ic._begin({}, "Svc/M", {}, _SC())
+    try:
+        ctx = gsrv.current_grpc_context()
+        rem = deadline.remaining(ctx.request.context())
+        assert rem is not None and 1.0 < rem <= 1.5
+    finally:
+        gsrv._grpc_ctx.reset(token)
+
+    class _NoDeadline:
+        def time_remaining(self):
+            return None
+
+    span, token = ic._begin({}, "Svc/M", {}, _NoDeadline())
+    try:
+        ctx = gsrv.current_grpc_context()
+        assert deadline.remaining(ctx.request.context()) is None
+    finally:
+        gsrv._grpc_ctx.reset(token)
+
+
+# -- engine integration (tiny model, paged layout; unmarked = tier-1) -----------
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    import jax
+
+    from gofr_tpu.models import LlamaConfig, llama
+
+    cfg = LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(7))
+    return cfg, params
+
+
+def _paged_engine(cfg, params, container, **kw):
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.engine import GenerateEngine
+
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("max_prefill_batch", 2)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("page_size", 8)
+    kw.setdefault("decode_chunk", 1)
+    return GenerateEngine(llama, cfg, params, container, **kw)
+
+
+class TestEngineCancellation:
+    def test_cancel_mid_decode_frees_slot_and_pages(self, tiny_llama):
+        """The disconnect-mid-SSE contract at the engine boundary: the
+        transport's stream.cancel() must reclaim the slot AND every KV
+        page — verified by the full paged-cache accounting cross-check."""
+        from gofr_tpu.testutil import assert_page_refs_consistent
+
+        cfg, params = tiny_llama
+        c = new_mock_container()
+        eng = _paged_engine(cfg, params, c)
+        try:
+            it = eng.generate(list(range(1, 6)), max_new_tokens=400,
+                              timeout=120, stream=True)
+            first = next(it)
+            assert isinstance(first, int)
+            it.cancel()  # what _stream_sse does on ConnectionResetError
+            with pytest.raises(Exception):
+                for _ in it:
+                    pass
+            t_end = time.monotonic() + 30
+            while time.monotonic() < t_end and any(
+                    s is not None for s in eng.slots):
+                time.sleep(0.05)
+            assert all(s is None for s in eng.slots)
+            assert it._req.cancelled
+            assert it._req.cancel_reason == "client_disconnect"
+            assert_page_refs_consistent(eng)  # zero leaked pages
+        finally:
+            eng.stop()
+
+    def test_expired_deadline_submit_sheds_pre_slot(self, tiny_llama):
+        """Doomed work never takes a slot: an effective timeout <= 0 is a
+        504 at submission, with the engine-side metric."""
+        cfg, params = tiny_llama
+        c = new_mock_container()
+        eng = _paged_engine(cfg, params, c)
+        try:
+            with pytest.raises(DeadlineExceeded):
+                eng.generate([1, 2, 3], max_new_tokens=2, timeout=0.0)
+            assert all(s is None for s in eng.slots)
+            assert c.metrics.get("app_request_deadline_exceeded_total").value(
+                where="engine") == 1
+        finally:
+            eng.stop()
+
+    def test_cancel_reason_reaches_the_flight_recorder(self, tiny_llama):
+        """Observability satellite: a cancelled generation's reason rides
+        the flight-recorder entry (the 'why did this request die' answer
+        an incident wants first)."""
+        cfg, params = tiny_llama
+        c = new_mock_container()
+        eng = _paged_engine(cfg, params, c)
+        try:
+            it = eng.generate(list(range(1, 6)), max_new_tokens=400,
+                              timeout=120, stream=True)
+            next(it)
+            it.cancel()
+            with pytest.raises(Exception):
+                for _ in it:
+                    pass
+            t_end = time.monotonic() + 30
+            entry = None
+            while time.monotonic() < t_end and entry is None:
+                for e in c.flight.requests():
+                    if e.get("cancel_reason") == "client_disconnect":
+                        entry = e
+                        break
+                time.sleep(0.05)
+            assert entry is not None, "cancel_reason missing from recorder"
+        finally:
+            eng.stop()
