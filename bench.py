@@ -14,6 +14,14 @@ was the round's only artifact). We probe TPU init in a SUBPROCESS with a
 timeout, retry once, and on failure pin the CPU backend and run a scaled
 preset — the JSON line always appears, with the platform reported honestly.
 
+A fallback the operator did not ask for is additionally a LOUD failure
+(ISSUE 11, ROADMAP O3: BENCH_r04/r05 archived CPU numbers as "green"):
+``vs_baseline`` becomes the string ``INVALID_CPU_FALLBACK`` and the process
+exits 3 after printing, so a harness can never archive a silent CPU run as
+a TPU datapoint. ``GOFR_BENCH_PLATFORM=cpu`` (explicit) and
+``GOFR_BENCH_ALLOW_CPU=1`` (CI smokes) remain valid, clearly-labelled CPU
+runs with exit 0.
+
 Env knobs:
     GOFR_BENCH_PRESET         one_b (default on TPU) | eight_b (Llama-3-8B shape,
                               the north-star model class) | tiny (CPU fallback default)
@@ -54,6 +62,21 @@ Env knobs:
                               storm through the shared RetryBudget must
                               keep amplification <= the budget fraction;
                               results in extra.storm
+    GOFR_BENCH_DIURNAL        1 = also run the trace-driven diurnal
+                              elasticity harness (ISSUE 11, ROADMAP O2): a
+                              24h-compressed sinusoidal arrival curve with
+                              burst hours and zipf tenant skew, replayed
+                              against a static max-replica fleet AND an
+                              elastic fleet driven by fleet/autoscaler.py;
+                              per-class SLO attainment and chip-seconds-
+                              per-request for both arms land in
+                              extra.autoscale
+    GOFR_BENCH_DIURNAL_S      compressed trace duration seconds (default 60)
+    GOFR_BENCH_DIURNAL_REQUESTS  trace size (default max(24, 3x requests))
+    GOFR_BENCH_DIURNAL_MAX    replica clamp for both arms (default 3)
+    GOFR_BENCH_DIURNAL_SLOTS  decode slots per replica (default min(4, slots))
+    GOFR_BENCH_ALLOW_CPU      1 = a TPU-probe CPU fallback stays a valid
+                              (labelled) CPU run instead of failing loud
     GOFR_BENCH_PIPELINE       device pipeline depth (default 2; 1 = sync, up to 4)
     GOFR_BENCH_OVERLAP_AB     1 = also measure the mixed-arrival workload (paced
                               arrivals of short + chunked-long prompts) with the
@@ -991,6 +1014,179 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["storm"] = f"error: {e}"[:160]
 
+    # trace-driven diurnal elasticity harness (ISSUE 11, ROADMAP O2): a 24h
+    # arrival curve compressed into GOFR_BENCH_DIURNAL_S seconds — sinusoidal
+    # "hours" with two 3x burst hours and zipf tenant→class skew — replayed
+    # IDENTICALLY against two fleets: "static" (max replicas, always on) and
+    # "elastic" (the fleet/autoscaler.py control loop starting from one
+    # replica). Judged on both axes the autoscaler trades between: per-class
+    # SLO attainment (did elasticity cost the users anything) and
+    # chip-seconds-per-request (what did static provisioning waste).
+    if os.environ.get("GOFR_BENCH_DIURNAL") == "1":
+        from gofr_tpu.container import new_mock_container as _fresh_container
+        from gofr_tpu.fleet.autoscaler import (
+            AutoscalePolicy,
+            Autoscaler,
+            FleetSignals,
+            LocalEngineFleet,
+        )
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        d_total_s = float(os.environ.get("GOFR_BENCH_DIURNAL_S", "60"))
+        d_reqs = int(os.environ.get("GOFR_BENCH_DIURNAL_REQUESTS",
+                                    str(max(24, 3 * n_requests))))
+        d_max = int(os.environ.get("GOFR_BENCH_DIURNAL_MAX", "3"))
+        d_slots = int(os.environ.get("GOFR_BENCH_DIURNAL_SLOTS",
+                                     str(min(4, best[0]))))
+        d_classes = ("interactive", "default", "batch")
+        # the trace is built ONCE — both arms replay identical arrival
+        # times, classes, prompts and output lengths
+        d_hours = np.arange(24)
+        d_weights = 1.0 + 0.9 * np.sin(2 * np.pi * (d_hours - 6) / 24.0)
+        d_burst_hours = rng.choice(24, size=2, replace=False)
+        d_weights[d_burst_hours] *= 3.0
+        d_per_hour = rng.multinomial(d_reqs, d_weights / d_weights.sum())
+        d_hour_s = d_total_s / 24.0
+        d_tw = np.array([1.0 / (i + 1) for i in range(6)])  # zipf tenants
+        d_tw = d_tw / d_tw.sum()
+        d_trace = []
+        for h, cnt in enumerate(d_per_hour):
+            for t_off in np.sort(rng.uniform(0, d_hour_s, size=int(cnt))):
+                tenant = int(rng.choice(6, p=d_tw))
+                plen = int(np.clip(rng.lognormal(
+                    np.log(max(8, prompt_len // 2)), 0.4), 8, prompt_len))
+                nlen = int(np.clip(rng.lognormal(
+                    np.log(max(2, max_new // 2)), 0.4), 2, max_new))
+                d_trace.append((
+                    h * d_hour_s + float(t_off),
+                    d_classes[tenant % len(d_classes)],
+                    rng.randint(1, cfg.vocab_size, size=plen).tolist(),
+                    nlen))
+
+        def _run_diurnal_arm(elastic: bool) -> dict:
+            # fresh container per arm: its SLO plane is the judge, so the
+            # arms must not share windows. CPU-scale objectives + a short
+            # fast window so a compressed trace can actually burn budget.
+            cont = _fresh_container({
+                "SLO_FAST_WINDOW_S": str(max(5.0, d_total_s / 8.0)),
+                "SLO_MIN_SAMPLES": "5",
+                "SLO_INTERACTIVE_TTFT_MS": os.environ.get(
+                    "GOFR_BENCH_DIURNAL_TTFT_MS", "1500"),
+            })
+
+            def factory(name: str) -> GenerateEngine:
+                # the warm-spare contract: weights are already in `params`
+                # and warmup() resolves its attention pins from the shared
+                # GOFR_AUTOTUNE_CACHE, so a mid-trace spawn is near-free
+                eng = GenerateEngine(llama, cfg, params, cont,
+                                     **engine_kw(d_slots, best[1]))
+                eng.warmup()
+                eng.start()
+                return eng
+
+            fleet = LocalEngineFleet(factory, name_prefix=f"d{int(elastic)}-")
+            n_start = 1 if elastic else d_max
+            for _ in range(n_start):
+                fleet.spawn()
+            scaler = None
+            if elastic:
+                policy = AutoscalePolicy(
+                    min_replicas=1, max_replicas=d_max,
+                    burn_out=1.5, burn_in=1.0,
+                    wait_out_s=0.5, wait_in_s=0.1,
+                    sustain_s=max(0.5, d_total_s / 60.0),
+                    idle_s=max(2.0, d_total_s / 12.0),
+                    cooldown_out_s=max(1.0, d_total_s / 30.0),
+                    cooldown_in_s=max(2.0, d_total_s / 15.0),
+                    interval_s=0.25, drain_timeout_s=timeout)
+
+                def signals() -> FleetSignals:
+                    pr = (cont.slo.pressure() if cont.slo is not None
+                          else {"burn": None})
+                    return FleetSignals(
+                        burn=pr.get("burn"),
+                        predicted_wait_s=fleet.max_predicted_wait(),
+                        replicas=fleet.count(), age_s=0.0)
+
+                scaler = Autoscaler(fleet, policy, signals=signals,
+                                    logger=cont.logger,
+                                    metrics=cont.metrics).start()
+            chip_s, errors, done = 0.0, 0, 0
+            lo = hi = fleet.count()
+            d_live = []
+            t0 = last = time.monotonic()
+            try:
+                for t_at, cls, p, nlen in d_trace:
+                    while True:
+                        now_t = time.monotonic()
+                        chip_s += fleet.count() * (now_t - last)
+                        last = now_t
+                        lo, hi = min(lo, fleet.count()), max(hi, fleet.count())
+                        if now_t - t0 >= t_at:
+                            break
+                        time.sleep(min(0.02, t_at - (now_t - t0)))
+                    # least-backlog placement with drain/shed spillover —
+                    # the in-process stand-in for the router's ring+spill
+                    for eng in sorted(fleet.engines(),
+                                      key=lambda e: e._backlog()):
+                        try:
+                            d_live.append(eng.submit(
+                                p, max_new_tokens=nlen, timeout=timeout,
+                                qos_class=cls))
+                            break
+                        except Exception:  # noqa: BLE001 - draining/shedding
+                            continue
+                    else:
+                        errors += 1
+                for r in d_live:
+                    try:
+                        r.result(timeout)
+                        done += 1
+                    except Exception:  # noqa: BLE001 - requeue raced retire
+                        errors += 1
+                    now_t = time.monotonic()
+                    chip_s += fleet.count() * (now_t - last)
+                    last = now_t
+                elapsed_d = time.monotonic() - t0
+                total_spawned = fleet._counter
+                final_count = fleet.count()
+            finally:
+                if scaler is not None:
+                    scaler.stop()
+                fleet.stop_all()
+            per_class = {
+                cname: {
+                    oname: {"attainment": e["fast"]["attainment"],
+                            "burn_rate": e["fast"]["burn_rate"]}
+                    for oname, e in objs.items() if e["fast"]["total"]}
+                for cname, objs in cont.slo.snapshot().items()}
+            return {
+                "requests": len(d_trace), "completed": done, "errors": errors,
+                "elapsed_s": round(elapsed_d, 2),
+                "chip_seconds": round(chip_s, 2),
+                "chip_seconds_per_request": round(chip_s / max(1, done), 4),
+                "replicas_min": lo, "replicas_max": hi,
+                "scale_outs": total_spawned - n_start,
+                "scale_ins": total_spawned - final_count,
+                "per_class": {c: v for c, v in per_class.items() if v},
+            }
+
+        try:
+            d_arms = {"elastic": _run_diurnal_arm(True),
+                      "static": _run_diurnal_arm(False)}
+            d_arms["trace"] = {
+                "compressed_s": d_total_s, "requests": len(d_trace),
+                "burst_hours": sorted(int(h) for h in d_burst_hours),
+                "max_replicas": d_max, "slots_per_replica": d_slots,
+            }
+            es, ss = d_arms["elastic"], d_arms["static"]
+            if es["completed"] and ss["completed"]:
+                d_arms["chip_seconds_saved_ratio"] = round(
+                    1.0 - es["chip_seconds"] / max(ss["chip_seconds"], 1e-9), 4)
+            extra["autoscale"] = d_arms
+        except Exception as e:  # noqa: BLE001
+            extra["autoscale"] = f"error: {e}"[:160]
+
     # NB: on the CPU fallback the "device" compute runs on the same host
     # cores as the packing/readback, so overlap has nothing to hide behind
     # and "off" can win; the A/B is meaningful on a real accelerator link
@@ -1074,13 +1270,33 @@ def main() -> None:
     # for one_b-class generate on TPU); a tiny-model CPU fallback could "beat"
     # it vacuously, so report null there rather than an inflated ratio.
     comparable = preset == "one_b" and on_accel
+    vs_baseline = round(req_per_s / 125.0, 4) if comparable else None
+    # Un-blinding (ROADMAP O3, ISSUE 11): BENCH_r04/r05 silently fell back
+    # to CPU behind the probe timeout and archived "green" numbers. A
+    # fallback the operator did not ask for is now a loud failure: the
+    # archive says INVALID_CPU_FALLBACK and the process exits nonzero, so
+    # no harness can mistake a CPU run for a TPU datapoint again. Asking
+    # for CPU explicitly (GOFR_BENCH_PLATFORM=cpu, or the
+    # GOFR_BENCH_ALLOW_CPU=1 escape hatch for CI smokes) stays a valid —
+    # clearly-labelled — CPU run.
+    silent_fallback = (backend_diag.startswith("TPU unavailable")
+                      and os.environ.get("GOFR_BENCH_ALLOW_CPU") != "1")
+    if silent_fallback:
+        vs_baseline = "INVALID_CPU_FALLBACK"
+        extra["platform_fallback"] = backend_diag
     print(json.dumps({
         "metric": f"llama_{preset}_generate_req_per_s_per_chip",
         "value": round(req_per_s, 3),
         "unit": "req/s",
-        "vs_baseline": round(req_per_s / 125.0, 4) if comparable else None,
+        "vs_baseline": vs_baseline,
         "extra": extra,
     }))
+    if silent_fallback:
+        print("bench: FAILING LOUD — TPU probe fell back to CPU "
+              f"({backend_diag}); these numbers are not a TPU datapoint. "
+              "Set GOFR_BENCH_PLATFORM=cpu or GOFR_BENCH_ALLOW_CPU=1 to run "
+              "an intentional CPU bench.", file=sys.stderr)
+        sys.exit(3)
 
 
 if __name__ == "__main__":

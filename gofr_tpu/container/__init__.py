@@ -133,6 +133,19 @@ class Container:
                       "followers dropped from the announce fan-out mid-stream")
         m.new_counter("app_fleet_supervisor_restarts_total",
                       "fleet member processes restarted by fleet.Supervisor")
+        # SLO-driven autoscaler (fleet/autoscaler.py, docs/resilience.md)
+        m.new_gauge("app_fleet_replicas", "replicas the autoscaler's driver manages")
+        m.new_counter("app_fleet_autoscale_decisions_total",
+                      "autoscaler control-loop ticks (by decision: out/in/hold/freeze)")
+        m.new_counter("app_fleet_autoscale_spawn_failures_total",
+                      "warm-spare spawn attempts that failed (retried with backoff)")
+        m.new_counter("app_fleet_autoscale_drain_aborts_total",
+                      "scale-in drains aborted (victim re-admitted to the ring)")
+        m.new_counter("app_fleet_requeued_total",
+                      "requests moved from a draining replica onto a peer")
+        m.new_gauge("app_tpu_draining", "1 while the engine is in its scale-in drain")
+        m.new_counter("app_tpu_drain_shed_total",
+                      "requests shed 503 because they arrived during a drain")
         # kernel-backend autotuner (ops/autotune.py, docs/kernels.md):
         # info-style gauge — 1 on the (op, backend) pair the warmup
         # autotuner pinned for 'auto' resolution, 0 on the loser

@@ -7,7 +7,13 @@ Pieces:
   followers dial the leader, frames carry the fleet epoch, membership
   changes happen at step boundaries outside the compiled programs;
 - :mod:`gofr_tpu.fleet.supervisor` — the watchdog→restart→warm-rejoin
-  loop for one fleet process (exit-17 aware, windowed restart budget);
+  loop for one fleet process (exit-17 aware, sliding-window restart
+  budget), plus :class:`FleetSupervisor`'s fleet-wide monotonic
+  generation counter;
+- :mod:`gofr_tpu.fleet.autoscaler` — the SLO-driven elastic control
+  loop (burn-rate/predicted-wait pressure → warm-spare spawn; calm →
+  zero-drop drain + retire) with hysteresis, cooldowns and a replica
+  clamp (``FLEET_AUTOSCALE_*``, docs/resilience.md);
 - :mod:`gofr_tpu.fleet.chaos` — deterministic fault injection at named
   points (``GOFR_CHAOS``), used by the failure-contract tests only and
   zero-cost when unset.
@@ -42,18 +48,33 @@ from gofr_tpu.fleet.channel import (
     FleetProtocolError,
     fingerprint_of,
 )
-from gofr_tpu.fleet.supervisor import Supervisor
+from gofr_tpu.fleet.autoscaler import (
+    AutoscalePolicy,
+    Autoscaler,
+    FleetSignals,
+    LocalEngineFleet,
+    ScaleDecider,
+    requeue,
+)
+from gofr_tpu.fleet.supervisor import FleetSupervisor, Supervisor
 
 __all__ = [
+    "AutoscalePolicy",
+    "Autoscaler",
     "ChannelClosed",
     "CollectiveChannel",
     "FleetConfig",
     "FleetFollowerChannel",
     "FleetLeaderChannel",
     "FleetProtocolError",
+    "FleetSignals",
+    "FleetSupervisor",
+    "LocalEngineFleet",
+    "ScaleDecider",
     "Supervisor",
     "epoch_of",
     "fingerprint_of",
+    "requeue",
 ]
 
 

@@ -13,17 +13,24 @@ A fleet process dies in one of three recognizable ways:
   the same seed; the announce channel is the only state that matters).
 
 The restart budget is windowed like the engine's device-loop budget:
-crashes further apart than ``window_s`` don't count against it — the
-give-up exists for crash LOOPS, not lifetime fault totals. Each respawn
-passes the new generation number to ``spawn`` so the process can derive
-its base fleet epoch (``FLEET_EPOCH``) and logs can correlate lives.
+only crashes inside the trailing ``window_s`` count against it — the
+give-up exists for crash LOOPS, not lifetime fault totals. The budget is
+a true sliding window (a deque of crash timestamps pruned to the
+window), not a reset-on-gap counter: a slow steady drip of isolated
+faults each a few minutes apart never exhausts it, because no single
+window ever holds more than a couple of crashes. Each respawn passes
+the new generation number to ``spawn`` so the process can derive its
+base fleet epoch (``FLEET_EPOCH``) and logs can correlate lives;
+:class:`FleetSupervisor` hands all members ONE shared monotonic counter
+so rapid kill/rejoin across different members can never reuse an epoch.
 """
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
-from typing import Any, Callable
+from typing import Any, Callable, Iterable
 
 # == tpu.lockstep.LOCKSTEP_EXIT_CODE; literal here because lockstep imports
 # the fleet package (chaos hooks) and this module must stay import-light
@@ -44,7 +51,8 @@ class Supervisor:
                  max_restarts: int = 3, window_s: float = 300.0,
                  backoff_s: float = 0.5, backoff_cap_s: float = 10.0,
                  restart_on: Callable[[int], bool] | None = None,
-                 logger=None, metrics=None):
+                 logger=None, metrics=None,
+                 now: Callable[[], float] = time.monotonic):
         self.spawn = spawn
         self.name = name
         self.max_restarts = max_restarts
@@ -58,7 +66,16 @@ class Supervisor:
         self.restarts = 0
         self.proc: Any = None
         self._stop = threading.Event()
-        self._last_crash_at = 0.0
+        self._now = now
+        self._crashes: collections.deque[float] = collections.deque()
+
+    def _crashes_in_window(self, now: float) -> int:
+        """Record a crash at ``now`` and return how many crashes the
+        trailing window holds (sliding, not reset-on-gap: see module doc)."""
+        self._crashes.append(now)
+        while self._crashes and now - self._crashes[0] > self.window_s:
+            self._crashes.popleft()
+        return len(self._crashes)
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -88,16 +105,13 @@ class Supervisor:
             if not self.restart_on(rc):
                 self._log(f"generation {self.generation} exited {rc}; policy says no restart")
                 return rc
-            now = time.monotonic()
-            if now - self._last_crash_at > self.window_s:
-                self.restarts = 0  # isolated fault, not a crash loop
-            self._last_crash_at = now
-            if self.restarts >= self.max_restarts:
+            in_window = self._crashes_in_window(self._now())
+            if in_window > self.max_restarts:
                 self._log(
                     f"generation {self.generation} exited {rc}; restart budget "
                     f"({self.max_restarts} within {self.window_s:.0f}s) exhausted — giving up")
                 return rc
-            self.restarts += 1
+            self.restarts = in_window
             why = ("liveness watchdog: leader presumed dead — restarting into rejoin-wait"
                    if rc == LOCKSTEP_EXIT_CODE else f"crash (exit {rc})")
             delay = min(self.backoff_s * (2 ** (self.restarts - 1)), self.backoff_cap_s)
@@ -120,3 +134,56 @@ class Supervisor:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+class FleetSupervisor:
+    """Supervise N named fleet members with ONE shared, lock-protected,
+    strictly monotonic generation counter. Every spawn — initial bring-up
+    or post-crash respawn of ANY member — draws the next number, so the
+    ``FLEET_EPOCH`` base derived from it can never be reused even under
+    rapid kill/rejoin across different members (two replicas crashing in
+    the same window get distinct, ordered generations; a ring re-admission
+    gate keyed on a strictly bumped epoch therefore always passes for the
+    newer life and never for a stale one).
+
+    ``spawn_member(name, generation) -> Popen-like`` starts one member;
+    the autoscaler drives the same protocol at a higher level, and each
+    member individually keeps the windowed restart budget of
+    :class:`Supervisor`.
+    """
+
+    def __init__(self, spawn_member: Callable[[str, int], Any], *,
+                 members: Iterable[str], logger=None, metrics=None,
+                 now: Callable[[], float] = time.monotonic, **supervisor_kw):
+        self.spawn_member = spawn_member
+        self._lock = threading.Lock()
+        self._generation = 0
+        self.members: dict[str, Supervisor] = {}
+        for name in members:
+            self.members[name] = Supervisor(
+                self._spawner(name), name=name, logger=logger,
+                metrics=metrics, now=now, **supervisor_kw)
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def next_generation(self) -> int:
+        with self._lock:
+            self._generation += 1
+            return self._generation
+
+    def _spawner(self, name: str) -> Callable[[int], Any]:
+        # the member Supervisor's own per-life counter is ignored on
+        # purpose: the FLEET-WIDE counter is the monotonicity contract
+        def spawn(_local_generation: int) -> Any:
+            return self.spawn_member(name, self.next_generation())
+        return spawn
+
+    def start(self) -> dict[str, threading.Thread]:
+        return {name: sup.start() for name, sup in self.members.items()}
+
+    def stop(self) -> None:
+        for sup in self.members.values():
+            sup.stop()

@@ -315,6 +315,30 @@ class SLOEngine:
     def burning_classes(self, now: float | None = None) -> set[str]:
         return {b["class"] for b in self.breaches(now)}
 
+    def pressure(self, now: float | None = None) -> dict[str, Any]:
+        """The autoscaler's pressure reading (fleet/autoscaler.py): the
+        WORST fast-window burn across every tracked (class, objective)
+        pair, regardless of the breach gate's ``burn_threshold`` — the
+        scale-out threshold is the autoscale policy's to set. ``burn`` is
+        None when no window holds ``min_samples`` yet — an idle fleet,
+        which the decider (together with an empty queue) reads as calm so
+        a quiet fleet can still scale in; a silent SIGNAL PLANE is the
+        reading's ``age_s``, and that is what freezes decisions."""
+        t = self._now() if now is None else now
+        worst: float | None = None
+        source = None
+        samples = 0
+        with self._lock:
+            for (cname, oname), tr in self._trackers.items():
+                good, total = tr.fast.stats(t)
+                samples += total
+                if total < self.min_samples:
+                    continue
+                burn = tr.burn(good, total)
+                if burn is not None and (worst is None or burn > worst):
+                    worst, source = burn, f"{cname}/{oname}"
+        return {"burn": worst, "source": source, "samples": samples}
+
     def should_shed(self, cls_name: str | None, now: float | None = None) -> bool:
         """QoS pressure signal (``QOS_SHED_ON_BURN``): shed this class when
         a STRICTLY higher-priority class is burning its fast budget — the

@@ -234,6 +234,13 @@ class AdmissionController:
                            or getattr(engine, "max_batch", 1)))
         return self._ewma_step * math.ceil(backlog / lanes)
 
+    def max_predicted_wait(self) -> float:
+        """Worst predicted queue wait across every bound engine — one of
+        the two pressure signals the fleet autoscaler scales out on
+        (fleet/autoscaler.py; the other is the SLO fast-window burn)."""
+        return max((self.predicted_wait(e) for e in self._engines.values()),
+                   default=0.0)
+
     # -- admission -------------------------------------------------------------
 
     def classify(self, headers) -> str:

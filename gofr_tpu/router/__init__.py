@@ -277,6 +277,11 @@ class Router:
             return None
         if home_r.restarting or home_r.drop_reason == "restart":
             return "restart"
+        if home_r.draining or home_r.drop_reason == "draining":
+            # scale-in: normally invisible here (a draining member left the
+            # full ring, so the successor already IS the home); this only
+            # names the race where a drain gossip lands mid-plan
+            return "draining"
         if home_r.shedding:
             return "shedding"
         if not home_r.in_ring:

@@ -15,6 +15,8 @@ Snapshot schema (one JSON object per message):
     status      UP | DEGRADED | DOWN — worst engine health
     epoch       max fleet/restart epoch over engines (fleet.epoch_of)
     restarting  any engine inside its PR 5 crash-recovery window
+    draining    any engine in its scale-in drain (fleet/autoscaler.py):
+                the registry moves the replica's keys to ring successors
     shedding    QoS shed within its window (AdmissionController.shedding)
     retry_after backoff hint (s) for router-side sheds while unavailable
     seq, ts     per-reporter sequence + wall clock (debug only)
@@ -60,6 +62,7 @@ class GossipReporter:
     def snapshot(self) -> dict[str, Any]:
         status = "UP"
         restarting = False
+        draining = False
         epoch = 0
         for engine in self.container.engines.values():
             try:
@@ -73,13 +76,15 @@ class GossipReporter:
             elif s != "UP" and status == "UP":
                 status = "DEGRADED"
             restarting = restarting or bool(getattr(engine, "_restarting", False))
+            draining = draining or bool(getattr(engine, "_draining", False))
             epoch = max(epoch, epoch_of(engine))
         qos = self.container.qos
         shedding = bool(qos.shedding) if qos is not None else False
         self._seq += 1
         snap: dict[str, Any] = {
             "replica": self.name, "url": self.url, "status": status,
-            "epoch": epoch, "restarting": restarting, "shedding": shedding,
+            "epoch": epoch, "restarting": restarting, "draining": draining,
+            "shedding": shedding,
             "retry_after": self.retry_after_s, "seq": self._seq,
             "ts": time.time(),
         }

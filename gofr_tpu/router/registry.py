@@ -12,6 +12,14 @@ consistent-hash ring's membership:
 - restart window (PR 5: the engine's crash-recovery backoff, gossiped as
   ``restarting``) or ``DOWN`` or gossip silence past ``ttl_s`` → dropped
   from the ring; its keys move to ring successors.
+- ``draining`` (scale-in: fleet/autoscaler.py flipped the replica's engine
+  into its drain state) → dropped from BOTH rings: unlike a restart
+  window the member is leaving on purpose, so every class's keys migrate
+  to ring successors immediately and nothing sheds. A drain abort (the
+  autoscaler re-admitting after a failed scale-in) gossips ``UP`` with
+  ``draining`` clear and re-enters through the normal jittered admission
+  — no epoch gate, because the replica's device state was never torn
+  down.
 - re-admission: after the replica gossips ``UP`` again — and, when the
   drop was a restart window, at a STRICTLY BUMPED epoch (the engine's
   restart/fleet-epoch counter; a replica whose device state was rebuilt
@@ -41,11 +49,12 @@ class Replica:
     epoch: int = 0
     shedding: bool = False
     restarting: bool = False
+    draining: bool = False         # scale-in drain in progress (autoscaler)
     retry_after: float = 0.0       # replica-suggested backoff hint (s)
     static: bool = False           # seeded by config, exempt from gossip TTL
     last_seen: float = 0.0
     in_ring: bool = False
-    drop_reason: str = ""          # restart | down | stale ('' = never dropped)
+    drop_reason: str = ""          # restart | down | stale | draining ('' = never dropped)
     healthy_epoch: int = -1        # last epoch gossiped while UP and in the ring
     drop_epoch: int = -1           # healthy_epoch at drop time (epoch-gate base)
     drop_at: float = 0.0
@@ -59,7 +68,8 @@ class Replica:
         return {
             "name": self.name, "url": self.url, "status": self.status,
             "epoch": self.epoch, "shedding": self.shedding,
-            "restarting": self.restarting, "in_ring": self.in_ring,
+            "restarting": self.restarting, "draining": self.draining,
+            "in_ring": self.in_ring,
             "drop_reason": self.drop_reason or None,
         }
 
@@ -120,6 +130,7 @@ class ReplicaRegistry:
                 pass
             r.shedding = bool(msg.get("shedding"))
             r.restarting = bool(msg.get("restarting"))
+            r.draining = bool(msg.get("draining"))
             try:
                 r.retry_after = float(msg.get("retry_after") or 0.0)
             except (TypeError, ValueError):
@@ -128,7 +139,7 @@ class ReplicaRegistry:
             if isinstance(dig, dict):
                 r.digest = dig
             r.last_seen = self._now()
-            if r.in_ring and r.status == "UP" and not r.restarting:
+            if r.in_ring and r.status == "UP" and not r.restarting and not r.draining:
                 # the epoch-gate base: the engine bumps its restart counter
                 # BEFORE its window opens, so the drop-triggering gossip
                 # already carries the post-rebuild epoch — only an epoch
@@ -162,13 +173,18 @@ class ReplicaRegistry:
     # -- state machine ---------------------------------------------------------
 
     def _apply(self, r: Replica) -> None:
-        healthy = r.status == "UP" and not r.restarting
+        healthy = r.status == "UP" and not r.restarting and not r.draining
         if r.in_ring:
             # DOWN outranks restarting: a terminal DOWN gossiped while an
             # engine is mid-restart-window (graceful stop during a crash
             # recovery) must give the keys up NOW, not look transient
             if r.status in ("DOWN", "STALE"):
                 self._drop(r, "down")
+            elif r.draining:
+                # scale-in: out of BOTH rings (reason != "restart" removes
+                # full-ring membership in _drop) — every class's keys move
+                # to successors, nothing sheds against a leaving member
+                self._drop(r, "draining")
             elif r.restarting:
                 self._drop(r, "restart")
         elif healthy and self._readmittable(r):

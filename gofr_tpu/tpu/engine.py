@@ -55,7 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gofr_tpu.fleet import chaos
-from gofr_tpu.http.errors import DeadlineExceeded, RequestTimeout
+from gofr_tpu.http.errors import DeadlineExceeded, RequestTimeout, ServiceUnavailable
 from gofr_tpu.qos.scheduler import QoSQueue
 from gofr_tpu.tracing import RequestTrace, current_span
 from gofr_tpu.tpu.lockstep import TAG_CHUNK, TAG_DECODE, TAG_PREFILL, TAG_SPEC
@@ -228,6 +228,10 @@ class _EngineBase:
         self.max_restarts = max_restarts
         self._restarts = 0
         self._restarting = False
+        # scale-in drain (fleet/autoscaler.py): while set, _submit sheds new
+        # arrivals with a retryable 503 and the device loop stops claiming
+        # slots for queued work — in-flight slot work runs to completion
+        self._draining = False
         # crashes further apart than this don't count against the restart
         # budget — the give-up is for crash LOOPS, not lifetime fault totals
         self.restart_window_s = 60.0
@@ -392,6 +396,12 @@ class _EngineBase:
             self.start()
         if self._startup_error is not None:
             raise self._startup_error
+        if self._draining:
+            # draining replica (scale-in): the registry already stopped
+            # routing here, so anything arriving now raced the transition —
+            # shed retryable, the ring successor owns the key by the retry
+            self.metrics.increment_counter("app_tpu_drain_shed_total", 1)
+            raise ServiceUnavailable("replica draining", retry_after=1.0)
         if "qos_class" in kw:  # public spelling of the internal routing key
             kw["_qos_class"] = kw.pop("qos_class")
         # the inbound server span, carried EXPLICITLY (contextvars don't
@@ -567,9 +577,12 @@ class _EngineBase:
         if self._restarting:
             return {"status": "DEGRADED",
                     "details": {"restarting": True, "restarts": self._restarts}}
+        detail: dict[str, Any] = {"queue_depth": self._backlog(), "restarts": self._restarts}
+        if self._draining:
+            detail["draining"] = True
         return {
             "status": "UP" if self._thread is not None and self._thread.is_alive() else "DEGRADED",
-            "details": {"queue_depth": self._backlog(), "restarts": self._restarts},
+            "details": detail,
         }
 
 
@@ -1738,6 +1751,76 @@ class GenerateEngine(_EngineBase):
         )
         return True
 
+    # -- scale-in drain (fleet/autoscaler.py; docs/resilience.md) --------------
+
+    def begin_drain(self) -> None:
+        """Flip the replica into draining: _submit sheds new arrivals with a
+        retryable 503 and _admit_prefill stops claiming slots for queued
+        work. In-flight slot work is untouched — streams keep streaming."""
+        self._draining = True
+        self.metrics.set_gauge("app_tpu_draining", 1)
+
+    def abort_drain(self) -> None:
+        """Drain abort (autoscaler re-admit after death-mid-drain chaos or a
+        failed scale-in): back to serving — admission resumes on the very
+        next loop iteration; nothing was torn down."""
+        self._draining = False
+        self.metrics.set_gauge("app_tpu_draining", 0)
+
+    def drain_queued(self) -> list[Request]:
+        """Pull every queued-but-unadmitted request off this replica for
+        requeue onto a peer (fleet.autoscaler.requeue). Must run AFTER
+        begin_drain: _admit_prefill holds the state lock across its whole
+        queue→pending→slot move and returns early while draining, so under
+        the same lock nothing can be half-moved here."""
+        out: list[Request] = []
+        with self._state_lock:
+            while True:
+                try:
+                    out.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            out.extend(r for r, _ in self._pending)
+            self._pending = []
+            out.extend(r for r, _ in self._pending_long)
+            self._pending_long = []
+        self.metrics.set_gauge("app_tpu_queue_depth", self._backlog())
+        return out
+
+    def drained(self) -> bool:
+        """True once every slot is empty and no device work is in flight —
+        the point where retiring the process drops zero streams."""
+        with self._state_lock:
+            return all(s is None for s in self.slots) and not self._dq
+
+    def drain(self, *, timeout_s: float = 30.0) -> list[Request]:
+        """The scale-in drain entrypoint: stop admitting, hand back queued
+        work for peer requeue, and wait for in-flight streams to finish.
+        Past ``timeout_s`` the stragglers are cooperatively cancelled (the
+        PR10 lifetime plane frees their slots and KV pages) with a bounded
+        grace for the reclaim. Returns the requests the caller must requeue;
+        the chaos point ``replica.drain`` fires after the flag flips, so an
+        injected fault leaves the engine draining — exactly the state a
+        replica that died mid-drain is in — for the autoscaler's
+        abort→re-admit path to undo."""
+        self.begin_drain()
+        chaos.fire("replica.drain")
+        pending = self.drain_queued()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        cancelled = False
+        while not self.drained():
+            if time.monotonic() >= deadline:
+                if cancelled:
+                    break
+                with self._state_lock:
+                    for s in self.slots:
+                        if s is not None:
+                            s.request.cancel("drain_timeout")
+                cancelled = True
+                deadline = time.monotonic() + 5.0  # reclaim grace
+            time.sleep(0.01)
+        return pending
+
     # -- slot/page bookkeeping -------------------------------------------------
 
     def _build_slot_cache(self):
@@ -2449,6 +2532,12 @@ class GenerateEngine(_EngineBase):
         # to preemption, _fail_all, and crash recovery like any other
         # occupied lane.
         with self._state_lock:
+            if self._draining:
+                # scale-in drain: no new slot claims; queued work stays put
+                # for drain_queued() to requeue onto a peer. Under the same
+                # lock drain_queued takes, so a request can never be mid-move
+                # from queue to slot when it runs.
+                return False
             self._drain_pending()
             self.metrics.set_gauge("app_tpu_queue_depth", self._backlog())
             self._admit_long()

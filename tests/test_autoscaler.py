@@ -1,0 +1,510 @@
+"""The elastic-fleet contracts (ISSUE 11, docs/resilience.md):
+
+- quick tier — the ScaleDecider's pure decision math (hysteresis, cooldown,
+  clamp, stale-signal freeze) with fake clocks, the spawn retry/backoff and
+  drain-abort chaos handling at the Autoscaler level, the registry's
+  ``draining`` membership transitions, and the zero-drop requeue helper;
+- engine tier — the scale-in drain drill on real paged engines: a replica
+  put into ``draining`` mid-stream finishes the stream token-exact, a
+  queued request requeues to a peer and completes, page accounting balances
+  after retire, and death-mid-drain chaos re-admits the victim with the
+  fleet routable and the control loop live.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gofr_tpu.fleet import chaos
+from gofr_tpu.fleet.autoscaler import (
+    AutoscalePolicy,
+    Autoscaler,
+    FleetSignals,
+    LocalEngineFleet,
+    ScaleDecider,
+    requeue,
+)
+from gofr_tpu.http.errors import RequestTimeout, ServiceUnavailable
+
+# -- fakes ---------------------------------------------------------------------
+
+
+def _sig(burn=None, wait=0.0, replicas=1, age=0.0):
+    return FleetSignals(burn=burn, predicted_wait_s=wait,
+                        replicas=replicas, age_s=age)
+
+
+POLICY = AutoscalePolicy(
+    min_replicas=1, max_replicas=3, burn_out=2.0, burn_in=1.0,
+    wait_out_s=2.0, wait_in_s=0.25, sustain_s=3.0, idle_s=10.0,
+    cooldown_out_s=5.0, cooldown_in_s=20.0, stale_s=5.0)
+
+
+class FakeDriver:
+    def __init__(self, n=1, fail_spawns=0, fail_drain=False):
+        self.n = n
+        self.fail_spawns = fail_spawns
+        self.fail_drain = fail_drain
+        self.spawned: list[str] = []
+        self.readmitted: list[str] = []
+        self.retired: list[str] = []
+
+    def count(self):
+        return self.n
+
+    def spawn(self):
+        if self.fail_spawns > 0:
+            self.fail_spawns -= 1
+            raise RuntimeError("spawn failed")
+        self.n += 1
+        name = f"rep{self.n}"
+        self.spawned.append(name)
+        return name
+
+    def pick_victim(self):
+        return f"rep{self.n}" if self.n > 1 else None
+
+    def drain(self, name, timeout_s):
+        if self.fail_drain:
+            raise RuntimeError("replica died mid-drain")
+        return True
+
+    def readmit(self, name):
+        self.readmitted.append(name)
+
+    def retire(self, name):
+        self.n -= 1
+        self.retired.append(name)
+
+
+def _autoscaler(driver, policy=POLICY, signals=None, clock=None):
+    sleeps: list[float] = []
+    t = {"now": 0.0}
+    return Autoscaler(
+        driver, policy,
+        signals=signals or (lambda: _sig()),
+        now=(clock or (lambda: t["now"])),
+        sleep=sleeps.append), sleeps
+
+
+# -- quick tier: decision math -------------------------------------------------
+
+
+@pytest.mark.quick
+class TestScaleDecider:
+    def test_scale_out_requires_sustained_pressure(self):
+        d = ScaleDecider(POLICY)
+        assert d.decide(_sig(burn=5.0), 0.0) == "hold"   # just got hot
+        assert d.decide(_sig(burn=5.0), 2.9) == "hold"   # not sustained yet
+        assert d.decide(_sig(burn=5.0), 3.0) == "out"    # sustain_s reached
+
+    def test_predicted_wait_is_an_independent_pressure_signal(self):
+        d = ScaleDecider(POLICY)
+        assert d.decide(_sig(wait=9.0), 0.0) == "hold"
+        assert d.decide(_sig(wait=9.0), 3.5) == "out"
+
+    def test_pressure_blip_resets_the_sustain_clock(self):
+        d = ScaleDecider(POLICY)
+        d.decide(_sig(burn=5.0), 0.0)
+        d.decide(_sig(burn=0.1, wait=0.0), 1.0)          # calm blip
+        assert d.decide(_sig(burn=5.0), 2.0) == "hold"   # clock restarted
+        assert d.decide(_sig(burn=5.0), 5.0) == "out"
+
+    def test_hysteresis_band_never_acts(self):
+        # burn between burn_in and burn_out, wait between wait_in and
+        # wait_out: neither hot nor calm, so neither streak accumulates
+        d = ScaleDecider(POLICY)
+        for t in range(0, 100, 2):
+            assert d.decide(_sig(burn=1.5, wait=1.0, replicas=2), float(t)) == "hold"
+
+    def test_cooldown_blocks_consecutive_scale_outs(self):
+        d = ScaleDecider(POLICY)
+        assert d.decide(_sig(burn=5.0), 3.0) == "hold"
+        assert d.decide(_sig(burn=5.0), 6.5) == "out"
+        d.note_action(6.5)
+        # still hot, sustain re-accumulates from the action; cooldown_out_s
+        # (5) < sustain_s re-accumulation (3) from 6.5 → out again at 9.5+
+        assert d.decide(_sig(burn=5.0, replicas=2), 7.0) == "hold"
+        assert d.decide(_sig(burn=5.0, replicas=2), 9.9) == "hold"
+        assert d.decide(_sig(burn=5.0, replicas=2), 11.6) == "out"
+
+    def test_clamp_holds_at_max_and_min(self):
+        d = ScaleDecider(POLICY)
+        d.decide(_sig(burn=5.0, replicas=3), 0.0)
+        assert d.decide(_sig(burn=5.0, replicas=3), 10.0) == "hold"  # at max
+        d2 = ScaleDecider(POLICY)
+        d2.decide(_sig(replicas=1), 0.0)
+        assert d2.decide(_sig(replicas=1), 50.0) == "hold"           # at min
+
+    def test_scale_in_requires_sustained_idle_and_long_cooldown(self):
+        d = ScaleDecider(POLICY)
+        assert d.decide(_sig(replicas=2), 0.0) == "hold"
+        assert d.decide(_sig(replicas=2), 9.0) == "hold"
+        assert d.decide(_sig(replicas=2), 25.0) == "in"
+        d.note_action(25.0)
+        assert d.decide(_sig(replicas=2), 30.0) == "hold"  # cooldown_in_s=20
+        assert d.decide(_sig(replicas=2), 46.0) == "in"
+
+    def test_stale_signals_freeze_and_clear_streaks(self):
+        d = ScaleDecider(POLICY)
+        d.decide(_sig(burn=5.0), 0.0)
+        d.decide(_sig(burn=5.0), 2.9)
+        # gossip silence: no decision on fiction, and the pressure streak
+        # must NOT survive the gap (it may be a different world after)
+        assert d.decide(_sig(burn=5.0, age=6.0), 3.0) == "freeze"
+        assert d.decide(_sig(burn=5.0), 4.0) == "hold"
+        assert d.decide(_sig(burn=5.0), 6.9) == "hold"
+        assert d.decide(_sig(burn=5.0), 7.1) == "out"
+
+    def test_no_burn_evidence_plus_empty_queue_is_calm(self):
+        # an idle fleet has no latency samples at all (burn=None): with the
+        # queue empty too, that IS calm — otherwise a quiet fleet could
+        # never scale in
+        d = ScaleDecider(POLICY)
+        d.decide(_sig(burn=None, wait=0.0, replicas=2), 0.0)
+        assert d.decide(_sig(burn=None, wait=0.0, replicas=2), 25.0) == "in"
+
+    def test_inverted_thresholds_rejected(self):
+        with pytest.raises(ValueError, match="scale-in"):
+            AutoscalePolicy(burn_out=1.0, burn_in=2.0)
+        with pytest.raises(ValueError, match="FLEET_AUTOSCALE_MAX"):
+            AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+
+@pytest.mark.quick
+class TestAutoscalerChaos:
+    def test_spawn_chaos_retries_with_backoff_then_succeeds(self):
+        drv = FakeDriver(n=1)
+        a, sleeps = _autoscaler(drv)
+        with chaos.override("autoscale.spawn:raise,nth=1"):
+            assert a._scale_out() is not None
+        assert drv.n == 2
+        assert sleeps == [a.policy.spawn_backoff_s]  # one backoff, then won
+
+    def test_permanent_spawn_failure_leaves_loop_live_and_cooled(self):
+        drv = FakeDriver(n=1)
+        a, sleeps = _autoscaler(drv)
+        with chaos.override("autoscale.spawn:raise"):
+            assert a._scale_out() is None  # gave up this tick, no raise
+        assert drv.n == 1
+        assert len(sleeps) == a.policy.spawn_retries - 1
+        # cooldown engaged even though nothing spawned: the next hot tick
+        # must not hammer the failing driver
+        assert a.decider._last_action_at == 0.0
+        assert a.step(now=0.1) == "hold"
+
+    def test_drain_abort_readmits_victim(self):
+        drv = FakeDriver(n=2, fail_drain=True)
+        a, _ = _autoscaler(drv)
+        assert a._scale_in() is None
+        assert drv.readmitted == ["rep2"]
+        assert drv.retired == []
+        assert drv.n == 2  # fleet unchanged, still routable
+
+    def test_clean_drain_retires(self):
+        drv = FakeDriver(n=2)
+        a, _ = _autoscaler(drv)
+        assert a._scale_in() == "rep2"
+        assert drv.retired == ["rep2"]
+        assert drv.n == 1
+
+    def test_signal_source_failure_freezes(self):
+        drv = FakeDriver(n=1)
+
+        def bad_signals():
+            raise RuntimeError("gossip silent")
+
+        a, _ = _autoscaler(drv, signals=bad_signals)
+        assert a.step(now=0.0) == "freeze"
+        assert a.step(now=100.0) == "freeze"  # still live, still frozen
+
+    def test_step_counts_decisions(self):
+        from gofr_tpu.container import new_mock_container
+
+        c = new_mock_container()
+        drv = FakeDriver(n=1)
+        a = Autoscaler(drv, POLICY, signals=lambda: _sig(),
+                       metrics=c.metrics, now=lambda: 0.0, sleep=lambda s: None)
+        a.step(now=0.0)
+        m = c.metrics.get("app_fleet_autoscale_decisions_total")
+        assert m.value(decision="hold") == 1
+
+
+# -- quick tier: registry draining transitions ---------------------------------
+
+
+@pytest.mark.quick
+class TestRegistryDraining:
+    def _registry(self):
+        from gofr_tpu.router.registry import ReplicaRegistry
+        from gofr_tpu.router.ring import HashRing
+
+        t = {"now": 0.0}
+        reg = ReplicaRegistry(HashRing(), ttl_s=0.0, jitter_s=0.0,
+                              now=lambda: t["now"])
+        return reg, t
+
+    def test_draining_leaves_both_rings(self):
+        reg, _ = self._registry()
+        for name in ("a", "b"):
+            reg.observe({"replica": name, "url": f"http://{name}", "epoch": 1})
+        assert set(reg.ring.members()) == {"a", "b"}
+        reg.observe({"replica": "a", "epoch": 1, "draining": True})
+        r = reg.get("a")
+        assert not r.in_ring and r.drop_reason == "draining"
+        # unlike a restart window, the FULL ring gives the keys up too:
+        # every class migrates to the successor, nothing sheds
+        assert reg.ring.members() == ["b"]
+        assert reg.full.members() == ["b"]
+
+    def test_drain_abort_readmits_without_epoch_bump(self):
+        reg, _ = self._registry()
+        reg.observe({"replica": "a", "epoch": 4})
+        reg.observe({"replica": "a", "epoch": 4, "draining": True})
+        assert not reg.get("a").in_ring
+        # device state was never torn down, so the SAME epoch re-admits
+        # (the strict bump gate is for restart windows only)
+        reg.observe({"replica": "a", "epoch": 4, "draining": False})
+        assert reg.get("a").in_ring
+
+    def test_terminal_down_after_drain_stays_out(self):
+        reg, _ = self._registry()
+        reg.observe({"replica": "a", "epoch": 1})
+        reg.observe({"replica": "a", "epoch": 1, "draining": True})
+        reg.observe({"replica": "a", "epoch": 1, "status": "DOWN"})
+        assert not reg.get("a").in_ring
+        assert reg.full.members() == []
+
+    def test_snapshot_carries_draining(self):
+        reg, _ = self._registry()
+        reg.observe({"replica": "a", "epoch": 1, "draining": True})
+        assert reg.snapshot()[0]["draining"] is True
+
+    def test_gossip_snapshot_reports_engine_drain(self):
+        from gofr_tpu.container import new_mock_container
+        from gofr_tpu.router.gossip import GossipReporter
+
+        class _Eng:
+            _draining = True
+            _restarting = False
+            _restarts = 0
+
+            def health_check(self):
+                return {"status": "UP", "details": {}}
+
+        c = new_mock_container()
+        c.register_engine("gen", _Eng())
+        snap = GossipReporter(c, name="rep-a").snapshot()
+        assert snap["draining"] is True
+        assert snap["status"] == "UP"
+
+
+# -- quick tier: zero-drop requeue ---------------------------------------------
+
+
+@pytest.mark.quick
+class TestRequeue:
+    def _req(self, timeout=30.0, stream=False):
+        from gofr_tpu.tpu.engine import Request
+
+        return Request([1, 2, 3], {}, timeout, stream)
+
+    class _Peer:
+        metrics = None
+
+        def __init__(self):
+            import queue
+
+            self._queue = queue.Queue()
+
+    def test_moves_request_objects_to_peer(self):
+        peer = self._Peer()
+        reqs = [self._req(), self._req()]
+        assert requeue(reqs, peer) == 2
+        assert peer._queue.qsize() == 2
+        assert peer._queue.get_nowait() is reqs[0]  # the OBJECT moved
+
+    def test_cancelled_and_expired_complete_instead_of_travelling(self):
+        peer = self._Peer()
+        dead = self._req()
+        dead.cancel("client_disconnect")
+        spent = self._req(timeout=0.000001)
+        time.sleep(0.01)
+        assert requeue([dead, spent], peer) == 0
+        assert peer._queue.qsize() == 0
+        with pytest.raises(RequestTimeout):
+            dead.result(1.0)
+
+    def test_no_peer_sheds_retryable(self):
+        req = self._req()
+        assert requeue([req], None) == 0
+        with pytest.raises(ServiceUnavailable):
+            req.result(1.0)
+
+
+# -- engine tier: the drain drill on real paged engines ------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from gofr_tpu.testutil import greedy_reference, tiny_f32_llama
+
+    cfg, params = tiny_f32_llama()
+    return cfg, params, greedy_reference(cfg, params)
+
+
+def _fleet(cfg, params, *, slots=2, registry=None):
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.models import llama
+    from gofr_tpu.tpu.engine import GenerateEngine
+
+    cont = new_mock_container()
+
+    def factory(name):
+        eng = GenerateEngine(llama, cfg, params, cont, slots=slots,
+                             max_len=64, kv_layout="paged", page_size=8,
+                             prefill_buckets=[16])
+        eng.start()
+        return eng
+
+    return LocalEngineFleet(factory, registry=registry), cont
+
+
+class TestDrainDrill:
+    PROMPT = [3, 7, 11, 3, 7, 11, 9, 1]
+    QUEUED = [5, 2, 9, 4]
+    NEW = 7
+
+    def test_drain_finishes_stream_token_exact_and_requeues_queued(self, tiny):
+        from gofr_tpu.router.registry import ReplicaRegistry
+        from gofr_tpu.router.ring import HashRing
+        from gofr_tpu.testutil import assert_page_refs_consistent
+
+        cfg, params, ref = tiny
+        reg = ReplicaRegistry(HashRing(), jitter_s=0.0)
+        fleet, _ = _fleet(cfg, params, slots=1, registry=reg)
+        try:
+            victim, peer = fleet.spawn(), fleet.spawn()
+            veng = fleet.engine(victim)
+            # one stream mid-flight on the only slot, one request queued
+            # behind it — the drain must finish the first token-exact on
+            # the victim and move the second, as an OBJECT, to the peer
+            streaming = veng.submit(self.PROMPT, max_new_tokens=self.NEW,
+                                    timeout=60.0, stream=True)
+            queued = veng.submit(self.QUEUED, max_new_tokens=self.NEW,
+                                 timeout=60.0)
+            deadline = time.monotonic() + 30.0
+            while streaming.kw.get("_slot") is None and time.monotonic() < deadline:
+                time.sleep(0.01)  # wait until the stream actually holds the slot
+            assert streaming.kw.get("_slot") is not None
+            assert fleet.drain(victim, timeout_s=60.0)
+            assert streaming.result(60.0)["tokens"] == ref(self.PROMPT, self.NEW)
+            assert queued.result(60.0)["tokens"] == ref(self.QUEUED, self.NEW)
+            assert veng.drained()
+            # zero-leak bar, not "mostly freed": page accounting must
+            # balance exactly on the drained replica before it retires
+            assert_page_refs_consistent(veng)
+            fleet.retire(victim)
+            assert not reg.get(victim).in_ring
+            assert reg.get(victim).status == "DOWN"
+            # the surviving fleet is routable: same prompt, same tokens
+            assert (fleet.engine(peer).generate(
+                self.PROMPT, max_new_tokens=self.NEW, timeout=60.0)["tokens"]
+                == ref(self.PROMPT, self.NEW))
+        finally:
+            fleet.stop_all()
+
+    def test_draining_engine_sheds_new_arrivals_retryable(self, tiny):
+        cfg, params, _ = tiny
+        fleet, _ = _fleet(cfg, params)
+        try:
+            name = fleet.spawn()
+            eng = fleet.engine(name)
+            eng.begin_drain()
+            with pytest.raises(ServiceUnavailable):
+                eng.submit(self.PROMPT, max_new_tokens=2, timeout=30.0)
+            eng.abort_drain()
+            out = eng.generate(self.PROMPT, max_new_tokens=2, timeout=60.0)
+            assert len(out["tokens"]) == 2
+        finally:
+            fleet.stop_all()
+
+    def test_death_mid_drain_readmits_and_fleet_stays_routable(self, tiny):
+        from gofr_tpu.router.registry import ReplicaRegistry
+        from gofr_tpu.router.ring import HashRing
+
+        cfg, params, ref = tiny
+        reg = ReplicaRegistry(HashRing(), jitter_s=0.0)
+        fleet, _ = _fleet(cfg, params, registry=reg)
+        a = Autoscaler(fleet, AutoscalePolicy(min_replicas=1, max_replicas=3),
+                       signals=lambda: _sig(replicas=fleet.count()))
+        try:
+            fleet.spawn(), fleet.spawn()
+            victim = fleet.pick_victim()
+            with chaos.override("replica.drain:raise"):
+                assert a._scale_in() is None  # chaos fault → abort, no raise
+            # re-admitted: engine flag cleared, registry UP and in-ring,
+            # and the replica actually serves again
+            assert fleet.count() == 2
+            assert not fleet.engine(victim)._draining
+            assert reg.get(victim).in_ring
+            assert (fleet.engine(victim).generate(
+                self.PROMPT, max_new_tokens=self.NEW, timeout=60.0)["tokens"]
+                == ref(self.PROMPT, self.NEW))
+            # the control loop survived: a clean scale-in still works
+            assert a._scale_in() is not None
+            assert fleet.count() == 1
+        finally:
+            fleet.stop_all()
+
+    def test_burn_pressure_spawns_warm_spare(self, tiny):
+        """The elastic drill's scale-out half: drive real traffic past a
+        class's TTFT objective so the live SLO plane reports fast-window
+        burn, and verify the control loop turns that burn into a spawned
+        spare the fleet then serves from."""
+        from gofr_tpu.container import new_mock_container
+        from gofr_tpu.models import llama
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        cfg, params, _ = tiny
+        # an unmeetable TTFT objective + tiny min_samples: every request
+        # burns, so pressure is deterministic on any machine speed
+        cont = new_mock_container({
+            "SLO_INTERACTIVE_TTFT_MS": "0.001", "SLO_MIN_SAMPLES": "3",
+            "SLO_FAST_WINDOW_S": "60"})
+
+        def factory(name):
+            eng = GenerateEngine(llama, cfg, params, cont, slots=2,
+                                 max_len=64, kv_layout="paged", page_size=8,
+                                 prefill_buckets=[16])
+            eng.start()
+            return eng
+
+        fleet = LocalEngineFleet(factory)
+        policy = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                 burn_out=1.5, sustain_s=0.0,
+                                 cooldown_out_s=0.0)
+
+        def signals():
+            pr = cont.slo.pressure()
+            return FleetSignals(burn=pr["burn"], predicted_wait_s=0.0,
+                                replicas=fleet.count())
+
+        a = Autoscaler(fleet, policy, signals=signals)
+        try:
+            first = fleet.spawn()
+            for _ in range(4):
+                fleet.engine(first).generate([3, 7, 9], max_new_tokens=2,
+                                             timeout=60.0,
+                                             qos_class="interactive")
+            assert cont.slo.pressure()["burn"] >= policy.burn_out
+            assert a.step() == "out"
+            assert fleet.count() == 2
+            spare = [n for n in fleet.names() if n != first][0]
+            out = fleet.engine(spare).generate([3, 7, 9], max_new_tokens=2,
+                                               timeout=60.0)
+            assert len(out["tokens"]) == 2
+        finally:
+            fleet.stop_all()

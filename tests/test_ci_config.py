@@ -196,6 +196,45 @@ def test_resilience_suite_is_in_quick_tier():
     assert "cancel_mid_decode" in text and "DEADLINE_HEADER" in text
 
 
+def test_autoscaler_suite_is_in_quick_tier():
+    """ISSUE 11 satellite: the elastic-fleet units — ScaleDecider
+    hysteresis/cooldown/clamp on fake clocks, spawn-retry and drain-abort
+    chaos handling, registry draining transitions, zero-drop requeue —
+    are CPU-trivial and must ride the `-m quick` CI job on every push;
+    the real-engine drain drills stay in tier-1 (unmarked)."""
+    path = REPO / "tests" / "test_autoscaler.py"
+    assert path.exists(), "tests/test_autoscaler.py missing"
+    text = path.read_text()
+    assert "pytest.mark.quick" in text, "autoscaler units must be quick-marked"
+    assert "test_autoscaler.py" not in QUICK_EXEMPT, (
+        "test_autoscaler.py must not be exempted from the quick tier"
+    )
+    # the tentpole's pieces are all covered: decision math, chaos drills,
+    # draining membership, requeue, and the token-exact drain drill
+    assert "ScaleDecider" in text and "autoscale.spawn" in text
+    assert "replica.drain" in text and "draining" in text
+    assert "requeue" in text and "assert_page_refs_consistent" in text
+
+
+def test_ci_runs_the_diurnal_smoke():
+    """ISSUE 11 satellite: CI must run the trace-driven diurnal harness
+    (60s-compressed, autoscaler live) as an EXPLICIT CPU run and assert
+    the elastic-vs-static verdict lands in extra.autoscale — otherwise
+    the judging harness itself can rot between TPU bench rounds."""
+    ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+    smoke_runs = [
+        step.get("run", "")
+        for job in ci["jobs"].values()
+        for step in job.get("steps", [])
+        if "GOFR_BENCH_DIURNAL=1" in step.get("run", "")
+    ]
+    assert smoke_runs, "ci.yml has no job running the GOFR_BENCH_DIURNAL smoke"
+    joined = " ".join(smoke_runs)
+    # explicit CPU label (the fail-loud guard rejects silent fallbacks)
+    assert "GOFR_BENCH_PLATFORM=cpu" in joined
+    assert "bench.py" in joined
+
+
 def test_ci_has_py310_compat_gate():
     """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
     syntax (same-quote nested f-strings) passes every 3.12 job silently and

@@ -1,7 +1,9 @@
 """Elastic fleet subsystem (gofr_tpu/fleet; docs/parallelism.md):
 
-- quick tier: chaos-injection determinism, Supervisor restart policy, and
-  the fleet announce channel's frame/handshake/rejoin protocol — pure
+- quick tier: chaos-injection determinism, Supervisor restart policy
+  (including the sliding-window restart budget and FleetSupervisor's
+  fleet-wide generation monotonicity, on fake clocks/procs), and the
+  fleet announce channel's frame/handshake/rejoin protocol — pure
   host-side code, no jax;
 - process tier: 4 REAL processes (1 leader + 3 followers, each with a
   process-local dp:2,tp:2 mesh over 4 virtual CPU devices) serving
@@ -30,6 +32,7 @@ from gofr_tpu.fleet import (  # noqa: E402
     FleetFollowerChannel,
     FleetLeaderChannel,
     FleetProtocolError,
+    FleetSupervisor,
     Supervisor,
     chaos,
 )
@@ -155,6 +158,121 @@ class TestSupervisor:
         sup.stop()
         t.join(timeout=10)
         assert not t.is_alive()
+
+
+# -- supervisor restart-budget window (quick: fake clocks, fake procs) -----------
+
+
+class _FakeProc:
+    """Popen-shaped stand-in that has already exited with ``rc``."""
+
+    def __init__(self, rc: int):
+        self.returncode = rc
+
+    def poll(self):
+        return self.returncode
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+    def terminate(self):
+        pass
+
+    def kill(self):
+        pass
+
+
+@pytest.mark.quick
+class TestSupervisorWindow:
+    """The restart budget is a TRUE sliding window over crash timestamps
+    (a deque pruned to ``window_s``), not a reset-on-gap counter — the
+    give-up exists for crash loops, not lifetime fault totals."""
+
+    @staticmethod
+    def _drip(codes, gap_s, **kw):
+        t = {"now": 0.0}
+        seen: list = []
+
+        def spawn(gen):
+            t["now"] = gap_s * gen
+            seen.append(gen)
+            return _FakeProc(codes[gen])
+
+        sup = Supervisor(spawn, name="t", backoff_s=0.001,
+                         logger=MockLogger(), now=lambda: t["now"], **kw)
+        return sup, seen
+
+    def test_slow_drip_never_exhausts(self):
+        # isolated faults 250s apart against a 300s window: no single
+        # window ever holds more than 2 crashes, so a budget of 2 is never
+        # exhausted — the reset-on-gap counter this replaced accumulated
+        # them (each gap < window_s) and gave up on the 3rd drip fault
+        sup, seen = self._drip([1, 1, 1, 1, 0], 250.0,
+                               max_restarts=2, window_s=300.0)
+        assert sup.run() == 0
+        assert seen == [0, 1, 2, 3, 4]
+
+    def test_overlapping_windows_counted_exactly(self):
+        # crashes at t=0/100/200 overlap pairwise: a 300s window holds all
+        # three at once (crash loop — give up after the budgeted 2
+        # restarts), while a 150s window holds at most two (keep serving)
+        sup, seen = self._drip([1, 1, 1, 1, 0], 100.0,
+                               max_restarts=2, window_s=300.0)
+        assert sup.run() == 1
+        assert seen == [0, 1, 2]
+        sup, seen = self._drip([1, 1, 1, 1, 0], 100.0,
+                               max_restarts=2, window_s=150.0)
+        assert sup.run() == 0
+        assert seen == [0, 1, 2, 3, 4]
+
+    def test_restarts_attribute_tracks_window_occupancy(self):
+        sup, _ = self._drip([1, 1, 1, 0], 250.0,
+                            max_restarts=2, window_s=300.0)
+        assert sup.run() == 0
+        # last crash (t=500) shares its window only with t=250 — the
+        # exported restart count is window occupancy, not a lifetime total
+        assert sup.restarts == 2
+
+
+@pytest.mark.quick
+class TestFleetSupervisorGenerations:
+    def test_generations_monotonic_under_rapid_kill_rejoin(self):
+        """Rapid kill/rejoin across DIFFERENT members: every spawn —
+        initial or respawn — draws from ONE fleet-wide counter, so the
+        FLEET_EPOCH base derived from it is never reused and is strictly
+        increasing per member (the ring's bumped-epoch re-admission gate
+        stays sound across members)."""
+        import threading as _threading
+
+        lock = _threading.Lock()
+        seen: list[tuple[str, int]] = []
+        lives = {"a": 3, "b": 3}  # 2 crashes then a clean exit, each
+
+        def spawn_member(name, gen):
+            with lock:
+                seen.append((name, gen))
+                lives[name] -= 1
+                rc = 1 if lives[name] > 0 else 0
+            return _FakeProc(rc)
+
+        fs = FleetSupervisor(spawn_member, members=["a", "b"],
+                             max_restarts=10, backoff_s=0.001,
+                             logger=MockLogger())
+        threads = fs.start()
+        for t in threads.values():
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads.values())
+        assert len(seen) == 6
+        gens = [g for _, g in seen]
+        # unique and gapless from 1: no generation is ever reused, even
+        # with both members respawning concurrently
+        assert sorted(gens) == list(range(1, 7))
+        per: dict[str, list[int]] = {}
+        for name, g in seen:
+            per.setdefault(name, []).append(g)
+        for gs in per.values():
+            assert gs == sorted(gs)  # strictly increasing per member
+        assert fs.generation == 6
 
 
 # -- announce channel (quick) ----------------------------------------------------
