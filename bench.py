@@ -75,6 +75,19 @@ Env knobs:
     GOFR_BENCH_DIURNAL_REQUESTS  trace size (default max(24, 3x requests))
     GOFR_BENCH_DIURNAL_MAX    replica clamp for both arms (default 3)
     GOFR_BENCH_DIURNAL_SLOTS  decode slots per replica (default min(4, slots))
+    GOFR_BENCH_DISAGG         1 = also run the disaggregated prefill/decode
+                              A/B (ISSUE 12): resident decode streams are
+                              measured quiet and then under a concurrent
+                              prefill wave, once colocated (ENGINE_ROLE=
+                              both) and once role-split (prefill worker →
+                              paged-KV handoff over loopback TCP → decode
+                              worker); TTFT/TPOT percentiles, the TPOT-p99
+                              degradation ratio per arm, token-exactness
+                              across arms and the handoff transfer stats
+                              land in extra.disagg
+    GOFR_BENCH_DISAGG_RESIDENTS  resident decode streams per phase (default 4)
+    GOFR_BENCH_DISAGG_WAVE    concurrent prefill-wave size (default
+                              max(4, requests/2))
     GOFR_BENCH_ALLOW_CPU      1 = a TPU-probe CPU fallback stays a valid
                               (labelled) CPU run instead of failing loud
     GOFR_BENCH_PIPELINE       device pipeline depth (default 2; 1 = sync, up to 4)
@@ -1186,6 +1199,154 @@ def main() -> None:
             extra["autoscale"] = d_arms
         except Exception as e:  # noqa: BLE001
             extra["autoscale"] = f"error: {e}"[:160]
+
+    # disaggregated prefill/decode A/B (ISSUE 12): the interference
+    # question — how much does a concurrent prefill wave degrade RESIDENT
+    # decode streams? "colocated" serves both phases on one engine;
+    # "disagg" role-splits them: a prefill worker exports each prompt's
+    # paged KV over loopback TCP to a decode worker (tpu/handoff.py) that
+    # owns the token streams. Each arm measures resident TPOT twice —
+    # quiet, then under the wave — so the archived degradation ratio
+    # isolates interference from raw speed. NB: on the CPU fallback both
+    # "devices" share the host cores, so the disagg arm's isolation win is
+    # only meaningful on real accelerators; the CPU smoke checks structure
+    # (both arms archived, handoff stats present, token-exactness).
+    if os.environ.get("GOFR_BENCH_DISAGG") == "1":
+        import threading as _threading
+
+        from gofr_tpu.container import new_mock_container as _fresh_container
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        g_res = int(os.environ.get("GOFR_BENCH_DISAGG_RESIDENTS", "4"))
+        g_wave = int(os.environ.get("GOFR_BENCH_DISAGG_WAVE",
+                                    str(max(4, n_requests // 2))))
+        g_page = 8 if on_cpu else 128
+        g_plen = max(g_page, (prompt_len // g_page) * g_page)
+        g_new = max(8, max_new)
+
+        def _disagg_kw() -> dict:
+            kw = dict(engine_kw(*best))
+            pages_per_seq = (g_plen + g_new) // g_page + 2
+            kw.update(kv_layout="paged", page_size=g_page,
+                      total_pages=max(64, 2 * best[0] * pages_per_seq),
+                      max_len=g_plen + g_new + 8, prefill_buckets=[g_plen])
+            return kw
+
+        # two disjoint resident sets (quiet phase / wave phase — a reused
+        # prompt would be a device-tier prefix hit the second time) and the
+        # wave, identical across arms
+        g_sets = [[rng.randint(1, cfg.vocab_size, size=g_plen).tolist()
+                   for _ in range(g_res)] for _ in range(2)]
+        g_wave_prompts = [rng.randint(1, cfg.vocab_size, size=g_plen).tolist()
+                          for _ in range(g_wave)]
+
+        def _timed_results(reqs: list, t0s: list) -> list[dict]:
+            """Per-request completion times via one waiter thread each —
+            serial .result() gathering would timestamp request i with
+            request i-1's drain."""
+            out: list = [None] * len(reqs)
+
+            def _wait(i: int) -> None:
+                r = reqs[i].result(timeout)
+                out[i] = (r, time.monotonic() - t0s[i])
+
+            ths = [_threading.Thread(target=_wait, args=(i,))
+                   for i in range(len(reqs))]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout + 5)
+            if any(o is None for o in out):
+                raise RuntimeError("disagg bench: resident stream hung")
+            return [{"tokens": r["tokens"], "ttft_s": r["ttft_s"],
+                     "total_s": total} for r, total in out]
+
+        def _phase(decode_eng, wave_eng, residents: list,
+                   wave: bool) -> tuple[dict, list]:
+            t0s: list[float] = []
+            reqs = []
+            for p in residents:
+                t0s.append(time.monotonic())
+                reqs.append(decode_eng.submit(p, max_new_tokens=g_new,
+                                              timeout=timeout))
+            wave_reqs = []
+            tw0 = time.monotonic()
+            if wave:
+                # the wave lands while the residents are mid-stream; on the
+                # wave engine a prefill-role request completes at its first
+                # token (finish_reason=handoff), a colocated one decodes a
+                # 2-token stub so both arms' waves are prefill-dominated
+                wave_reqs = [wave_eng.submit(p, max_new_tokens=2,
+                                             timeout=timeout)
+                             for p in g_wave_prompts]
+            rs = _timed_results(reqs, t0s)
+            for r in wave_reqs:
+                r.result(timeout)
+            wave_s = time.monotonic() - tw0
+            tpots = [(r["total_s"] - r["ttft_s"]) / (len(r["tokens"]) - 1)
+                     for r in rs if len(r["tokens"]) > 1]
+            m = {"ttft_p50_s": round(_percentile([r["ttft_s"] for r in rs], 50), 4),
+                 "ttft_p99_s": round(_percentile([r["ttft_s"] for r in rs], 99), 4),
+                 "tpot_p50_s": round(_percentile(tpots, 50), 5),
+                 "tpot_p99_s": round(_percentile(tpots, 99), 5)}
+            if wave:
+                m["wave_requests"] = len(wave_reqs)
+                m["wave_elapsed_s"] = round(wave_s, 3)
+            return m, [r["tokens"] for r in rs]
+
+        def _run_disagg_arm(split: bool) -> tuple[dict, list]:
+            cont = _fresh_container()
+            kw = _disagg_kw()
+            if split:
+                dec = GenerateEngine(llama, cfg, params, cont,
+                                     role="decode", **kw)
+                pre = GenerateEngine(llama, cfg, params, _fresh_container(),
+                                     role="prefill",
+                                     handoff_target=dec.handoff_addr, **kw)
+                engines = [pre, dec]
+            else:
+                pre = dec = GenerateEngine(llama, cfg, params, cont, **kw)
+                engines = [dec]
+            try:
+                for e in engines:
+                    e.warmup()
+                    e.start()
+                if split:
+                    # stage both resident sets through the prefill worker:
+                    # their KV chains land on the decode side as host-tier
+                    # prefix nodes, which is what makes the decode-side
+                    # resident submissions decode-only work
+                    for p in g_sets[0] + g_sets[1]:
+                        r = pre.generate(p, max_new_tokens=2, timeout=timeout)
+                        if r.get("finish_reason") != "handoff":
+                            raise RuntimeError(
+                                f"prefill worker decoded locally: {r.get('finish_reason')}")
+                quiet, toks = _phase(dec, pre, g_sets[0], wave=False)
+                loaded, _ = _phase(dec, pre, g_sets[1], wave=True)
+                arm = {"quiet": quiet, "wave": loaded,
+                       "tpot_p99_degradation": round(
+                           loaded["tpot_p99_s"] / max(quiet["tpot_p99_s"], 1e-9), 3)}
+                if split:
+                    arm["handoff"] = {"export": pre.handoff_stats().get("export"),
+                                      "import": dec.handoff_stats().get("import")}
+                return arm, toks
+            finally:
+                for e in engines:
+                    e.stop()
+
+        try:
+            disagg: dict = {"residents": g_res, "prompt_len": g_plen,
+                            "max_new": g_new, "page_size": g_page}
+            colo_arm, colo_toks = _run_disagg_arm(False)
+            split_arm, split_toks = _run_disagg_arm(True)
+            disagg["colocated"] = colo_arm
+            disagg["disagg"] = split_arm
+            # same seeded prompts, same params: the role-split pipeline must
+            # reproduce the colocated streams token for token
+            disagg["token_exact"] = bool(colo_toks == split_toks)
+            extra["disagg"] = disagg
+        except Exception as e:  # noqa: BLE001
+            extra["disagg"] = f"error: {e}"[:160]
 
     # NB: on the CPU fallback the "device" compute runs on the same host
     # cores as the packing/readback, so overlap has nothing to hide behind

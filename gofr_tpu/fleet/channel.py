@@ -51,6 +51,14 @@ _MAGIC = b"GOFR-FLEET1\n"
 _HEADER = struct.Struct("<4i")
 _NBYTES = struct.Struct("<i")
 
+# Frame-size sanity cap, both directions. A corrupt or hostile length
+# prefix would otherwise make _recv_exact allocate the advertised bytes
+# outright (silent multi-GB OOM); an oversized send is a caller bug that
+# must fail loudly, not wedge every follower mid-frame. Generous: the
+# largest legitimate frames are multi-MB KV-page payloads (tpu/handoff.py
+# rides the same framing), far below 256 MiB.
+MAX_FRAME_BYTES = 256 << 20
+
 
 class ChannelClosed(Exception):
     """The peer went away mid-stream (EOF, reset, or local abort). For
@@ -211,18 +219,30 @@ class FleetLeaderChannel:
         """Fan one frame out to every active follower. A failing follower
         is dropped (counted + logged) and serving continues — its
         supervisor restarts it into the pending set."""
-        data = _HEADER.pack(*(int(x) for x in header))
+        head = _HEADER.pack(*(int(x) for x in header))
         if payload is None:
-            data += _NBYTES.pack(0)
+            head += _NBYTES.pack(0)
+            body = None
         else:
-            raw = np.ascontiguousarray(payload, np.int32).tobytes()
-            data += _NBYTES.pack(len(raw)) + raw
+            # zero-copy payload path: the header+length go out as one small
+            # bytes object, the payload as a memoryview over the (already
+            # contiguous) array — multi-MB KV-page frames no longer pay a
+            # tobytes() copy plus a second header+payload concat copy
+            arr = np.ascontiguousarray(payload, np.int32)
+            if arr.nbytes > MAX_FRAME_BYTES:
+                raise FleetProtocolError(
+                    f"fleet: refusing to send a {arr.nbytes}-byte frame "
+                    f"(cap {MAX_FRAME_BYTES}); payload shape {arr.shape}")
+            head += _NBYTES.pack(arr.nbytes)
+            body = memoryview(arr).cast("B")
         with self._lock:
             conns = list(self._active)
         lost = []
         for conn in conns:
             try:
-                conn.sendall(data)
+                conn.sendall(head)
+                if body is not None:
+                    conn.sendall(body)
             except OSError as e:
                 lost.append(conn)
                 if self.logger is not None:
@@ -343,6 +363,10 @@ class FleetFollowerChannel:
                 "fleet: leader rejected this follower (engine config "
                 "fingerprint mismatch — rebuild with the leader's config)")
         (self._pending_nbytes,) = _NBYTES.unpack(_recv_exact(sock, _NBYTES.size))
+        if not 0 <= self._pending_nbytes <= MAX_FRAME_BYTES:
+            raise FleetProtocolError(
+                f"fleet: frame advertises {self._pending_nbytes} payload "
+                f"bytes (cap {MAX_FRAME_BYTES}) — corrupt stream")
         return header
 
     def recv_payload(self, shape: tuple[int, ...]) -> np.ndarray:

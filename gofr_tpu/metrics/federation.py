@@ -300,6 +300,11 @@ def _state_lines(digests: Mapping[str, Mapping[str, Any]],
             st = states[replica]
             up = 1 if str(st.get("status", "")).upper() == "UP" else 0
             ls: LabelSet = (("replica", replica),)
+            role = str(st.get("role", "") or "")
+            if role and role != "both":
+                # role label only for a role-split member (disaggregated
+                # serving): colocated fleets keep the exact pre-role series
+                ls = (("replica", replica), ("role", role))
             lines.append(f"app_fleet_replica_up{_fmt_labels(ls)} {up}")
         lines.append("# TYPE app_fleet_replica_epoch gauge")
         for replica in sorted(states):

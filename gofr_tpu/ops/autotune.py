@@ -105,8 +105,15 @@ def shape_key(*dims: int) -> str:
     return "x".join(str(int(d)) for d in dims)
 
 
-def entry_key(device_kind: str, op: str, shape: str, kv_dtype: str) -> str:
-    return "|".join((str(device_kind), op, shape, str(kv_dtype)))
+def entry_key(device_kind: str, op: str, shape: str, kv_dtype: str,
+              role: str = "") -> str:
+    """Cache key. ``role`` (ENGINE_ROLE, disaggregated serving) is appended
+    only when it narrows the decision — ``""``/``"both"`` keep the exact
+    pre-role key so existing cache files stay valid."""
+    key = "|".join((str(device_kind), op, shape, str(kv_dtype)))
+    if role and role != "both":
+        key += f"|role={role}"
+    return key
 
 
 def set_last_report(report: dict[str, Any] | None) -> None:
@@ -165,11 +172,15 @@ class Autotuner:
 
     def __init__(self, device_kind: str = "cpu", cache_file: str | None = None,
                  timer: Callable[[Callable[[], Any]], float] | None = None,
-                 logger: Any = None):
+                 logger: Any = None, role: str = ""):
         self.device_kind = device_kind
         self.cache_file = cache_file
         self.timer = timer or _default_timer
         self.logger = logger
+        # role-scoped keys (disaggregation): a decode-role spare's pins live
+        # under their own cache keys, so its warmup neither waits on nor
+        # clobbers a colocated engine's measurements for the same shapes
+        self.role = role if role not in ("", "both") else ""
         self.decisions: dict[str, dict] = {}  # op -> decision record
         self._cache = _load_cache(cache_file, logger)  # lookups only
         self._own: dict[str, dict] = {}  # keys THIS tuner decided (persisted)
@@ -181,7 +192,7 @@ class Autotuner:
         fallback path costs zero device work). A candidate that raises
         (e.g. Mosaic rejects the shape) loses by disqualification; if every
         candidate fails, 'xla' — the everywhere-correct path — is pinned."""
-        key = entry_key(self.device_kind, op, shape, kv_dtype)
+        key = entry_key(self.device_kind, op, shape, kv_dtype, self.role)
         cached = self._cache.get(key)
         if cached is not None and cached.get("backend") in candidates:
             rec = {"backend": cached["backend"], "shape": shape, "kv_dtype": kv_dtype,
@@ -240,7 +251,11 @@ class Autotuner:
         return {op: rec["backend"] for op, rec in self.decisions.items()}
 
     def report(self) -> dict[str, Any]:
-        return {"device_kind": self.device_kind, "decisions": dict(self.decisions)}
+        out: dict[str, Any] = {"device_kind": self.device_kind,
+                               "decisions": dict(self.decisions)}
+        if self.role:
+            out["role"] = self.role
+        return out
 
 
 __all__ = [

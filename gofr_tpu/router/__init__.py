@@ -215,7 +215,8 @@ class Router:
 
     # -- decision plane --------------------------------------------------------
 
-    def plan(self, key: int, cls_name: str | None = None) -> RoutePlan:
+    def plan(self, key: int, cls_name: str | None = None,
+             stage: str = "any") -> RoutePlan:
         qos_class = self.qos_policy.resolve(cls_name).name
         spillable = qos_class in self.policy.spill_classes
         self.registry.sweep()
@@ -233,6 +234,23 @@ class Router:
             # +2 slack absorbs shedding-filtered candidates without paying
             # a full vnode walk under the ring lock per admission
             live = self.ring.lookup(key, n=1 + self.policy.max_spill + 2)
+        # role-aware planning (disaggregated serving, docs/routing.md):
+        # when the fleet is role-split, a stage-specific plan only
+        # considers replicas whose ENGINE_ROLE serves the stage —
+        # admissions land on the prefill pool, token streams on the
+        # decode pool. With no eligible member the filter stands down
+        # (colocated fallback) rather than shed a servable request.
+        if stage != "any" and self._role_split():
+            eligible = [n for n in live
+                        if self._stage_ok(self.registry.get(n), stage)]
+            if eligible:
+                live = eligible
+                if home is None or not self._stage_ok(
+                        self.registry.get(home), stage):
+                    # the stage pool's first ring candidate is the
+                    # effective home: deterministic per key, so affinity
+                    # inside the pool still compounds warm state
+                    home = eligible[0]
         home_r = self.registry.get(home) if home else None
         home_live = home_r is not None and home_r.in_ring and not home_r.shedding
         if home_live and self.policy.mode == "affinity":
@@ -270,6 +288,20 @@ class Router:
         return RoutePlan(key, qos_class, spillable, home, [],
                          shed=(reason, retry_after))
 
+    def _role_split(self) -> bool:
+        """Is any known replica running a split ENGINE_ROLE? Colocated
+        fleets answer False, keeping plan() byte-identical to pre-role."""
+        return any(getattr(r, "role", "both") not in ("", "both")
+                   for r in self.registry.replicas().values())
+
+    @staticmethod
+    def _stage_ok(r: Replica | None, stage: str) -> bool:
+        """Does the replica's role serve the stage? ``both`` serves all."""
+        if r is None:
+            return False
+        role = getattr(r, "role", "both") or "both"
+        return role == "both" or role == stage
+
     @staticmethod
     def _home_reason(home_r: Replica | None) -> str | None:
         """Why a request could not go to its home replica (None = it can)."""
@@ -298,7 +330,15 @@ class Router:
         req = ctx.request
         cls_name = ctx.header(self.qos_policy.class_header)
         key = self.request_key(req)
-        p = self.plan(key, cls_name)
+        # stage from the route shape (disaggregated serving): SSE streams
+        # read tokens off the decode pool, buffered admissions land on the
+        # prefill pool (whose handoff ships the KV to decode). Colocated
+        # fleets ignore the stage entirely (_role_split is False).
+        path_only = (req.path or b"/")
+        if isinstance(path_only, bytes):
+            path_only = path_only.decode("utf-8", "replace")
+        stage = "decode" if path_only.rstrip("/").endswith("/stream") else "prefill"
+        p = self.plan(key, cls_name, stage=stage)
         m = self.container.metrics
         m.increment_counter("app_router_requests_total", 1, qos_class=p.qos_class)
         self.budget.note_request()  # originals fund the retry/hedge budget
@@ -672,7 +712,8 @@ class Router:
         from gofr_tpu.metrics import federation
 
         self.registry.sweep()
-        states = {name: {"status": r.status, "epoch": r.epoch}
+        states = {name: {"status": r.status, "epoch": r.epoch,
+                         "role": getattr(r, "role", "both")}
                   for name, r in self.registry.replicas().items()}
         return federation.fleet_text(self.digests(), states)
 
@@ -705,6 +746,10 @@ class Router:
                 d["decisions"] = counts
                 d["affinity_hit_ratio"] = (
                     round(counts["home"] / sent, 4) if sent else None)
+            if isinstance(r.handoff, dict):
+                # role-split member: KV-handoff transfer counters ride the
+                # gossip (disaggregated serving, docs/serving.md)
+                d["handoff"] = r.handoff
             replicas.append(d)
         return {
             "replicas": replicas,

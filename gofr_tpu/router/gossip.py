@@ -18,6 +18,9 @@ Snapshot schema (one JSON object per message):
     draining    any engine in its scale-in drain (fleet/autoscaler.py):
                 the registry moves the replica's keys to ring successors
     shedding    QoS shed within its window (AdmissionController.shedding)
+    role        ENGINE_ROLE when role-split (disaggregated serving); the
+                key is absent for colocated ("both") members
+    handoff_addr decode-role KV handoff listener (host:port), role-split only
     retry_after backoff hint (s) for router-side sheds while unavailable
     seq, ts     per-reporter sequence + wall clock (debug only)
     digest      compact metrics/SLO digest (metrics/federation.py) for the
@@ -64,7 +67,17 @@ class GossipReporter:
         restarting = False
         draining = False
         epoch = 0
+        role = "both"
+        handoff_addr = ""
         for engine in self.container.engines.values():
+            er = str(getattr(engine, "role", "both") or "both")
+            if er != "both":
+                # role-split member (disaggregated serving): the router's
+                # registry needs the role for stage-aware planning, and the
+                # decode side's handoff listener for operator visibility
+                role = er
+                handoff_addr = handoff_addr or str(
+                    getattr(engine, "handoff_addr", "") or "")
             try:
                 h = (engine.health_check()
                      if hasattr(engine, "health_check") else {"status": "UP"})
@@ -88,6 +101,19 @@ class GossipReporter:
             "retry_after": self.retry_after_s, "seq": self._seq,
             "ts": time.time(),
         }
+        if role != "both":
+            # only role-split members carry the keys — a colocated fleet's
+            # gossip schema stays byte-identical to the pre-role wire format
+            snap["role"] = role
+            if handoff_addr:
+                snap["handoff_addr"] = handoff_addr
+            try:
+                for engine in self.container.engines.values():
+                    if hasattr(engine, "handoff_stats"):
+                        snap["handoff"] = engine.handoff_stats()
+                        break
+            except Exception:  # noqa: BLE001 - liveness outranks the stats
+                pass
         if self.digest_every > 0 and self._seq % self.digest_every == 0:
             try:
                 from gofr_tpu.metrics import federation

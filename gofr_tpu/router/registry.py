@@ -50,6 +50,8 @@ class Replica:
     shedding: bool = False
     restarting: bool = False
     draining: bool = False         # scale-in drain in progress (autoscaler)
+    role: str = "both"             # ENGINE_ROLE (disaggregated serving)
+    handoff_addr: str = ""         # decode-role KV handoff listener (host:port)
     retry_after: float = 0.0       # replica-suggested backoff hint (s)
     static: bool = False           # seeded by config, exempt from gossip TTL
     last_seen: float = 0.0
@@ -63,15 +65,23 @@ class Replica:
     # schema); kept across digest-less publishes so a throttled
     # ROUTER_GOSSIP_DIGEST_EVERY still leaves the fleet views populated
     digest: dict[str, Any] | None = field(default=None, repr=False)
+    # last KV-handoff transfer counters (role-split members only; engine
+    # handoff_stats schema) — surfaced in the router's /debug/fleet view
+    handoff: dict[str, Any] | None = field(default=None, repr=False)
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "name": self.name, "url": self.url, "status": self.status,
             "epoch": self.epoch, "shedding": self.shedding,
             "restarting": self.restarting, "draining": self.draining,
             "in_ring": self.in_ring,
             "drop_reason": self.drop_reason or None,
         }
+        if self.role != "both":  # role-split member (disaggregated serving)
+            out["role"] = self.role
+            if self.handoff_addr:
+                out["handoff_addr"] = self.handoff_addr
+        return out
 
 
 class ReplicaRegistry:
@@ -131,6 +141,8 @@ class ReplicaRegistry:
             r.shedding = bool(msg.get("shedding"))
             r.restarting = bool(msg.get("restarting"))
             r.draining = bool(msg.get("draining"))
+            r.role = str(msg.get("role") or "both")
+            r.handoff_addr = str(msg.get("handoff_addr") or "")
             try:
                 r.retry_after = float(msg.get("retry_after") or 0.0)
             except (TypeError, ValueError):
@@ -138,6 +150,9 @@ class ReplicaRegistry:
             dig = msg.get("digest")
             if isinstance(dig, dict):
                 r.digest = dig
+            ho = msg.get("handoff")
+            if isinstance(ho, dict):
+                r.handoff = ho
             r.last_seen = self._now()
             if r.in_ring and r.status == "UP" and not r.restarting and not r.draining:
                 # the epoch-gate base: the engine bumps its restart counter

@@ -62,7 +62,8 @@ from gofr_tpu.tpu.lockstep import TAG_CHUNK, TAG_DECODE, TAG_PREFILL, TAG_SPEC
 from gofr_tpu.native import plan_prefill
 from gofr_tpu.models.base import ModelSpec, get_family
 from gofr_tpu.parallel import shard_pytree
-from gofr_tpu.tpu.decode import (
+from gofr_tpu.tpu import executor
+from gofr_tpu.tpu.executor import (
     dispatch_decode,
     dispatch_spec,
     process_decode,
@@ -848,6 +849,10 @@ class GenerateEngine(_EngineBase):
         fleet: Any = None,
         spec_draft: tuple | None = None,
         pipeline_depth: int | None = None,
+        role: str = "both",
+        handoff_target: str | None = None,
+        handoff_listen: str | None = None,
+        handoff_timeout_s: float = 5.0,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -993,6 +998,26 @@ class GenerateEngine(_EngineBase):
             raise ValueError(f"model family {family.__name__} has no paged-cache support")
         self.kv_layout = kv_layout
 
+        # Engine role (disaggregated serving; tpu/handoff.py): "both"
+        # keeps today's colocated behavior bit-for-bit; "prefill" exports
+        # each prompt's full KV pages to the decode pool after prefill
+        # instead of decoding locally; "decode" imports handed-off pages
+        # as host-tier prefix nodes and serves the decode phase. Role
+        # workers need the paged layout — the handoff payload IS pool
+        # pages — and cannot combine with lockstep (followers could
+        # never replay a transfer that arrived over a side channel).
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"ENGINE_ROLE {role!r}: use 'both', 'prefill' or 'decode'")
+        if role != "both" and kv_layout != "paged":
+            raise ValueError(
+                f"ENGINE_ROLE={role} needs kv_layout='paged' "
+                "(the KV handoff ships pool pages)")
+        if role != "both" and lockstep_role:
+            raise ValueError(
+                "ENGINE_ROLE prefill/decode cannot combine with lockstep")
+        self.role = role
+
         if kv_quantize and kv_quantize != "int8":
             raise ValueError(f"kv_quantize={kv_quantize!r}: only 'int8' is supported")
         if kv_layout == "paged":
@@ -1070,8 +1095,20 @@ class GenerateEngine(_EngineBase):
                     f"footprint ({self._page_bytes} bytes); host tier disabled"
                 )
                 host_budget = 0
+            if role == "decode" and prefix_cache and not host_budget:
+                # a decode worker IMPORTS handed-off pages as host-tier
+                # nodes — without a budget every transfer would be dropped
+                # at the door. Default a working buffer (the budget is a
+                # cap, not an allocation); ENGINE_PREFIX_HOST_MB overrides.
+                host_budget = max(self._page_bytes, 256 << 20)
             self._prefix = (PrefixCache(page_size, host_budget_bytes=host_budget)
                             if prefix_cache else None)
+            if role == "decode" and (self._prefix is None
+                                     or not self._prefix.host_budget):
+                raise ValueError(
+                    "ENGINE_ROLE=decode needs the prefix cache with a host "
+                    "tier (the handoff import target); keep "
+                    "ENGINE_PREFIX_CACHE on")
             self._cache_treedef = jax.tree.structure(self.cache)
             # swap-in upload widths: a power-of-two bucket ladder like the
             # prefill buckets — one compiled upload program per bucket, and
@@ -1083,13 +1120,16 @@ class GenerateEngine(_EngineBase):
             # (both device-thread only)
             self._pending_swapins: list = []
             self._pending_spills: list = []
-            if self._prefix is not None and self._prefix.host_budget:
+            if self._prefix is not None and (self._prefix.host_budget
+                                             or role == "prefill"):
                 # compile the spill gather EAGERLY: it is the one program
                 # dispatched while the state lock is held (_evict_prefix_
-                # page), and warmup() is optional — a first-spill JIT
-                # compile under the lock would stall submit()/stop() for
-                # the compile duration. The swap-in upload programs compile
-                # in warmup() or lazily at dispatch, which runs unlocked.
+                # page — and the prefill-role handoff export, which
+                # gathers every exported page the same way), and warmup()
+                # is optional — a first-spill JIT compile under the lock
+                # would stall submit()/stop() for the compile duration.
+                # The swap-in upload programs compile in warmup() or
+                # lazily at dispatch, which runs unlocked.
                 from gofr_tpu.ops.paged import gather_page
 
                 jax.block_until_ready(
@@ -1238,6 +1278,38 @@ class GenerateEngine(_EngineBase):
             else:
                 self._ls = LockstepLeader()
 
+        # -- disaggregation handoff plumbing (tpu/handoff.py) ----------------
+        # decode role: listen for KV frames from prefill workers; prefill
+        # role: export to HANDOFF_TARGET (without a target the worker
+        # decodes locally — the colocated fallback keeps it correct while
+        # the decode pool is still coming up). handoff_addr rides the
+        # gossip snapshot so the router's fleet view can show the wiring.
+        self.handoff_timeout_s = float(handoff_timeout_s)
+        self._handoff_exporter = None
+        self._handoff_server = None
+        self.handoff_addr = ""
+        if self.role == "decode":
+            from gofr_tpu.tpu.handoff import HandoffServer
+
+            self._handoff_server = HandoffServer(
+                self, handoff_listen or "127.0.0.1:0",
+                logger=self.logger, metrics=self.metrics)
+            self.handoff_addr = self._handoff_server.addr
+            self.logger.infof("kv handoff import listening at %s",
+                              self.handoff_addr)
+        elif self.role == "prefill":
+            if handoff_target:
+                from gofr_tpu.tpu.handoff import HandoffExporter
+
+                self._handoff_exporter = HandoffExporter(
+                    handoff_target, engine=self,
+                    timeout_s=self.handoff_timeout_s,
+                    logger=self.logger, metrics=self.metrics)
+            else:
+                self.logger.warn(
+                    "ENGINE_ROLE=prefill without HANDOFF_TARGET: prompts "
+                    "decode locally (colocated fallback)")
+
     # -- public API ------------------------------------------------------------
 
     def warmup(self, len_buckets: list[int] | None = None,
@@ -1266,105 +1338,11 @@ class GenerateEngine(_EngineBase):
                 return self._warmup_traced(lbs, bbs)
 
     def _warmup_traced(self, lbs: list[int], bbs: list[int]) -> int:
-        count = 0
-        w = self.pages_per_slot if self.kv_layout == "paged" else 1
-        oob = self.total_pages if self.kv_layout == "paged" else self.num_slots
-        for lb in lbs:
-            for nb in bbs:
-                packed = np.zeros((nb, lb + w + 3), np.int32)
-                packed[:, lb] = 1  # lengths
-                packed[:, lb + 1:lb + 1 + w] = oob  # all-OOB rows: writes dropped
-                self._announce(TAG_PREFILL, lb, nb, packed)
-                toks, self.cache = self._prefill_sample(
-                    self.params, self._base_key, self.cache, jnp.asarray(packed)
-                )
-                jax.block_until_ready(toks)
-                self._compiled.add(("prefill", lb, nb))
-                count += 1
-        if self._chunked_ok:
-            # chunked-prefill programs (batch 1, one per len bucket). OOB
-            # rows — block-table entries (paged) or the slot id (slot) —
-            # drop their writes, so a warmup never touches live cache state.
-            for lb in lbs:
-                packed = np.zeros((1, lb + w + 4), np.int32)
-                packed[0, lb] = 1
-                packed[0, lb + 1:lb + 1 + w] = oob
-                self._announce(TAG_CHUNK, lb, 1, packed)
-                toks, self.cache = self._chunk_prefill(
-                    self.params, self._base_key, self.cache, jnp.asarray(packed)
-                )
-                jax.block_until_ready(toks)
-                self._compiled.add(("prefill_chunk", lb, 1))
-                count += 1
-        n, k = self.num_slots, self.decode_chunk
-        wt = self.pages_per_slot if self.kv_layout == "paged" else 0
-        packed = np.zeros((5 + wt, n), np.int32)
-        if self.kv_layout == "paged":
-            packed[5:] = self.total_pages  # OOB table: writes dropped
-        else:
-            packed[1, :] = self._cache_len  # OOB positions: writes dropped
-        if not self.spec_tokens:
-            # spec mode never calls decode.dispatch_decode — don't compile
-            # the (expensive) plain decode program it would throw away
-            self._announce(TAG_DECODE, 0, 0, packed)  # a=0: warmup, no carry
-            out, _, self.cache = self._decode_chunk(
-                self.params, self._base_key, self.cache, k, jnp.asarray(packed),
-                jnp.zeros((n,), jnp.int32),
-            )
-            jax.block_until_ready(out)
-            self._compiled.add(("decode", n, k))
-            count += 1
-        if self.spec_tokens:
-            if self.kv_layout == "paged":
-                sw, sh = self.pages_per_slot, self.pages_per_slot * self.page_size
-                spec_packed = np.zeros((4 + sw + sh, n), np.int32)
-                spec_packed[1, :] = sh + 1  # all lanes OOB
-                spec_packed[4:4 + sw] = self.total_pages  # all-OOB tables
-                self._announce(TAG_SPEC, 4 + sw + sh, 0, spec_packed)
-                toks, _, self.cache = self._spec_chunk_fn(
-                    self.params, self._base_key, self.cache, k,
-                    jnp.asarray(spec_packed))
-            else:
-                # slot layout: all lanes host-arbitrated and OOB, so no
-                # cache/history write survives. Announced with a=0 (warmup,
-                # mirroring the TAG_DECODE convention): both sides feed a
-                # zeros carry and DISCARD the output carry, so leader and
-                # followers stay carry-identical without relying on a
-                # warmup-produced value (ADVICE r5).
-                spec_packed = np.zeros((5, n), np.int32)
-                spec_packed[1, :] = self._cache_len + 1
-                spec_packed[2, :] = 1
-                self._announce(TAG_SPEC, 0, 0, spec_packed)
-                carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
-                toks, _, self.cache, _warm_carry = self._spec_chunk_fn(
-                    self.params, self._base_key, self.cache, k,
-                    jnp.asarray(spec_packed), carry)
-                del _warm_carry  # never stored: _loop starts from None
-            jax.block_until_ready(toks)
-            self._compiled.add(("decode_spec", n, k, self.spec_tokens))
-            count += 1
-        if (self.kv_layout == "paged" and self._prefix is not None
-                and self._prefix.host_budget):
-            # host-tier spill/swap-in programs: a first spill or swap-in
-            # mid-serving would otherwise pay its XLA compile inside the
-            # latency window the tier exists to shrink. The swap-in warmup
-            # uses an all-OOB id vector, so every upload write is dropped.
-            from gofr_tpu.ops.paged import gather_page, swap_in_pages
-
-            jax.block_until_ready(
-                jax.tree.leaves(gather_page(self.cache, jnp.int32(0)))[0])
-            count += 1
-            for wb in self._swapin_buckets:
-                ids = np.full((wb,), self.total_pages, np.int32)
-                payload = jax.tree.unflatten(self._cache_treedef, [
-                    np.zeros((leaf.shape[0], wb) + tuple(leaf.shape[2:]), leaf.dtype)
-                    for leaf in jax.tree.leaves(self.cache)])
-                self.cache, marker = swap_in_pages(
-                    self.cache, jnp.asarray(ids), payload)
-                jax.block_until_ready(marker)
-                self._compiled.add(("swapin", wb))
-                count += 1
-        return count
+        # the compile body lives in the executor layer (tpu/executor.py,
+        # warmup_compile) and is ROLE-scoped there: a prefill worker
+        # skips the decode/spec compiles, a decode worker skips the
+        # batched-prefill ladder — most of a role spare's warmup win
+        return executor.warmup_compile(self, lbs, bbs)
 
     def _autotune_backends(self) -> None:
         """Measure Pallas vs XLA for this engine's decode attention op on
@@ -1381,6 +1359,13 @@ class GenerateEngine(_EngineBase):
 
         if self.lockstep_role or self._autotune_pins or not autotune.enabled():
             return
+        if self.role == "prefill":
+            # every op the tuner races is decode attention; a prefill-role
+            # worker never traces one. Pins stay role-scoped regardless via
+            # autotune.entry_key(..., role), so a colocated engine's cache
+            # entries are untouched either way.
+            self._autotune = {"skipped": "prefill role: no decode ops to tune"}
+            return
         from gofr_tpu.ops import attention as attn_ops
         from gofr_tpu.ops.pallas import kernel_platform
 
@@ -1396,7 +1381,7 @@ class GenerateEngine(_EngineBase):
                 else None) or getattr(self.tpu, "platform", "cpu")
         tuner = autotune.Autotuner(
             device_kind=str(kind), cache_file=autotune.cache_path(),
-            timer=self._autotune_timer, logger=self.logger)
+            timer=self._autotune_timer, logger=self.logger, role=self.role)
         pallas_ok = kernel_platform()
         t0 = time.monotonic()
         n = self.num_slots
@@ -1550,6 +1535,10 @@ class GenerateEngine(_EngineBase):
 
     def stop(self) -> None:
         super().stop()
+        if self._handoff_exporter is not None:
+            self._handoff_exporter.close()
+        if self._handoff_server is not None:
+            self._handoff_server.close()
         if self._ls is not None and not self._poisoned:
             # after a CLEAN device-thread join no concurrent collective can
             # interleave with the terminal broadcast. A wedged thread may
@@ -2196,8 +2185,85 @@ class GenerateEngine(_EngineBase):
         s.first_token_at = now
         self._lane_to_decode(idx)
         self._prefix_insert(idx)
+        if self.role == "prefill" and self._export_handoff(idx, s, tok, now):
+            return
         self._emit(s, tok)
         self._maybe_finish(idx)
+
+    def _export_handoff(self, idx: int, s: _Slot, tok: int, now: float) -> bool:
+        """Prefill-role terminal: ship the slot's full KV pages to the decode
+        pool and complete the request with just its first token
+        (finish_reason="handoff"). Returns False → colocated fallback (no
+        exporter wired, unpaged prompt shorter than one page, lane state
+        already torn down).
+
+        The pages survive `_free_slot` because `_prefix_insert` one line
+        earlier retained them in the prefix cache; the per-page gathers are
+        dispatched HERE, under the state lock, so they capture the cache
+        value before any later step can recycle a page (the
+        `_evict_prefix_page` discipline — JAX's functional updates make the
+        gathered payload immune to subsequent pool writes)."""
+        exp = self._handoff_exporter
+        if exp is None or self._prefix is None:
+            return False
+        n_full = s.prompt_len // self.page_size
+        if n_full == 0 or len(self._slot_pages[idx]) < n_full:
+            return False
+        pages = self._slot_pages[idx][:n_full]
+        payloads = executor.gather_pages(self, pages)
+        rt = s.request.kw.get("_rt")
+        if rt is not None:
+            rt.end("engine.decode")
+            rt.begin("engine.handoff", **{"pages": n_full})
+        self._free_slot(idx)
+        from gofr_tpu.tpu.handoff import HandoffJob
+
+        exp.submit(HandoffJob(
+            request=s.request, prompt_tokens=np.asarray(s.prompt_tokens),
+            first_token=tok, payloads=payloads,
+            nbytes_page=self._page_bytes, t0=now))
+        return True
+
+    def handoff_import(self, toks, payloads, nbytes_page: int) -> int:
+        """Decode-role ingest (called from the HandoffServer thread): park
+        the shipped pages as HOST-tier prefix nodes for `toks`' chain. The
+        next admission of that prompt claims them through `_usable_hit` and
+        re-uploads via the ordinary swap-in path, so the upload overlaps
+        live decode on the `_dq` exactly like any other host-tier hit.
+        Returns the number of chain positions newly registered."""
+        if self.kv_layout != "paged" or self._prefix is None:
+            raise ValueError("handoff import needs the paged prefix cache")
+        if not self._prefix.host_budget:
+            raise ValueError("handoff import needs a host-tier budget")
+        want = [((leaf.shape[0],) + tuple(leaf.shape[2:]), leaf.dtype)
+                for leaf in jax.tree.leaves(self.cache)]
+        for planes in payloads:
+            if len(planes) != len(want):
+                raise ValueError(
+                    f"handoff page has {len(planes)} planes, pool has {len(want)}")
+            for plane, (shape, dtype) in zip(planes, want):
+                if tuple(plane.shape) != shape or plane.dtype != dtype:
+                    raise ValueError(
+                        f"handoff plane {plane.dtype}{tuple(plane.shape)} != "
+                        f"pool {dtype}{shape}")
+        with self._state_lock:
+            # the engine's OWN page-byte size, not the wire value: both sides
+            # must agree on geometry for the planes to validate above, and
+            # budget accounting must match this pool's arithmetic
+            added = self._prefix.insert_host(
+                np.asarray(toks), payloads, self._page_bytes)
+            self._set_prefix_gauges()
+        return added
+
+    def handoff_stats(self) -> dict:
+        """Role + transfer counters for /debug/fleet."""
+        out: dict[str, Any] = {"role": self.role}
+        if self._handoff_exporter is not None:
+            out["export"] = self._handoff_exporter.stats()
+        if self._handoff_server is not None:
+            out["import"] = self._handoff_server.stats()
+            out["addr"] = self.handoff_addr
+        return out
 
     def _loop(self) -> None:
         self._dq.clear()  # a restarted loop must not read a dead life's futures
@@ -2376,26 +2442,10 @@ class GenerateEngine(_EngineBase):
             temp = float(s.request.kw.get("temperature", 0.0))
             t0 = time.monotonic()
 
-        # pure-numpy packing OUTSIDE the state lock: everything below is
+        # device dispatch OUTSIDE the state lock: everything in the plan is
         # immutable (prompt_tokens) or snapshotted above (table row, step)
-        w = self.pages_per_slot if self.kv_layout == "paged" else 1
-        packed = self._staging("chunk", (1, lb + w + 4))
-        packed[0, :chunk] = s.prompt_tokens[offset:offset + chunk]
-        packed[0, lb] = chunk
-        if self.kv_layout == "paged":
-            packed[0, lb + 1:lb + 1 + w] = table_row
-        else:
-            packed[0, lb + 1] = idx
-        packed[0, lb + 1 + w] = offset  # chunk offset
-        packed[0, lb + 2 + w] = np.float32(temp).view(np.int32)
-        packed[0, lb + 3 + w] = step
-
-        self._announce(TAG_CHUNK, lb, 1, packed)
-        first_dev, self.cache = self._chunk_prefill(
-            self.params, self._base_key, self.cache, jnp.asarray(packed)
-        )
-        self._dq.append(("chunk", first_dev, (idx, s, chunk, offset, last),
-                         t0, chunk / lb, ("prefill_chunk", lb, 1)))
+        executor.dispatch_chunk(self, executor.ChunkPlan(
+            idx, s, chunk, offset, last, lb, table_row, temp, step, t0))
         return True
 
     def _fold_chunk(self, first: np.ndarray, meta, t0: float,
@@ -2447,50 +2497,16 @@ class GenerateEngine(_EngineBase):
         holding the small gathered device buffers; this step — device
         thread, once per loop iteration — blocks on those buffers, copies
         them to host memory, and swaps the node payload. Nodes dropped or
-        promoted in between simply skip the replacement."""
-        items, self._pending_spills = self._pending_spills, []
-        for key, dev_payload in items:
-            host_payload = tuple(np.asarray(x) for x in dev_payload)
-            with self._state_lock:
-                if self._prefix is not None:
-                    self._prefix.replace_host_payload(key, host_payload)
+        promoted in between simply skip the replacement. Body lives in
+        the executor layer (tpu/executor.py)."""
+        executor.materialize_spills(self)
 
     def _dispatch_swapins(self) -> bool:
         """Dispatch one async host→device page upload per staged hit onto
-        the unified in-flight queue (device thread, outside the state lock
-        — packing is host memcpy and the device call must never wedge under
-        the lock). Pages were claimed and nodes promoted at hit time; the
-        fold (_fold_swapin) settles the nodes and records the metrics, and
-        discards slot bookkeeping by identity like every other entry."""
-        items, self._pending_swapins = self._pending_swapins, []
-        from gofr_tpu.ops.paged import swap_in_pages
-
-        leaves_proto = jax.tree.leaves(self.cache)
-        for idx, slot, keys, pids, payloads in items:
-            t0 = time.monotonic()
-            n = len(pids)
-            # smallest bucketed upload width: padding is at most 2x the
-            # pages actually swapped, never the full pages_per_slot
-            w = next_bucket(n, self._swapin_buckets)
-            ids = np.full((w,), self.total_pages, np.int32)  # pad rows: OOB, dropped
-            ids[:n] = pids
-            stacked = []
-            for li, proto in enumerate(leaves_proto):
-                buf = np.zeros((proto.shape[0], w) + tuple(proto.shape[2:]),
-                               np.asarray(payloads[0][li]).dtype)
-                for j in range(n):
-                    buf[:, j] = payloads[j][li]
-                stacked.append(buf)
-            payload_tree = jax.tree.unflatten(self._cache_treedef, stacked)
-            self.cache, marker = swap_in_pages(
-                self.cache, jnp.asarray(ids), payload_tree)
-            leaves_proto = jax.tree.leaves(self.cache)
-            # the histogram records the ACTUAL transfer (padded width) so
-            # swap-in latency and bytes stay comparable
-            nbytes = w * self._page_bytes
-            self._dq.append(("swapin", marker, (idx, slot, keys, n, nbytes),
-                             t0, n / w, ("swapin", w)))
-        return True
+        the unified in-flight queue. Body lives in the executor layer
+        (tpu/executor.py, dispatch_swapins) — see its docstring for the
+        locking/fold contract."""
+        return executor.dispatch_swapins(self)
 
     def _fold_swapin(self, meta, t0: float, occupancy: float, sig: tuple) -> None:
         """Dequeue side of one swap-in (process_decode already blocked on
@@ -2684,36 +2700,11 @@ class GenerateEngine(_EngineBase):
             self._step_count += 1
             step = self._step_count
 
-        # pure-numpy packing OUTSIDE the state lock: token/temp data rides
-        # the immutable `ready` list, lanes and table rows were snapshotted
-        # under the lock above
-        packed = self._staging("prefill", (nb, lb + w + 3))
-        packed[:, lb] = 1  # padding rows: length 1
-        temps = np.zeros((nb,), np.float32)
-        if self.kv_layout == "paged":
-            packed[:, lb + 1:lb + 1 + w] = self.total_pages
-        else:
-            packed[:, lb + 1] = self.num_slots
-        for i, (req, toks) in enumerate(ready):
-            packed[i, : toks.shape[0]] = toks
-            packed[i, lb] = toks.shape[0]
-            if self.kv_layout == "paged":
-                packed[i, lb + 1:lb + 1 + w] = table_rows[i]
-            else:
-                packed[i, lb + 1] = rows[i]
-            temps[i] = float(req.kw.get("temperature", 0.0))
-        packed[:, lb + 1 + w] = temps.view(np.int32)
-        packed[0, lb + 2 + w] = step
-
-        self._announce(TAG_PREFILL, lb, nb, packed)
-        first_dev, self.cache = self._prefill_sample(
-            self.params, self._base_key, self.cache, jnp.asarray(packed)
-        )
-        # tokens, never logits — and NEVER read back here: the future rides
-        # the in-flight queue; _fold_prefill activates the claimed slots at
-        # dequeue, overlapped with whatever dispatches after this call
-        self._dq.append(("prefill", first_dev, meta, t0, n / nb,
-                         ("prefill", lb, nb)))
+        # device dispatch OUTSIDE the state lock (executor layer): token/
+        # temp data rides the immutable `ready` list, lanes and table rows
+        # were snapshotted under the lock above
+        executor.dispatch_prefill(self, executor.PrefillPlan(
+            ready, meta, nb, lb, w, rows, table_rows, step, t0))
         return True
 
     def _fold_prefill(self, first: np.ndarray, meta, t0: float,
@@ -3108,6 +3099,17 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
                 f"{getattr(family, '__name__', family)!r} (no {kvq_attr})"
             )
             kv_quantize = ""
+        # disaggregated serving (ENGINE_ROLE, docs/serving.md): a prefill
+        # worker ships finished prompts' KV pages to a decode worker over
+        # the handoff channel; "both" (the default) is colocated serving,
+        # byte-identical to the pre-role engine.
+        role = str(kw.pop("role", conf.get_or_default("ENGINE_ROLE", "both")) or "both")
+        handoff_target = kw.pop(
+            "handoff_target", conf.get_or_default("HANDOFF_TARGET", "")) or None
+        handoff_listen = kw.pop(
+            "handoff_listen", conf.get_or_default("HANDOFF_LISTEN", "")) or None
+        handoff_timeout = float(kw.pop(
+            "handoff_timeout_s", conf.get_float("HANDOFF_TIMEOUT_S", 5.0)))
         return GenerateEngine(
             family, cfg, params, container,
             slots=int(kw.pop("slots", conf.get_int("ENGINE_SLOTS", 8))),
@@ -3138,6 +3140,10 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             eos_token_id=eos,
             tokenizer=tokenizer,
             default_timeout=default_timeout,
+            role=role,
+            handoff_target=handoff_target,
+            handoff_listen=handoff_listen,
+            handoff_timeout_s=handoff_timeout,
             **kw,
         )
 

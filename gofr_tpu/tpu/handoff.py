@@ -1,0 +1,436 @@
+"""Prefill→decode paged-KV handoff for disaggregated serving (ISSUE 12).
+
+Role-split engines (``ENGINE_ROLE`` — tpu/engine.py) separate the two
+phases continuous batching otherwise interleaves on one device: a
+*prefill* worker runs prompt prefill and ships the resulting full KV
+pages here; a *decode* worker imports them as HOST-tier prefix-cache
+nodes (tpu/prefix.py ``insert_host``), so the next admission of that
+prompt gets a prefix hit and the page upload rides the existing
+``swapin`` kind on the unified in-flight queue ``_dq`` — the transfer
+overlaps live decode steps instead of stalling them.
+
+Wire format (own magic; the framing discipline — length prefix, exact
+reads, loud size cap — is fleet/channel.py's): after a one-time
+``_MAGIC`` handshake, each KV frame is::
+
+    <i meta_nbytes> <meta JSON> <payload bytes>
+
+where meta carries the prompt tokens, page count, and per-plane
+dtype/shape (the paged cache is a pytree; each page's payload is the
+per-layer K/V planes ``ops.paged.gather_page`` returns, int8 scale
+planes included), and the payload is the pages' planes concatenated in
+chain order. The receiver replies ``<i status>`` (0 = imported) — the
+ACK is what bounds the exporter's wait and closes the ``engine.handoff``
+span. Both sides inherit ``MAX_FRAME_BYTES`` so a corrupt length can
+never silently OOM the importer.
+
+Failure contract (the PR 10 deadline plane): the exporter waits at most
+``min(handoff_timeout_s, request deadline remaining)`` for the ACK; a
+stuck or severed transfer completes the request with a 504
+(``where="handoff"``). The prefill side's pages were retained by its own
+prefix cache BEFORE export and the decode side registers only refcount-
+free host payloads, so a transfer severed at ANY byte leaks zero pool
+pages on either side (``assert_page_refs_consistent``) — the chaos point
+``kv.handoff`` (docs/testing.md) proves it from both ends.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from gofr_tpu.fleet import chaos
+from gofr_tpu.fleet.channel import MAX_FRAME_BYTES
+from gofr_tpu.http.errors import DeadlineExceeded
+
+_MAGIC = b"GOFR-HANDOFF1\n"
+_I32 = struct.Struct("<i")
+
+ACK_OK = 0
+ACK_REJECTED = 1
+
+
+class HandoffClosed(ConnectionError):
+    """The peer went away mid-frame (sever, crash, chaos drop)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly n bytes (same discipline as fleet/channel.py — a
+    short read mid-frame is a protocol error, not a retry)."""
+    buf = bytearray()
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise HandoffClosed(f"handoff peer closed mid-read ({len(buf)}/{n} bytes)")
+        buf.extend(part)
+    return bytes(buf)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype by name, reaching into ml_dtypes for the accelerator
+    dtypes numpy itself doesn't know (bfloat16 — jax always ships it)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_frame(toks: np.ndarray, payloads: list[tuple], nbytes_page: int) -> bytes:
+    """One KV frame: meta-length + meta JSON + concatenated plane bytes.
+    ``payloads`` holds one tuple of HOST numpy planes per full page, in
+    chain order (the caller already read the device buffers back)."""
+    planes = [{"dtype": str(a.dtype), "shape": list(a.shape)} for a in payloads[0]]
+    meta = json.dumps({
+        "toks": np.asarray(toks, np.int64).tolist(),
+        "n_pages": len(payloads),
+        "nbytes_page": int(nbytes_page),
+        "planes": planes,
+    }).encode("utf-8")
+    parts = [_I32.pack(len(meta)), meta]
+    for page in payloads:
+        for a in page:
+            parts.append(np.ascontiguousarray(a).tobytes())
+    frame = b"".join(parts)
+    if len(frame) > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"handoff: refusing to send a {len(frame)}-byte frame "
+            f"(cap {MAX_FRAME_BYTES}); {len(payloads)} pages")
+    return frame
+
+
+def decode_frame(sock: socket.socket) -> tuple[np.ndarray, list[tuple], int]:
+    """Read one KV frame off ``sock``: (prompt tokens, per-page plane
+    tuples, nbytes_page). Raises HandoffClosed on sever, ValueError on a
+    frame that lies about its size."""
+    (meta_len,) = _I32.unpack(_recv_exact(sock, _I32.size))
+    if not 0 < meta_len <= MAX_FRAME_BYTES:
+        raise ValueError(f"handoff: frame advertises {meta_len} meta bytes — corrupt stream")
+    meta = json.loads(_recv_exact(sock, meta_len).decode("utf-8"))
+    toks = np.asarray(meta["toks"], np.int32)
+    n_pages = int(meta["n_pages"])
+    planes = meta["planes"]
+    dtypes = [_np_dtype(p["dtype"]) for p in planes]
+    shapes = [tuple(int(d) for d in p["shape"]) for p in planes]
+    per_page = sum(int(np.prod(sh)) * dt.itemsize for sh, dt in zip(shapes, dtypes))
+    if not 0 < n_pages * per_page <= MAX_FRAME_BYTES:
+        raise ValueError(
+            f"handoff: frame advertises {n_pages} pages x {per_page} bytes "
+            f"(cap {MAX_FRAME_BYTES}) — corrupt stream")
+    payloads: list[tuple] = []
+    for _ in range(n_pages):
+        page = []
+        for sh, dt in zip(shapes, dtypes):
+            raw = _recv_exact(sock, int(np.prod(sh)) * dt.itemsize)
+            page.append(np.frombuffer(raw, dtype=dt).reshape(sh).copy())
+        payloads.append(tuple(page))
+    return toks, payloads, int(meta["nbytes_page"])
+
+
+def _register_handoff_metrics(metrics) -> None:
+    """The registry's record-by-name API drops writes to unregistered
+    names, so both endpoints declare the transfer metrics up front
+    (idempotent: _register returns the existing metric)."""
+    metrics.new_counter("app_tpu_kv_handoff_pages_total",
+                        "KV pages shipped between role-split workers")
+    metrics.new_counter("app_tpu_kv_handoff_bytes_total",
+                        "KV handoff wire bytes (frame size, export side)")
+    metrics.new_histogram("app_tpu_kv_handoff_seconds",
+                          "prefill-side handoff latency: activation to ACK")
+
+
+class HandoffJob:
+    """One staged export: everything the exporter thread needs to ship a
+    slot's prompt pages and settle the request, captured under the engine
+    state lock at activation time. ``payloads`` are DEVICE buffers — the
+    gathers were dispatched under the lock (the _evict_prefix_page
+    discipline); the exporter blocks on them outside it."""
+
+    __slots__ = ("request", "prompt_tokens", "first_token", "payloads",
+                 "nbytes_page", "t0")
+
+    def __init__(self, request, prompt_tokens, first_token, payloads,
+                 nbytes_page, t0):
+        self.request = request
+        self.prompt_tokens = prompt_tokens
+        self.first_token = first_token
+        self.payloads = payloads
+        self.nbytes_page = nbytes_page
+        self.t0 = t0
+
+
+class HandoffExporter:
+    """Prefill-side export thread: serializes staged jobs onto one TCP
+    connection to the decode worker's HandoffServer, lazily (re)dialing.
+    Jobs are strictly serial — KV frames are multi-MB and the decode
+    side imports under its state lock, so pipelining frames buys nothing
+    and interleaving them would corrupt the stream."""
+
+    def __init__(self, target: str, *, engine=None, timeout_s: float = 5.0,
+                 logger=None, metrics=None):
+        host, _, port = target.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.timeout_s = max(0.1, float(timeout_s))
+        self.engine = engine
+        self.logger = logger
+        self.metrics = metrics
+        if metrics is not None:
+            _register_handoff_metrics(metrics)
+        self._sock: socket.socket | None = None
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._stop = threading.Event()
+        self._stats = {"exported": 0, "failed": 0, "pages": 0, "bytes": 0}
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._run, name="kv-handoff-export", daemon=True)
+        self._thread.start()
+
+    # -- connection ------------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.sendall(_MAGIC)
+        self._sock = s
+        return s
+
+    def _sever(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # -- export ----------------------------------------------------------------
+
+    def submit(self, job: HandoffJob) -> None:
+        self._q.put(job)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                job = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if job is None:
+                break
+            try:
+                self._export(job)
+            except Exception as e:  # noqa: BLE001 - one bad job must not kill the thread
+                self._fail(job, f"handoff export error: {e}")
+
+    def _export(self, job: HandoffJob) -> None:
+        req = job.request
+        # device→host readback OUTSIDE every engine lock: the gathers were
+        # dispatched at activation; np.asarray blocks on them here
+        host_pages = [tuple(np.asarray(a) for a in page) for page in job.payloads]
+        if req.cancelled or req.expired(time.monotonic()):
+            self._fail(job, "request expired before KV export began")
+            return
+        try:
+            frame = encode_frame(job.prompt_tokens, host_pages, job.nbytes_page)
+        except ValueError as e:
+            self._fail(job, str(e))
+            return
+        # bound the whole send+ACK by the tighter of the handoff budget and
+        # the request's remaining deadline (PR 10 plane)
+        budget = self.timeout_s
+        if req.deadline is not None:
+            budget = min(budget, max(0.05, req.deadline - time.monotonic()))
+        # chaos kv.handoff, client side: drop = sever the connection with
+        # the frame (possibly partially) unsent — no ACK ever arrives
+        if chaos.fire("kv.handoff", side="export", pages=len(host_pages)):
+            self._sever()
+            self._fail(job, "handoff transfer severed (chaos kv.handoff)")
+            return
+        try:
+            s = self._connect()
+            s.settimeout(budget)
+            s.sendall(frame)
+            (status,) = _I32.unpack(_recv_exact(s, _I32.size))
+        except (OSError, HandoffClosed) as e:
+            self._sever()
+            self._fail(job, f"handoff transfer failed: {e}")
+            return
+        if status != ACK_OK:
+            self._fail(job, f"decode worker rejected the KV frame (status {status})")
+            return
+        self._settle(job, len(host_pages), len(frame))
+
+    def _settle(self, job: HandoffJob, n_pages: int, nbytes: int) -> None:
+        req = job.request
+        now = time.monotonic()
+        with self._lock:
+            self._stats["exported"] += 1
+            self._stats["pages"] += n_pages
+            self._stats["bytes"] += nbytes
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_tpu_kv_handoff_pages_total", n_pages, side="export")
+            self.metrics.increment_counter(
+                "app_tpu_kv_handoff_bytes_total", nbytes, side="export")
+            self.metrics.record_histogram(
+                "app_tpu_kv_handoff_seconds", now - job.t0)
+        rt = req.kw.get("_rt")
+        if rt is not None:
+            rt.end("engine.handoff", pages=n_pages, bytes=nbytes)
+        eng = self.engine
+        tokenizer = getattr(eng, "tokenizer", None) if eng is not None else None
+        tokens = [int(job.first_token)]
+        ft = req.kw.get("_first_token_at", job.t0)
+        req.complete(result={
+            "tokens": tokens,
+            "text": tokenizer.decode(tokens) if tokenizer is not None else None,
+            "finish_reason": "handoff",
+            "ttft_s": ft - req.enqueued_at,
+        })
+
+    def _fail(self, job: HandoffJob, why: str) -> None:
+        with self._lock:
+            self._stats["failed"] += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_request_deadline_exceeded_total", 1, where="handoff")
+        rt = job.request.kw.get("_rt")
+        if rt is not None:
+            rt.end("engine.handoff", error=why[:120])
+        if self.logger is not None:
+            self.logger.warn(f"kv handoff: {why}")
+        job.request.complete(error=DeadlineExceeded(f"kv handoff failed: {why}"))
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._q.put(None)
+        self._thread.join(timeout=2.0)
+        self._sever()
+
+
+class HandoffServer:
+    """Decode-side import listener: accepts prefill workers' connections
+    and registers each frame's pages as host-tier prefix nodes via
+    ``engine.handoff_import`` — refcount-free payloads the next prefix
+    hit promotes and uploads through the normal ``swapin`` path."""
+
+    def __init__(self, engine, listen: str = "127.0.0.1:0", *,
+                 logger=None, metrics=None):
+        self.engine = engine
+        self.logger = logger
+        self.metrics = metrics
+        if metrics is not None:
+            _register_handoff_metrics(metrics)
+        host, _, port = listen.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((self.host, int(port)))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._stats = {"imported": 0, "rejected": 0, "pages": 0, "bytes": 0}
+        self._lock = threading.Lock()
+        self._conns: list[socket.socket] = []
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="kv-handoff-server", daemon=True)
+        self._thread.start()
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        self._srv.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="kv-handoff-conn", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            if _recv_exact(conn, len(_MAGIC)) != _MAGIC:
+                return  # not a handoff peer; drop the connection
+            while not self._stop.is_set():
+                toks, payloads, nbytes_page = decode_frame(conn)
+                # chaos kv.handoff, server side: the frame arrived but is
+                # dropped BEFORE import — the exporter times out waiting
+                # for an ACK that never comes (raise/delay work too)
+                if chaos.fire("kv.handoff", side="import", pages=len(payloads)):
+                    return
+                try:
+                    added = self.engine.handoff_import(toks, payloads, nbytes_page)
+                    status = ACK_OK
+                except Exception as e:  # noqa: BLE001 - reject, keep serving
+                    added = 0
+                    status = ACK_REJECTED
+                    if self.logger is not None:
+                        self.logger.warn(f"kv handoff import rejected: {e}")
+                nbytes = len(payloads) * nbytes_page
+                with self._lock:
+                    if status == ACK_OK:
+                        self._stats["imported"] += 1
+                        self._stats["pages"] += added
+                        self._stats["bytes"] += nbytes
+                    else:
+                        self._stats["rejected"] += 1
+                if self.metrics is not None and status == ACK_OK:
+                    self.metrics.increment_counter(
+                        "app_tpu_kv_handoff_pages_total", added, side="import")
+                    self.metrics.increment_counter(
+                        "app_tpu_kv_handoff_bytes_total", nbytes, side="import")
+                conn.sendall(_I32.pack(status))
+        except (HandoffClosed, ValueError, OSError, json.JSONDecodeError):
+            pass  # peer gone or corrupt stream: drop the connection
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = list(self._conns), []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=2.0)
+
+
+__all__ = [
+    "ACK_OK", "ACK_REJECTED", "HandoffClosed", "HandoffExporter",
+    "HandoffJob", "HandoffServer", "decode_frame", "encode_frame",
+]

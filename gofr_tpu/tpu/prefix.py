@@ -262,6 +262,48 @@ class PrefixCache:
                 new.append(pages[i])
         return new
 
+    def insert_host(self, toks: np.ndarray, payloads, nbytes_each: int) -> int:
+        """Register transferred page payloads (chain order, one per full
+        page of ``toks``) as HOST-tier nodes — the decode-side import half
+        of the disaggregated handoff (tpu/handoff.py). Host nodes hold no
+        pool references, so a severed transfer leaves only droppable host
+        bytes behind: zero-leak by construction. Positions already cached
+        in either tier are touched and skipped (KV content equality — the
+        payload is identical to what the cache already holds). Enforces the
+        host byte budget like ``commit_spill``. Returns the number of nodes
+        added (0 when the host tier is disabled)."""
+        if self.host_budget <= 0:
+            return 0
+        added = 0
+        key = _ROOT
+        p = self.page_size
+        buf = self._page_bytes_of(toks)
+        for i in range(min(buf.shape[0] // p, len(payloads))):
+            page_toks = buf[i * p:(i + 1) * p].tobytes()
+            parent, key = key, self._child_key(key, page_toks)
+            node = self._get(parent, key, page_toks)
+            if node is not None:
+                self._touch(key, node)
+                continue
+            if key in self._nodes:
+                break  # collision with a different chain: stop extending
+            node = _Node(parent, page_toks, -1, self._tick())
+            node.host = payloads[i]
+            node.host_nbytes = int(nbytes_each)
+            self._nodes[key] = node
+            self._host_count += 1
+            self.host_bytes += node.host_nbytes
+            pnode = self._nodes.get(parent)
+            if pnode is not None:
+                pnode.children += 1
+            if node.children == 0:
+                self._hpush(key, node)
+            added += 1
+        while self.host_bytes > self.host_budget:
+            if self._drop_host_lru() is None:
+                break  # only interior host nodes left: transient overshoot
+        return added
+
     # -- device-tier eviction / spill -------------------------------------------
 
     def _pop_dev_lru(self) -> tuple[int, _Node] | None:

@@ -235,6 +235,55 @@ def test_ci_runs_the_diurnal_smoke():
     assert "bench.py" in joined
 
 
+def test_handoff_suite_is_in_quick_tier():
+    """ISSUE 12 satellite: the disaggregated-serving suite — KV wire
+    codec round trips, token-exact P→D handoff vs a colocated engine
+    (bf16 AND int8 paged KV), the deadline-plane handoff shed, the
+    chaos-severed zero-leak drill on both workers, and the router's
+    stage-aware planning — runs on the CPU mesh in seconds and must ride
+    the `-m quick` CI job on every push."""
+    path = REPO / "tests" / "test_handoff.py"
+    assert path.exists(), "tests/test_handoff.py missing"
+    text = path.read_text()
+    assert "pytestmark = pytest.mark.quick" in text, (
+        "test_handoff.py must be quick-marked module-wide"
+    )
+    assert "test_handoff.py" not in QUICK_EXEMPT, (
+        "test_handoff.py must not be exempted from the quick tier"
+    )
+    # the tentpole's acceptance pieces are all covered: token-exactness
+    # on both KV dtypes, the deadline shed, the severed-transfer leak
+    # check, and role-aware routing
+    assert "token_exact_bf16" in text and "token_exact_int8" in text
+    assert "kv.handoff" in text and "assert_page_refs_consistent" in text
+    assert "deadline" in text and "stage" in text
+
+
+def test_ci_runs_the_disagg_smoke():
+    """ISSUE 12 satellite: CI must run the prefill/decode A/B as an
+    EXPLICIT CPU run and assert both arms archive TTFT/TPOT percentiles
+    plus the role-split arm's handoff transfer stats in extra.disagg —
+    otherwise the disaggregation harness can rot between TPU rounds."""
+    ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+    smoke_runs = [
+        step.get("run", "")
+        for job in ci["jobs"].values()
+        for step in job.get("steps", [])
+        if "GOFR_BENCH_DISAGG=1" in step.get("run", "")
+    ]
+    assert smoke_runs, "ci.yml has no job running the GOFR_BENCH_DISAGG smoke"
+    joined = " ".join(smoke_runs)
+    assert "GOFR_BENCH_PLATFORM=cpu" in joined
+    assert "bench.py" in joined
+    # the verdict step must actually check the archived structure
+    checks = " ".join(
+        step.get("run", "")
+        for job in ci["jobs"].values()
+        for step in job.get("steps", [])
+        if "disagg" in step.get("run", ""))
+    assert "tpot" in checks and "handoff" in checks and "token_exact" in checks
+
+
 def test_ci_has_py310_compat_gate():
     """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
     syntax (same-quote nested f-strings) passes every 3.12 job silently and

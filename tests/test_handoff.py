@@ -1,0 +1,318 @@
+"""Disaggregated prefill/decode serving (ISSUE 12): role-split engines
+with paged-KV handoff over the fleet-style channel.
+
+Unit tier: the KV wire codec (encode/decode round trip, bfloat16 planes,
+frame-size cap). Engine tier proves the acceptance properties on the CPU
+mesh: a prefill-role worker completes prompts with ``finish_reason=
+"handoff"`` and ships bit-identical pages to a decode-role worker whose
+generation is TOKEN-EXACT vs a colocated (``ENGINE_ROLE=both``) engine on
+both paged KV layouts (bf16 and int8 scale planes); a stuck transfer is
+shed by the PR 10 deadline plane as a 504 with ``where="handoff"``; and a
+chaos-severed transfer (``kv.handoff``, either side) leaks zero pool
+pages on BOTH workers (``assert_page_refs_consistent``).
+"""
+
+import socket
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.fleet import chaos
+from gofr_tpu.http.errors import DeadlineExceeded
+from gofr_tpu.models import LlamaConfig, llama
+from gofr_tpu.testutil import assert_page_refs_consistent, assert_paged_pool_consistent
+from gofr_tpu.tpu import handoff
+from gofr_tpu.tpu.engine import GenerateEngine
+
+pytestmark = pytest.mark.quick
+
+
+# -- wire codec -----------------------------------------------------------------
+
+
+def _roundtrip(payloads, toks, nbytes_page=64):
+    """encode_frame → a real socket pair → decode_frame."""
+    frame = handoff.encode_frame(np.asarray(toks, np.int32), payloads, nbytes_page)
+    a, b = socket.socketpair()
+    try:
+        a.sendall(frame)
+        return handoff.decode_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+class TestWireCodec:
+    def test_roundtrip_multi_plane(self):
+        pages = [
+            (np.arange(12, dtype=np.float32).reshape(3, 4),
+             np.full((2, 2), i, np.int8))
+            for i in range(3)
+        ]
+        toks, out, nbytes = _roundtrip(pages, [1, 2, 3, 4, 5])
+        assert toks.tolist() == [1, 2, 3, 4, 5] and nbytes == 64
+        assert len(out) == 3
+        for want, got in zip(pages, out):
+            for w, g in zip(want, got):
+                assert w.dtype == g.dtype and (np.asarray(w) == np.asarray(g)).all()
+
+    def test_roundtrip_bfloat16(self):
+        import ml_dtypes
+
+        page = (np.asarray([[1.5, -2.0]], ml_dtypes.bfloat16),)
+        _, out, _ = _roundtrip([page], [7])
+        assert out[0][0].dtype == ml_dtypes.bfloat16
+        assert (np.asarray(out[0][0], np.float32) == [[1.5, -2.0]]).all()
+
+    def test_encode_refuses_oversized_frame(self, monkeypatch):
+        monkeypatch.setattr(handoff, "MAX_FRAME_BYTES", 64)
+        big = [(np.zeros((64,), np.float32),)]
+        with pytest.raises(ValueError, match="refusing to send"):
+            handoff.encode_frame(np.asarray([1], np.int32), big, 256)
+
+    def test_decode_rejects_lying_meta(self):
+        import json
+        import struct
+
+        meta = json.dumps({
+            "toks": [1], "n_pages": 1 << 30, "nbytes_page": 4,
+            "planes": [{"dtype": "float32", "shape": [1024, 1024]}],
+        }).encode()
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack("<i", len(meta)) + meta)
+            with pytest.raises(ValueError, match="corrupt stream"):
+                handoff.decode_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+
+# -- engine tier (CPU mesh) ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(7))
+    return cfg, params
+
+
+def make_engine(cfg, params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("max_prefill_batch", 2)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("page_size", 8)
+    kw.setdefault("total_pages", 16)
+    return GenerateEngine(llama, cfg, params, new_mock_container(), **kw)
+
+
+PROMPT = [(11 * i) % 190 + 1 for i in range(20)]  # 2 full pages @ page_size 8
+
+
+def _disagg_pair(cfg, params, **kw):
+    dec = make_engine(cfg, params, role="decode", **kw)
+    pre = make_engine(cfg, params, role="prefill",
+                      handoff_target=dec.handoff_addr, **kw)
+    return pre, dec
+
+
+class TestDisaggServing:
+    def _token_exact(self, setup, **engine_kw):
+        """Acceptance: the P→D handoff run must be token-exact vs ONE
+        colocated engine of the same configuration (the only valid
+        comparison under int8 KV, whose quantized logits differ from an
+        unquantized reference)."""
+        cfg, params = setup
+        colo = make_engine(cfg, params, **engine_kw)
+        try:
+            want = colo.generate(PROMPT, max_new_tokens=6, timeout=300)["tokens"]
+        finally:
+            colo.stop()
+        pre, dec = _disagg_pair(cfg, params, **engine_kw)
+        try:
+            # 1) prefill worker: prompt prefill + KV export; the request
+            # completes with exactly the first sampled token
+            res = pre.generate(PROMPT, max_new_tokens=6, timeout=300)
+            assert res["finish_reason"] == "handoff"
+            assert res["tokens"] == [want[0]], "prefill first token diverged"
+            assert res["ttft_s"] >= 0
+            assert pre._handoff_exporter.stats()["exported"] == 1
+            # 2) decode worker: the shipped chain is a host-tier prefix hit;
+            # upload rides the swapin path and decode streams the rest
+            assert dec._prefix.host_pages == 2, "import did not land both pages"
+            assert dec._handoff_server.stats()["imported"] == 1
+            out = dec.generate(PROMPT, max_new_tokens=6, timeout=300)
+            assert out["tokens"] == want, "disagg decode diverged from colocated"
+            swapped = dec.metrics.get("app_tpu_prefix_swapin_pages_total")
+            assert swapped is not None and sum(swapped._values.values()) == 2
+            # export-side transfer metrics (satellite: observability)
+            pages = pre.metrics.get("app_tpu_kv_handoff_pages_total")
+            assert pages is not None and sum(pages._values.values()) == 2
+            lat = pre.metrics.get("app_tpu_kv_handoff_seconds")
+            assert lat is not None and lat.count() == 1
+            # zero-leak on BOTH sides (the acceptance drill)
+            assert_page_refs_consistent(pre)
+            assert_page_refs_consistent(dec)
+            assert_paged_pool_consistent(dec, slots_empty=True)
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_disagg_token_exact_bf16(self, setup):
+        self._token_exact(setup)
+
+    def test_disagg_token_exact_int8(self, setup):
+        self._token_exact(setup, kv_quantize="int8")
+
+    def test_role_validation(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="paged"):
+            make_engine(cfg, params, kv_layout="slot", role="prefill",
+                        page_size=None, total_pages=None)
+        with pytest.raises(ValueError, match="ENGINE_ROLE"):
+            make_engine(cfg, params, role="sidecar")
+        with pytest.raises(ValueError, match="prefix cache"):
+            make_engine(cfg, params, role="decode", prefix_cache=False)
+
+    def test_prefill_without_target_falls_back_colocated(self, setup):
+        """ENGINE_ROLE=prefill with no HANDOFF_TARGET: loud warn, prompts
+        decode locally — bring-up must not brick a mis-wired worker."""
+        cfg, params = setup
+        eng = make_engine(cfg, params, role="prefill")
+        try:
+            res = eng.generate(PROMPT, max_new_tokens=4, timeout=300)
+            assert res["finish_reason"] in ("stop", "length")
+            assert len(res["tokens"]) == 4
+        finally:
+            eng.stop()
+
+    def test_handoff_deadline_shed(self, setup):
+        """A transfer that never ACKs (listener accepts, stays silent) is
+        shed by the deadline plane: 504 DeadlineExceeded, where="handoff"
+        counted, and the prefill side's pool stays consistent — the pages
+        live on in its prefix cache, nothing leaks."""
+        cfg, params = setup
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)  # never accepted: connect+send buffer, the ACK never comes
+        target = f"127.0.0.1:{srv.getsockname()[1]}"
+        eng = make_engine(cfg, params, role="prefill", handoff_target=target,
+                          handoff_timeout_s=0.5)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded, match="handoff"):
+                eng.generate(PROMPT, max_new_tokens=4, timeout=300)
+            assert time.monotonic() - t0 < 10.0, "shed was not deadline-bounded"
+            shed = eng.metrics.get("app_request_deadline_exceeded_total")
+            counts = {dict(ls).get("where"): v for ls, v in shed._values.items()}
+            assert counts.get("handoff") == 1
+            assert eng._handoff_exporter.stats()["failed"] == 1
+            assert_page_refs_consistent(eng)
+        finally:
+            eng.stop()
+            srv.close()
+
+    def test_chaos_severed_transfer_leaks_nothing_either_side(self, setup):
+        """kv.handoff chaos, both fire sites: an export-side sever (hit 1)
+        and an import-side drop (hit 2 — frame arrives, dropped before
+        import, connection closed with no ACK) each shed the request and
+        leave BOTH pools consistent; the decode side holds zero imported
+        pages. After the chaos window the same pair ships cleanly."""
+        cfg, params = setup
+        pre, dec = _disagg_pair(cfg, params)
+        try:
+            with chaos.override("kv.handoff:drop,nth=1"):
+                with pytest.raises(DeadlineExceeded, match="handoff"):
+                    pre.generate(PROMPT, max_new_tokens=4, timeout=300)
+            assert dec._prefix.host_pages == 0
+            assert_page_refs_consistent(pre)
+            assert_page_refs_consistent(dec)
+
+            prompt2 = [(13 * i) % 170 + 2 for i in range(20)]
+            with chaos.override("kv.handoff:drop,nth=2"):
+                with pytest.raises(DeadlineExceeded, match="handoff"):
+                    pre.generate(prompt2, max_new_tokens=4, timeout=300)
+            assert dec._prefix.host_pages == 0, "dropped frame was imported"
+            assert dec._handoff_server.stats()["imported"] == 0
+            assert_page_refs_consistent(pre)
+            assert_page_refs_consistent(dec)
+
+            # chaos cleared: the exporter re-dials and the path heals
+            prompt3 = [(17 * i) % 150 + 3 for i in range(20)]
+            res = pre.generate(prompt3, max_new_tokens=4, timeout=300)
+            assert res["finish_reason"] == "handoff"
+            assert dec._prefix.host_pages == 2
+            assert_page_refs_consistent(pre)
+            assert_page_refs_consistent(dec)
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_handoff_stats_and_span(self, setup):
+        """Role + transfer counters surface through engine.handoff_stats
+        (what the gossip ships to /debug/fleet)."""
+        cfg, params = setup
+        pre, dec = _disagg_pair(cfg, params)
+        try:
+            pre.generate(PROMPT, max_new_tokens=4, timeout=300)
+            ps = pre.handoff_stats()
+            assert ps["role"] == "prefill" and ps["export"]["exported"] == 1
+            ds = dec.handoff_stats()
+            assert ds["role"] == "decode" and ds["import"]["imported"] == 1
+            assert ds["addr"] == dec.handoff_addr
+        finally:
+            pre.stop()
+            dec.stop()
+
+
+class TestRouterRoleAwareness:
+    def _registry(self):
+        from gofr_tpu.router import RouterPolicy, Router
+
+        container = new_mock_container()
+        r = Router(container, RouterPolicy(ttl_s=0.0, jitter_s=0.0))
+        return r
+
+    def test_plan_filters_by_stage_when_role_split(self):
+        r = self._registry()
+        for name, role in (("p0", "prefill"), ("p1", "prefill"), ("d0", "decode")):
+            r.registry.observe({"replica": name, "url": f"http://{name}",
+                                "status": "UP", "role": role})
+        for key in (1, 99, 12345, 999999):
+            plan_p = r.plan(key, stage="prefill")
+            assert plan_p.targets and all(
+                r.registry.get(t.name).role == "prefill" for t in plan_p.targets)
+            plan_d = r.plan(key, stage="decode")
+            assert plan_d.targets and all(
+                r.registry.get(t.name).role == "decode" for t in plan_d.targets)
+
+    def test_plan_ignores_stage_for_colocated_fleet(self):
+        r = self._registry()
+        for name in ("r0", "r1"):
+            r.registry.observe({"replica": name, "url": f"http://{name}",
+                                "status": "UP"})
+        p_any = r.plan(42)
+        p_stage = r.plan(42, stage="decode")
+        assert [t.name for t in p_any.targets] == [t.name for t in p_stage.targets]
+
+    def test_stage_filter_stands_down_with_no_eligible_member(self):
+        r = self._registry()
+        r.registry.observe({"replica": "p0", "url": "http://p0",
+                            "status": "UP", "role": "prefill"})
+        plan = r.plan(7, stage="decode")  # no decode member: colocated fallback
+        assert [t.name for t in plan.targets] == ["p0"]
+
+    def test_replica_up_carries_role_label_only_when_split(self):
+        from gofr_tpu.metrics import federation
+
+        text = federation.fleet_text(
+            {}, {"r0": {"status": "UP", "epoch": 0},
+                 "d0": {"status": "UP", "epoch": 0, "role": "decode"}})
+        assert 'app_fleet_replica_up{replica="r0"} 1' in text
+        assert ('app_fleet_replica_up{replica="d0",role="decode"} 1' in text
+                or 'app_fleet_replica_up{role="decode",replica="d0"} 1' in text)
