@@ -35,8 +35,19 @@ Env knobs:
     GOFR_BENCH_PLATFORM       force 'cpu' or 'tpu' (skips the probe)
     GOFR_BENCH_PROBE_S        TPU init probe timeout seconds (default 240)
     GOFR_BENCH_KV             'slot' (default) | 'paged' engine KV layout
-    GOFR_BENCH_KV_QUANTIZE    'int8' = int8 KV cache (slot and paged layouts)
+    GOFR_BENCH_KV_QUANTIZE    'int8' = int8 KV cache (slot and paged layouts);
+                              'int4' = packed-int4 KV pages (paged only, ISSUE 13)
+    GOFR_BENCH_KVDTYPE        1 = also run the paged-pool dtype three-way A/B
+                              (bf16 / int8 / int4 arms): req/s, decode TPOT
+                              p50/p99, exact pool bytes-per-decode-token,
+                              per-arm mbu_decode_lb, and token_exact/parity
+                              vs the bf16 arm land in extra.kvdtype
     GOFR_BENCH_SPEC           N>0 = speculative decoding with N lookup drafts
+    GOFR_BENCH_SPEC_AB        1 = also measure paced mixed arrivals with spec
+                              rounds on vs off at the configured KV layout
+                              (extra.spec_ab — the ISSUE 13 evidence that
+                              paged spec rides the async pipeline instead of
+                              serializing the device loop)
     GOFR_BENCH_PREFIX         1 = also measure the forced-spill shared-prefix
                               workload on the paged engine, three-way: cache
                               off / HBM-only / HBM+host spill tier (cold and
@@ -427,8 +438,13 @@ def main() -> None:
     pipeline = int(pipeline_env)
 
     kv_quantize = os.environ.get("GOFR_BENCH_KV_QUANTIZE", "")
-    if kv_quantize not in ("", "int8"):
-        raise SystemExit(f"GOFR_BENCH_KV_QUANTIZE={kv_quantize!r}: only 'int8' (or empty)")
+    if kv_quantize not in ("", "int8", "int4"):
+        raise SystemExit(
+            f"GOFR_BENCH_KV_QUANTIZE={kv_quantize!r}: only 'int8' or 'int4' (or empty)")
+    if kv_quantize == "int4" and kv_layout != "paged":
+        # same fail-loud rule: int4 KV is packed-nibble PAGES (ISSUE 13);
+        # silently benching the slot layout would report the wrong config
+        raise SystemExit("GOFR_BENCH_KV_QUANTIZE=int4 needs GOFR_BENCH_KV=paged")
     spec_tokens = int(os.environ.get("GOFR_BENCH_SPEC", "0"))
 
     def engine_kw(s: int, k: int) -> dict:
@@ -1407,7 +1423,114 @@ def main() -> None:
                 and isinstance(overlap_ab.get("off"), dict)):
             overlap_ab["speedup"] = round(
                 overlap_ab["on"]["req_per_s"] / max(overlap_ab["off"]["req_per_s"], 1e-9), 3)
+        # the layout/spec config the A/B actually ran under (ISSUE 13: spec
+        # rounds ride the same pipeline on BOTH layouts now, so the overlap
+        # claim is meaningful with GOFR_BENCH_KV=paged GOFR_BENCH_SPEC>0 too)
+        overlap_ab["kv_layout"] = kv_layout
+        if spec_tokens:
+            overlap_ab["spec_tokens"] = spec_tokens
         extra["overlap_ab"] = overlap_ab
+
+    # spec-on/off overlap A/B (ISSUE 13): paced mixed arrivals with
+    # speculative rounds ON vs OFF at the configured KV layout. Before the
+    # pipeline fold, the paged spec path dispatched synchronously — every
+    # round stalled prefill admission for a full device round trip; now
+    # both layouts dispatch spec rounds onto the bounded in-flight queue,
+    # and this A/B is the archived evidence that spec no longer serializes
+    # the device loop under arrival pressure (same CPU caveat as above).
+    if os.environ.get("GOFR_BENCH_SPEC_AB") == "1":
+        st_ab = spec_tokens or 3
+        short = prompts[: max(8, n_requests // 4)]
+        arrival_s = max(0.001, elapsed / n_requests / 2)
+        spec_ab: dict = {"kv_layout": kv_layout, "spec_tokens": st_ab,
+                         "arrival_ms": round(arrival_s * 1000, 2)}
+        for mode, stv in (("on", st_ab), ("off", 0)):
+            skw = dict(engine_kw(*best))
+            skw.pop("spec_tokens", None)
+            if stv:
+                skw["spec_tokens"] = stv
+            try:
+                mm = _run_mixed(skw, cfg, params, container, llama, short,
+                                max_new, timeout, arrival_s)
+                spec_ab[mode] = {
+                    "req_per_s": round(len(short) / mm["elapsed"], 3),
+                    "decode_tokens_per_s": round(mm["new_tokens"] / mm["elapsed"], 1),
+                    "ttft_p50_s": round(_percentile(mm["ttfts"], 50), 4),
+                    "ttft_p99_s": round(_percentile(mm["ttfts"], 99), 4),
+                }
+            except Exception as e:  # noqa: BLE001
+                spec_ab[mode] = f"error: {e}"[:160]
+        if (isinstance(spec_ab.get("on"), dict)
+                and isinstance(spec_ab.get("off"), dict)):
+            spec_ab["speedup"] = round(
+                spec_ab["on"]["req_per_s"] / max(spec_ab["off"]["req_per_s"], 1e-9), 3)
+        extra["spec_ab"] = spec_ab
+
+    # KV-dtype three-way A/B (ISSUE 13): bf16 vs int8 vs int4 paged pools
+    # under the same workload, archiving the decode-bandwidth story — pool
+    # bytes per decode token (exact, from the pool planes), decode TPOT
+    # percentiles, throughput, and per-arm mbu_decode_lb — plus the
+    # correctness fields: every arm's tokens vs the bf16 arm (token_exact,
+    # and parity = the fraction of requests matching exactly).
+    if os.environ.get("GOFR_BENCH_KVDTYPE") == "1":
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        short = prompts[: max(4, n_requests // 4)]
+        kvd: dict = {}
+        arm_tokens: dict = {}
+        for arm in ("bf16", "int8", "int4"):
+            akw = dict(engine_kw(*best))
+            akw.update(kv_layout="paged", page_size=akw.get("page_size", 128))
+            akw.pop("kv_quantize", None)
+            if arm != "bf16":
+                akw["kv_quantize"] = arm
+            cont_a = new_mock_container()  # isolated flight recorder per arm
+            try:
+                eng = GenerateEngine(llama, cfg, params, cont_a, **akw)
+                try:
+                    eng.warmup()
+                    eng.start()
+                    eng.generate(short[0], max_new_tokens=2, timeout=timeout)
+                    kv_pool = eng.kv_cache
+                    pool_positions = eng.total_pages * eng.page_size
+                    kv_bytes_tok = (sum(x.nbytes for x in jax.tree.leaves(kv_pool))
+                                    / pool_positions)
+                    t0a = time.monotonic()
+                    reqs = [eng.submit(p, max_new_tokens=max_new, timeout=timeout)
+                            for p in short]
+                    results = [r.result(timeout) for r in reqs]
+                    el = time.monotonic() - t0a
+                finally:
+                    eng.stop()
+                new_toks = sum(len(r["tokens"]) for r in results)
+                ents = cont_a.flight.requests(limit=4 * len(short))
+                tpots = [e["tpot_s"] for e in ents if e.get("tpot_s")]
+                arm_tokens[arm] = [r["tokens"] for r in results]
+                kvd[arm] = {
+                    "req_per_s": round(len(short) / el, 3),
+                    "decode_tokens_per_s": round(new_toks / el, 1),
+                    "kv_bytes_per_decode_token": round(kv_bytes_tok, 2),
+                    "tpot_p50_s": round(_percentile(tpots, 50), 5) if tpots else None,
+                    "tpot_p99_s": round(_percentile(tpots, 99), 5) if tpots else None,
+                    "mbu_decode_lb": (round((param_bytes * new_toks / best[0])
+                                            / el / _peak_bw(device), 4)
+                                      if on_accel else None),
+                }
+            except Exception as e:  # noqa: BLE001
+                kvd[arm] = f"error: {e}"[:200]
+        ref_toks = arm_tokens.get("bf16")
+        for arm in ("bf16", "int8", "int4"):
+            if not isinstance(kvd.get(arm), dict):
+                continue
+            got = arm_tokens.get(arm)
+            if ref_toks and got:
+                matches = sum(a == b for a, b in zip(got, ref_toks))
+                kvd[arm]["parity"] = round(matches / len(ref_toks), 3)
+                kvd[arm]["token_exact"] = matches == len(ref_toks)
+            else:
+                kvd[arm]["parity"] = None
+                kvd[arm]["token_exact"] = None
+        extra["kvdtype"] = kvd
 
     # kernel A/B on the chip: engine throughput with the Pallas kernels
     # forced on vs off (fresh engines retrace under the env toggle)

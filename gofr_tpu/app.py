@@ -742,6 +742,15 @@ class App:
         engines = {}
         for name, engine in self.container.engines.items():
             snap = engine.health_check() if hasattr(engine, "health_check") else {}
+            layout = getattr(engine, "kv_layout", None)
+            if layout is not None:
+                # the KV-pool dimension a kv-dtype A/B flips (ENGINE_KV_DTYPE;
+                # docs/kernels.md): '' quantize means the dense bf16 pool
+                snap = dict(snap)
+                snap["kv"] = {
+                    "layout": layout,
+                    "dtype": getattr(engine, "kv_quantize", "") or "bf16",
+                }
             report = getattr(engine, "autotune_report", None)
             rep = report() if report is not None else None
             if rep:

@@ -41,17 +41,22 @@ from gofr_tpu.ops.kvcache import (
     write_prompts,
     write_prompts_q,
 )
-from gofr_tpu.ops.attention import paged_decode_attention_q
+from gofr_tpu.ops.attention import paged_decode_attention_q, paged_decode_attention_q4
 from gofr_tpu.ops.paged import (
     PagedKVCache,
+    Q4PagedKVCache,
     QPagedKVCache,
     append_tokens_paged,
     append_tokens_paged_q,
+    append_tokens_paged_q4,
     gather_kv,
     gather_kv_q,
+    gather_kv_q4,
     write_prompts_paged,
     write_prompts_paged_q,
+    write_prompts_paged_q4,
 )
+from gofr_tpu.ops.quant import fake_quant_row_int4
 
 
 @dataclass(frozen=True)
@@ -503,14 +508,20 @@ def verify_step_paged(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
     """Speculative-decoding verification against the paged pool — the
     contract and stale-draft-KV invariants of ``verify_step``, with writes
     routed through per-slot block tables (``table`` [N, MaxP]; OOB rows
-    drop) and attention over the gathered logical views. Handles both the
-    dense and int8 pools (cache-type branch, like decode_step_paged)."""
+    drop) and attention over the gathered logical views. Handles the
+    dense, int8, and packed-int4 pools (cache-type branch, like
+    decode_step_paged — the quantized layouts share plane names, so only
+    the write/gather helpers differ)."""
     cos, sin = _rope(cfg)
     x = params["embed"][tokens].astype(cfg.dtype)
     n, t = tokens.shape
     pos2d = positions[:, None] + jnp.arange(t)[None]
     total = positions + t
-    quant = isinstance(cache, QPagedKVCache)
+    q4c = isinstance(cache, Q4PagedKVCache)
+    quant = q4c or isinstance(cache, QPagedKVCache)
+    wpp = write_prompts_paged_q4 if q4c else write_prompts_paged_q
+    gkv = gather_kv_q4 if q4c else gather_kv_q
+    out_cls = Q4PagedKVCache if q4c else QPagedKVCache
 
     def body(x, xs):
         if quant:
@@ -521,10 +532,10 @@ def verify_step_paged(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
         q = apply_rope(q, pos2d, cos, sin)
         k = apply_rope(k, pos2d, cos, sin)
         if quant:
-            k_layer, ks_l = write_prompts_paged_q(k_layer, ks_l, table, k, positions)
-            v_layer, vs_l = write_prompts_paged_q(v_layer, vs_l, table, v, positions)
-            gkq, gks = gather_kv_q(k_layer, ks_l, table)
-            gvq, gvs = gather_kv_q(v_layer, vs_l, table)
+            k_layer, ks_l = wpp(k_layer, ks_l, table, k, positions)
+            v_layer, vs_l = wpp(v_layer, vs_l, table, v, positions)
+            gkq, gks = gkv(k_layer, ks_l, table)
+            gvq, gvs = gkv(v_layer, vs_l, table)
             k_view = dequantize_view(gkq, gks, cfg.dtype)
             v_view = dequantize_view(gvq, gvs, cfg.dtype)
         else:
@@ -541,7 +552,7 @@ def verify_step_paged(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
     if quant:
         xs = (params["blocks"], cache.k, cache.ks, cache.v, cache.vs)
         x, (new_k, new_ks, new_v, new_vs) = lax.scan(body, x, xs)
-        out_cache = QPagedKVCache(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
+        out_cache = out_cls(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
     else:
         x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
         out_cache = PagedKVCache(k=new_k, v=new_v)
@@ -562,6 +573,15 @@ def make_paged_cache_q(cfg: LlamaConfig, pages: int, page_size: int = 128) -> QP
     """int8 paged pool (ops.paged.QPagedKVCache): prefill_paged /
     decode_step_paged branch on the cache type, like the slot layout."""
     return QPagedKVCache.create(
+        cfg.num_layers, pages, page_size, cfg.num_kv_heads, cfg.head_size,
+    )
+
+
+def make_paged_cache_q4(cfg: LlamaConfig, pages: int, page_size: int = 128) -> Q4PagedKVCache:
+    """Packed-int4 paged pool (ops.paged.Q4PagedKVCache): same plane names
+    as the int8 pool so the scan xs plumbing is shared; only the per-plane
+    write/gather/attention helpers differ (cache-type branch)."""
+    return Q4PagedKVCache.create(
         cfg.num_layers, pages, page_size, cfg.num_kv_heads, cfg.head_size,
     )
 
@@ -594,7 +614,12 @@ def prefill_paged(
     chunked = offsets is not None
     # pages holding THIS chunk's writes: logical pages off//page .. (off+s)//page
     total = off + lengths  # [B] cache length after this chunk
-    quant = isinstance(cache, QPagedKVCache)
+    q4c = isinstance(cache, Q4PagedKVCache)
+    quant = q4c or isinstance(cache, QPagedKVCache)
+    wpp = write_prompts_paged_q4 if q4c else write_prompts_paged_q
+    gkv = gather_kv_q4 if q4c else gather_kv_q
+    fq = fake_quant_row_int4 if q4c else fake_quant_row
+    out_cls = Q4PagedKVCache if q4c else QPagedKVCache
 
     def body(x, xs):
         if quant:
@@ -606,10 +631,10 @@ def prefill_paged(
         k = apply_rope(k, positions, cos, sin)
         if chunked:
             if quant:
-                k_layer, ks_l = write_prompts_paged_q(k_layer, ks_l, pages, k, off)
-                v_layer, vs_l = write_prompts_paged_q(v_layer, vs_l, pages, v, off)
-                gkq, gks = gather_kv_q(k_layer, ks_l, pages)
-                gvq, gvs = gather_kv_q(v_layer, vs_l, pages)
+                k_layer, ks_l = wpp(k_layer, ks_l, pages, k, off)
+                v_layer, vs_l = wpp(v_layer, vs_l, pages, v, off)
+                gkq, gks = gkv(k_layer, ks_l, pages)
+                gvq, gvs = gkv(v_layer, vs_l, pages)
                 k_view = dequantize_view(gkq, gks, cfg.dtype)
                 v_view = dequantize_view(gvq, gvs, cfg.dtype)
             else:
@@ -622,13 +647,14 @@ def prefill_paged(
             )
         else:
             if quant:
-                k_layer, ks_l = write_prompts_paged_q(k_layer, ks_l, pages, k)
-                v_layer, vs_l = write_prompts_paged_q(v_layer, vs_l, pages, v)
+                k_layer, ks_l = wpp(k_layer, ks_l, pages, k)
+                v_layer, vs_l = wpp(v_layer, vs_l, pages, v)
                 # attend to what the cache STORES (fake-quantized k/v) so a
-                # later prefix-cache hit — which reads the int8 pages — is
-                # bit-identical to this cold run (kvcache.fake_quant_row)
+                # later prefix-cache hit — which reads the quantized pages —
+                # is bit-identical to this cold run (kvcache.fake_quant_row
+                # / quant.fake_quant_row_int4)
                 attn = (attn_fn or mha_attention)(
-                    q, fake_quant_row(k), fake_quant_row(v),
+                    q, fq(k), fq(v),
                     causal=True, kv_lengths=lengths)
             else:
                 k_layer, v_layer = write_prompts_paged(k_layer, v_layer, pages, k, v)
@@ -640,7 +666,7 @@ def prefill_paged(
     if quant:
         xs = (params["blocks"], cache.k, cache.ks, cache.v, cache.vs)
         x, (new_k, new_ks, new_v, new_vs) = lax.scan(body, x, xs)
-        out_cache = QPagedKVCache(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
+        out_cache = out_cls(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
     else:
         x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
         out_cache = PagedKVCache(k=new_k, v=new_v)
@@ -662,7 +688,11 @@ def decode_step_paged(
     x = params["embed"][tokens].astype(cfg.dtype)  # [N,E]
     n = tokens.shape[0]
     pos1 = positions[:, None]
-    quant = isinstance(cache, QPagedKVCache)
+    q4c = isinstance(cache, Q4PagedKVCache)
+    quant = q4c or isinstance(cache, QPagedKVCache)
+    atp = append_tokens_paged_q4 if q4c else append_tokens_paged_q
+    pda = paged_decode_attention_q4 if q4c else paged_decode_attention_q
+    out_cls = Q4PagedKVCache if q4c else QPagedKVCache
 
     def body(x, xs):
         if quant:
@@ -674,9 +704,9 @@ def decode_step_paged(
         k = apply_rope(k, pos1, cos, sin)[:, 0]
         v = v[:, 0]
         if quant:
-            k_layer, ks_l = append_tokens_paged_q(k_layer, ks_l, table, positions, k)
-            v_layer, vs_l = append_tokens_paged_q(v_layer, vs_l, table, positions, v)
-            attn = paged_decode_attention_q(
+            k_layer, ks_l = atp(k_layer, ks_l, table, positions, k)
+            v_layer, vs_l = atp(v_layer, vs_l, table, positions, v)
+            attn = pda(
                 q, k_layer, v_layer, ks_l, vs_l, table, positions + 1)
         else:
             k_layer, v_layer = append_tokens_paged(k_layer, v_layer, table, positions, k, v)
@@ -688,7 +718,7 @@ def decode_step_paged(
     if quant:
         xs = (params["blocks"], cache.k, cache.ks, cache.v, cache.vs)
         x, (new_k, new_ks, new_v, new_vs) = lax.scan(body, x, xs)
-        out_cache = QPagedKVCache(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
+        out_cache = out_cls(k=new_k, v=new_v, ks=new_ks, vs=new_vs)
     else:
         x, (new_k, new_v) = lax.scan(body, x, (params["blocks"], cache.k, cache.v))
         out_cache = PagedKVCache(k=new_k, v=new_v)

@@ -295,6 +295,57 @@ def paged_decode_attention_q(
     return decode_attention_q(q, gkq, gvq, gks, gvs, lengths, scale=scale)
 
 
+def paged_decode_attention_q4(
+    q: jnp.ndarray,        # [N, Hq, D]
+    kq_pool: jnp.ndarray,  # uint8 [P, Hkv, page, D//2] packed nibbles
+    vq_pool: jnp.ndarray,
+    ks_pool: jnp.ndarray,  # [P, Hkv, page]
+    vs_pool: jnp.ndarray,
+    table: jnp.ndarray,    # [N, MaxP]
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    backend: str = "auto",
+) -> jnp.ndarray:
+    """paged_decode_attention over a PACKED int4 pool (ops.paged.
+    Q4PagedKVCache; ops/quant.pack_int4 split-half nibble format).
+
+    'pallas' is the FUSED kernel (ops.pallas.paged_decode.paged_decode_
+    attention_q4): packed byte pages + scale rows stream straight out of
+    the pool through the scalar-prefetched block tables; nibble unpack +
+    dequant happen in-register, so the KV HBM read is half the int8
+    kernel's. 'xla' gathers the packed views, unpacks after the gather
+    (ops.paged.gather_kv_q4), and reuses the folded-scale dense decode
+    path — correct everywhere, the parity reference for the kernel.
+    'auto' follows resolve_backend (autotune pin aware, op key
+    'paged_decode_q4' — tuned separately from int8 because the winner
+    shifts with the unpack cost on each device generation)."""
+    page = kq_pool.shape[2]
+    if resolve_backend(backend, op="paged_decode_q4") == "pallas":
+        if page % 8 == 0:
+            from gofr_tpu.ops.pallas import interpret_mode
+            from gofr_tpu.ops.pallas.paged_decode import (
+                paged_decode_attention_q4 as pallas_paged_q4,
+            )
+
+            return pallas_paged_q4(
+                q, kq_pool, vq_pool, ks_pool, vs_pool, table, lengths,
+                scale=scale, interpret=interpret_mode(),
+            )
+        if backend == "pallas":
+            # explicit requests never degrade silently (ADVICE.md round 2)
+            raise ValueError(
+                f"backend='pallas' requested but page size {page} is not a "
+                f"multiple of 8 (f32 sublane tile); use a page_size % 8 == 0 "
+                f"or backend='auto'"
+            )
+    from gofr_tpu.ops.paged import gather_kv_q4
+
+    gkq, gks = gather_kv_q4(kq_pool, ks_pool, table)
+    gvq, gvs = gather_kv_q4(vq_pool, vs_pool, table)
+    return decode_attention_q(q, gkq, gvq, gks, gvs, lengths, scale=scale)
+
+
 def paged_decode_attention(
     q: jnp.ndarray,
     k_pool: jnp.ndarray,

@@ -5,7 +5,8 @@ faster on v5e") as policy. This module replaces it with the same
 measure-then-pin philosophy GSPMD applies to sharding (PAPERS.md,
 2105.04663): at ``engine.warmup()`` each decode op in play — ``decode``
 (slot bf16), ``paged_decode`` (paged bf16), ``paged_decode_q`` (paged
-int8, the fused kernel in ops/pallas/paged_decode.py) — is timed with BOTH
+int8) and ``paged_decode_q4`` (paged packed-int4; both fused kernels live
+in ops/pallas/paged_decode.py) — is timed with BOTH
 backends on the engine's real post-sharding serving shapes, the winner is
 pinned via :func:`decision_scope`, and every trace the engine drives
 (warmup + device loop, ``engine._trace_scope``) resolves ``backend="auto"``

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from gofr_tpu.ops.kvcache import quantize_row
+from gofr_tpu.ops.quant import pack_int4, quantize_row_int4, unpack_int4
 
 # The append-lowering choice (select | scatter | pallas). Engines resolve
 # GOFR_PAGED_KV_WRITE ONCE at construction and pin it here for every trace
@@ -150,6 +151,52 @@ class QPagedKVCache:
         return self.k.shape[3]
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class Q4PagedKVCache:
+    """Packed-int4 paged pool: two nibbles per byte in the head_dim axis
+    (ops/quant.pack_int4 split-half order — byte j of a D-wide row holds
+    elements j and j + D/2) with the same per-(page, head, position) bf16
+    scale planes as the int8 layout. KV page reads quarter vs bf16 and
+    halve vs int8; the scales still fold outside the attention
+    contractions (in-kernel for the Pallas path, via the unpacked gather
+    view for XLA). Zero-initialized bytes decode to the -8 nibble pair,
+    but unwritten positions always sit behind the per-slot length mask and
+    their scale planes are zero, so no read ever sees them. Prefix caching
+    and handoff compose unchanged: a page's (packed, scale) content is a
+    deterministic function of the token prefix."""
+
+    k: jnp.ndarray   # uint8 [L, P, Hkv, page, D//2] packed nibbles
+    v: jnp.ndarray   # uint8 [L, P, Hkv, page, D//2]
+    ks: jnp.ndarray  # bf16 [L, P, Hkv, page]
+    vs: jnp.ndarray  # bf16 [L, P, Hkv, page]
+
+    @classmethod
+    def create(cls, layers: int, pages: int, page_size: int, kv_heads: int,
+               head_dim: int, dtype=None) -> "Q4PagedKVCache":
+        del dtype
+        if head_dim % 2:
+            raise ValueError(f"int4 packing needs an even head_dim, got {head_dim}")
+        shape = (layers, pages, kv_heads, page_size, head_dim // 2)
+        sshape = (layers, pages, kv_heads, page_size)
+        return cls(
+            k=jnp.zeros(shape, jnp.uint8), v=jnp.zeros(shape, jnp.uint8),
+            ks=jnp.zeros(sshape, jnp.bfloat16), vs=jnp.zeros(sshape, jnp.bfloat16),
+        )
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+
 def write_prompts_paged_q(
     cache_q: jnp.ndarray,  # int8 [P, Hkv, page, D] (one of k/v)
     cache_s: jnp.ndarray,  # [P, Hkv, page]
@@ -224,6 +271,86 @@ def gather_kv_q(
     gq = cache_q[safe].transpose(0, 2, 1, 3, 4).reshape(n, hkv, maxp * page, d)
     gs = cache_s[safe].transpose(0, 2, 1, 3).reshape(n, hkv, maxp * page)
     return gq, gs
+
+
+def write_prompts_paged_q4(
+    cache_q: jnp.ndarray,  # uint8 [P, Hkv, page, D//2] packed (one of k/v)
+    cache_s: jnp.ndarray,  # [P, Hkv, page]
+    pages: jnp.ndarray,    # [B, S_pages]
+    new: jnp.ndarray,      # [B, S, Hkv, D]
+    offsets: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """int4 analog of write_prompts_paged_q for one k/v plane: quantize to
+    nibbles, pack two-per-byte, write bytes through the block table."""
+    b, s, hkv, _ = new.shape
+    page = cache_q.shape[2]
+    q, sc = quantize_row_int4(new)  # [B,S,Hkv,D] int8, [B,S,Hkv]
+    packed = pack_int4(q)           # [B,S,Hkv,D//2] uint8
+    pos = jnp.arange(s)[None, :] + (offsets[:, None] if offsets is not None else 0)
+    pp, off = _locate(pages, pos, page)  # [B,S] each
+    rows = pp[:, :, None]
+    heads = jnp.arange(hkv)[None, None, :]
+    offs = off[:, :, None]
+    cache_q = cache_q.at[rows, heads, offs].set(packed)
+    cache_s = cache_s.at[rows, heads, offs].set(sc.astype(cache_s.dtype))
+    return cache_q, cache_s
+
+
+def append_tokens_paged_q4(
+    cache_q: jnp.ndarray,   # uint8 [P, Hkv, page, D//2] packed
+    cache_s: jnp.ndarray,   # [P, Hkv, page]
+    table: jnp.ndarray,     # [N, MaxP]
+    positions: jnp.ndarray, # [N]
+    new: jnp.ndarray,       # [N, Hkv, D]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """int4 analog of append_tokens_paged_q for one k/v plane, honoring the
+    same write-mode lowering switch. The one-hot fold runs in f32 over the
+    PACKED bytes and casts back — uint8 magnitudes <= 255 are exact in
+    f32, so the byte round-trips losslessly."""
+    n, hkv, d2 = new.shape[0], new.shape[1], cache_q.shape[3]
+    p_total, _, page, _ = cache_q.shape
+    q, sc = quantize_row_int4(new)  # [N,Hkv,D] int8, [N,Hkv] f32
+    packed = pack_int4(q)           # [N,Hkv,D//2] uint8
+    pp, off = _locate(table, positions[:, None], page)
+    pp, off = pp[:, 0], off[:, 0]
+
+    if resolve_write_mode() != "scatter":
+        flat = pp * page + off  # OOB rows land >= p_total*page
+        grid = jnp.arange(p_total * page)
+        m = flat[:, None] == grid[None, :]  # [N, P*page]
+        any_m = m.reshape(n, p_total, page).any(axis=0)
+        mf = m.astype(jnp.float32)
+        upd = jnp.einsum("np,nhd->phd", mf, packed.astype(jnp.float32))
+        upd = upd.reshape(p_total, page, hkv, d2).transpose(0, 2, 1, 3)
+        cache_q = jnp.where(any_m[:, None, :, None], upd.astype(jnp.uint8), cache_q)
+        upd_s = jnp.einsum("np,nh->ph", mf, sc).reshape(p_total, page, hkv)
+        cache_s = jnp.where(any_m[:, None, :],
+                            upd_s.transpose(0, 2, 1).astype(cache_s.dtype), cache_s)
+        return cache_q, cache_s
+
+    rows = pp[:, None]
+    heads = jnp.arange(hkv)[None, :]
+    cache_q = cache_q.at[rows, heads, off[:, None]].set(packed)
+    cache_s = cache_s.at[rows, heads, off[:, None]].set(sc.astype(cache_s.dtype))
+    return cache_q, cache_s
+
+
+def gather_kv_q4(
+    cache_q: jnp.ndarray,  # uint8 [P, Hkv, page, D//2] packed
+    cache_s: jnp.ndarray,  # [P, Hkv, page]
+    table: jnp.ndarray,    # [N, MaxP]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Logical ([N, Hkv, MaxP*page, D] int8 in [-8, 7], [N, Hkv, MaxP*page]
+    scale) views of each slot's packed cache — the XLA read path unpacks
+    AFTER the gather so HBM reads stay packed; the unpacked view feeds the
+    same ``decode_attention_q`` contraction the int8 layout uses."""
+    n, maxp = table.shape
+    _, hkv, page, d2 = cache_q.shape
+    safe = jnp.minimum(table, cache_q.shape[0] - 1)
+
+    gq = cache_q[safe].transpose(0, 2, 1, 3, 4).reshape(n, hkv, maxp * page, d2)
+    gs = cache_s[safe].transpose(0, 2, 1, 3).reshape(n, hkv, maxp * page)
+    return unpack_int4(gq), gs
 
 
 def write_prompts_paged(
@@ -316,10 +443,11 @@ def append_tokens_paged(
 #
 # The engine's host-DRAM cache tier (tpu/prefix.py, docs/serving.md) moves
 # whole pages between the pool and host memory. Both helpers work on the
-# cache PYTREE (PagedKVCache or QPagedKVCache), so one definition covers the
-# bf16 layout (k/v planes) and the int8 layout (k/v int8 + ks/vs scale
-# planes) — every plane is [L, P, ...page-slice dims...] and the page axis
-# is always axis 1.
+# cache PYTREE (PagedKVCache, QPagedKVCache, or Q4PagedKVCache), so one
+# definition covers the bf16 layout (k/v planes), the int8 layout, and the
+# packed-int4 layout (k/v bytes + ks/vs scale planes) — every plane is
+# [L, P, ...page-slice dims...] and the page axis is always axis 1. Packed
+# int4 pages spill/swap as opaque uint8 bytes; no repack is ever needed.
 
 
 @jax.jit

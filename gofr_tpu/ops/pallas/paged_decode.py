@@ -23,6 +23,14 @@ exactly where the XLA path folds them (ops.attention.decode_attention_q).
 No gather-materialized logical view exists anywhere: HBM traffic for the
 most bandwidth-bound op in the system stays int8 end to end, where the
 XLA fallback pays a full extra int8 round trip for the gather copy.
+
+``paged_decode_attention_q4`` (ISSUE 13) extends the same discipline to
+PACKED int4 pages: the pool stores two nibbles per byte ([P, Hkv, page,
+D//2] uint8, ops/quant.pack_int4 split-half order), the kernel streams the
+packed bytes through the identical scalar-prefetched block tables, and the
+nibble unpack + dequant happen in-register — HBM reads per KV token halve
+again vs int8. The scale folds are byte-for-byte the int8 kernel's: ks on
+the scores after the QK matmul, vs inside the online-softmax recurrence.
 """
 
 from __future__ import annotations
@@ -230,6 +238,129 @@ def paged_decode_attention_q(
                 pl.BlockSpec((1, 1, group, d), lambda bi, hi, pi, ln, tb: (bi, hi, 0, 0)),
                 pl.BlockSpec((1, 1, page, d), kv_map),
                 pl.BlockSpec((1, 1, page, d), kv_map),
+                pl.BlockSpec((1, 1, page), sc_map),
+                pl.BlockSpec((1, 1, page), sc_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, group, d), lambda bi, hi, pi, ln, tb: (bi, hi, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((group, d), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+                pltpu.VMEM((group, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, hkv, group, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), safe_table, q4, kq_pool, vq_pool, ks_pool, vs_pool)
+    return out.reshape(n, hq, d)
+
+
+def _paged_decode_q4_kernel(
+    ln_ref,    # SMEM [N] per-slot live length (scalar prefetch)
+    table_ref, # SMEM [N, MaxP] block table (scalar prefetch)
+    q_ref,     # VMEM [1, 1, G, d]
+    k_ref,     # VMEM uint8 [1, 1, page, d//2] packed nibbles (index_map page)
+    v_ref,     # VMEM uint8 [1, 1, page, d//2]
+    ks_ref,    # VMEM [1, 1, page] per-position K scales (same page pick)
+    vs_ref,    # VMEM [1, 1, page]
+    o_ref,     # VMEM [1, 1, G, d]
+    acc_ref,   # scratch f32 [G, d]
+    m_ref,     # scratch f32 [G, 128]
+    l_ref,     # scratch f32 [G, 128]
+    *,
+    scale: float,
+    page: int,
+    n_pages: int,
+    group: int,
+):
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+    init_softmax_scratch(pi, acc_ref, m_ref, l_ref)
+
+    def unpack(b):
+        # split-half nibble unpack (ops/quant.unpack_int4, inlined on the
+        # int32 VPU): byte j holds elements j (low) and j + d/2 (high),
+        # each biased +8 — so the unpacked [page, d] tile is a concatenate
+        # of two contiguous nibble planes, no interleave shuffle needed
+        bi32 = b.astype(jnp.int32)
+        return jnp.concatenate([(bi32 & 0xF) - 8, ((bi32 >> 4) & 0xF) - 8], axis=-1)
+
+    q = q_ref[0, 0]                              # [G, d]
+    k = unpack(k_ref[0, 0]).astype(q.dtype)      # packed → [page, d] nibbles
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [G, page]
+    # K-scale fold: identical order to the int8 kernel — constant along the
+    # d reduction, multiplies the finished scores per key position.
+    s = s * ks_ref[0, 0].astype(jnp.float32)[None, :]
+
+    kv_pos = pi * page + jax.lax.broadcasted_iota(jnp.int32, (group, page), 1)
+    s = jnp.where(kv_pos < ln_ref[bi], s, NEG_INF)
+
+    # V-scale fold inside the recurrence (common.py), with V unpacked from
+    # nibbles in-register — the PV matmul input converts to f32 there.
+    softmax_block_update(s, unpack(v_ref[0, 0]), acc_ref, m_ref, l_ref,
+                         v_scale=vs_ref[0, 0])
+
+    def write(out):
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+    softmax_finish(pi, n_pages, acc_ref, l_ref, write)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_decode_attention_q4(
+    q: jnp.ndarray,        # [N, Hq, D]
+    kq_pool: jnp.ndarray,  # uint8 [P, Hkv, page, D//2] packed nibbles
+    vq_pool: jnp.ndarray,  # uint8 [P, Hkv, page, D//2]
+    ks_pool: jnp.ndarray,  # [P, Hkv, page] per-position K scales
+    vs_pool: jnp.ndarray,  # [P, Hkv, page]
+    table: jnp.ndarray,    # [N, MaxP] int32, OOB entries == P
+    lengths: jnp.ndarray,  # [N] live length per slot
+    *,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused single-step decode against the PACKED int4 pool → [N, Hq, D].
+
+    Same contract as ops.attention.paged_decode_attention_q4, without the
+    gather: packed nibble pages and their scale rows are block-streamed per
+    (slot, head, logical page); unpack + dequant happen in-register, so HBM
+    traffic for the KV read is the packed byte stream — half the int8
+    kernel's, a quarter of bf16's."""
+    n, hq, d = q.shape
+    pool, hkv, page, d2 = kq_pool.shape
+    _, maxp = table.shape
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not divisible by kv heads {hkv}")
+    if d2 * 2 != d:
+        raise ValueError(f"packed head_dim {d2}*2 != query head_dim {d}")
+    group = hq // hkv
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+
+    q4 = q.reshape(n, hkv, group, d)
+    safe_table = jnp.minimum(table, pool - 1).astype(jnp.int32)
+
+    def kv_map(bi, hi, pi, ln_ref, table_ref):
+        return (table_ref[bi, pi], hi, 0, 0)
+
+    def sc_map(bi, hi, pi, ln_ref, table_ref):
+        return (table_ref[bi, pi], hi, 0)
+
+    kernel = functools.partial(
+        _paged_decode_q4_kernel, scale=scale, page=page, n_pages=maxp, group=group
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(n, hkv, maxp),
+            in_specs=[
+                pl.BlockSpec((1, 1, group, d), lambda bi, hi, pi, ln, tb: (bi, hi, 0, 0)),
+                pl.BlockSpec((1, 1, page, d2), kv_map),
+                pl.BlockSpec((1, 1, page, d2), kv_map),
                 pl.BlockSpec((1, 1, page), sc_map),
                 pl.BlockSpec((1, 1, page), sc_map),
             ],

@@ -1,4 +1,5 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only int8 quantization for serving, plus the int4 row primitives
+the paged KV cache packs with.
 
 Decode is HBM-bandwidth-bound on weight reads (every step re-reads the full
 parameter set), so storing linear weights as int8 with per-output-channel
@@ -11,6 +12,14 @@ the dequant multiply fuses into the matmul consumer).
 donation / sharding like plain arrays. Quantize AFTER sharding
 (``build_engine`` does) so logical-axis rules apply to the original tree;
 the quantized arrays inherit shardings from the computation.
+
+The int4 helpers at the bottom (``quantize_row_int4`` / ``pack_int4`` /
+``unpack_int4`` / ``fake_quant_row_int4``) are the single definition of the
+packed-nibble format the int4 paged KV pool (ops/paged.Q4PagedKVCache), the
+fused Pallas decode kernel (ops/pallas/paged_decode.py), and the XLA gather
+fallback all share — any asymmetry between pack and unpack would silently
+corrupt KV reads, so both directions live next to each other here and are
+round-tripped by the unit tests.
 """
 
 from __future__ import annotations
@@ -90,3 +99,58 @@ def quantized_bytes(params) -> int:
     for leaf in jax.tree.leaves(params):
         total += leaf.size * leaf.dtype.itemsize
     return total
+
+
+# -- int4 row quantization (packed KV pages; ops/paged.Q4PagedKVCache) ----------
+#
+# Symmetric per-row int4 over the last (head_dim) axis, mirroring
+# kvcache.quantize_row's int8 contract but with the [-7, 7] range (the -8
+# code is reserved so the symmetric scale max|x|/7 round-trips 0 exactly and
+# negation stays lossless). Two values pack per byte in SPLIT-HALF order:
+# byte j of a D-element row holds elements j (low nibble) and j + D/2 (high
+# nibble), each stored biased by +8 so the byte is plain uint8 arithmetic —
+# no sign-extension subtleties in either XLA or Mosaic. Split-half (rather
+# than interleaved even/odd) keeps the unpack a single concatenate of two
+# contiguous nibble planes, which lowers to cheap vector ops on both
+# backends.
+
+
+def quantize_row_int4(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int4 over the last axis: returns (q int8 in [-7, 7],
+    scale[...] f32 without the reduced axis). Pack with ``pack_int4``."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 7.0
+    q = jnp.clip(jnp.round(xf / s[..., None]), -7, 7).astype(jnp.int8)
+    return q, s
+
+
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """[..., D] int8 nibbles in [-8, 7] → [..., D//2] uint8. Byte j =
+    (q[j] + 8) | ((q[j + D/2] + 8) << 4). The uint8 cast happens BEFORE the
+    shift: a biased high nibble reaches 15 << 4 = 240, which would overflow
+    int8 arithmetic."""
+    d = q.shape[-1]
+    lo = (q[..., : d // 2] + 8).astype(jnp.uint8)
+    hi = (q[..., d // 2 :] + 8).astype(jnp.uint8)
+    return lo | (hi << 4)
+
+
+def unpack_int4(b: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of ``pack_int4``: [..., D//2] uint8 → [..., D] int8 in
+    [-8, 7] (split-half order: low nibbles first, then high)."""
+    bi = b.astype(jnp.int32)
+    return jnp.concatenate(
+        [(bi & 0xF) - 8, ((bi >> 4) & 0xF) - 8], axis=-1
+    ).astype(jnp.int8)
+
+
+def fake_quant_row_int4(x: jnp.ndarray, dtype=None,
+                        scale_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Round-trip ``x`` through int4 row quantization exactly as the packed
+    pool stores and the read path dequantizes it (scale through the cache's
+    bf16 scale dtype) — the int4 analog of kvcache.fake_quant_row, used by
+    whole-prompt paged prefill so cold prompts attend to what a later
+    prefix-cache hit will read."""
+    q, s = quantize_row_int4(x)
+    out_dtype = dtype or x.dtype
+    return q.astype(out_dtype) * s.astype(scale_dtype)[..., None].astype(out_dtype)

@@ -9,16 +9,20 @@ plain decode, ``eng._spec_carry`` for speculative rounds), and the
 emit/finish callbacks.
 
 Every asynchronous device call rides ``eng._dq``: plain decode chunks and
-slot-layout speculative rounds (dispatched here), plus batched and
+speculative rounds on BOTH layouts (dispatched here), plus batched and
 chunked prefills (dispatched by ``engine._admit``/``_advance_chunked``).
 ``process_decode`` dequeues the OLDEST entry, blocks on its readback —
 overlapping every younger dispatch's compute — and folds the result into
 slot state. Decode can pipeline because the data-dependent state (token,
 hlen, token history) is device-resident — the host never needs chunk
 t-1's output to assemble chunk t; prefill can because the prompt is
-host-known. Paged-layout spec is the one synchronous discipline left:
-page allocation depends on data-dependent position advance the host only
-learns at readback.
+host-known. Paged spec used to be the one synchronous discipline left
+(page allocation depended on acceptance counts the host only learned at
+readback); ``dispatch_spec_paged`` breaks that dependency by OVER-
+CLAIMING pages for the worst-case accepted span at dispatch time and
+releasing the rejected surplus at fold time (``_fold_spec`` →
+``engine._trim_lane_pages``), so paged spec rounds overlap prefill
+chunks and other in-flight work exactly like the slot layout's.
 """
 
 from __future__ import annotations
@@ -70,6 +74,14 @@ def _fold_spec(eng, toks, accs, meta, k) -> None:
                     break
             if eng.slots[i] is not s:
                 break
+        if (eng.kv_layout == "paged" and eng.slots[i] is s
+                and s.inflight == 0):
+            # release the over-claim's rejected surplus — safe only with
+            # no round in flight for this lane: an in-flight dispatch's
+            # table snapshot may write to any page claimed at its
+            # dispatch (dispatch_spec_paged over-claims for the
+            # worst-case accepted span)
+            eng._trim_lane_pages(i, s, max(s.pos - 1, 0))
     eng.metrics.increment_counter("app_tpu_tokens_total", emitted)
     # proposed counts only lanes whose acceptance was folded — a lane
     # discarded mid-flight (freed/preempted/cancelled) contributes to
@@ -79,68 +91,80 @@ def _fold_spec(eng, toks, accs, meta, k) -> None:
     eng.metrics.increment_counter("app_tpu_spec_accepted", accepted)
 
 
-def spec_round(eng) -> bool:
-    """One synchronous PAGED-layout speculative round: ``decode_chunk``
-    outer steps, each drafting ``spec_tokens`` continuation tokens by
-    prompt lookup and verifying them with ONE target forward
-    (family.verify_step_paged). Acceptance is distribution-exact
-    rejection sampling (programs.speculative_sample) — greedy requests
-    are its temperature-0 case and stay bit-identical to plain greedy
-    decode; each round trip yields up to decode_chunk*(spec_tokens+1)
-    tokens per slot. Synchronous because the next round's page
-    allocation depends on this round's acceptance counts. (The slot
-    layout pipelines instead — dispatch_spec.)"""
+def dispatch_spec_paged(eng) -> bool:
+    """Assemble and asynchronously dispatch one PAGED-layout speculative
+    round onto the unified in-flight queue — the paged twin of
+    ``dispatch_spec``, with the same ``[token, hlen, use_host, temps,
+    step]`` carry arbitration plus the block-table rows (packed
+    ``[5 + Wp, n]``; tpu/programs.py docstring). Token history lives in
+    the cache pytree (kv, hist); prefill seeded it, the spec program
+    maintains it — the old synchronous round shipped O(Hcap) history per
+    lane per round.
+
+    What used to force paged spec synchronous was page allocation: the
+    host only learns acceptance counts at readback. This dispatcher
+    breaks the dependency by OVER-CLAIMING — every dispatch grows the
+    lane's table to cover its worst case, ``pos + chunk_span *
+    (inflight + 1) - 1`` (each un-folded in-flight round may advance pos
+    by a full chunk_span) — and the fold releases the rejected surplus
+    once the lane has no round in flight (``_fold_spec`` →
+    ``engine._trim_lane_pages``). Lanes whose worst-case position
+    reaches max_total are masked until their in-flight rounds process,
+    the same single-chunk_span cache-slack bound plain pipelined decode
+    relies on."""
     with eng._state_lock:
-        lanes = [(i, eng.slots[i]) for i in eng._active()
-                 if eng.slots[i].pos < eng.slots[i].max_total]
-        if not lanes:
-            return False
         n = eng.num_slots
         k = eng.decode_chunk
-        # every round writes up to chunk_span positions past pos —
-        # allocate pages for the worst case NOW (the device cannot
-        # allocate mid-chunk)
+        span = eng._chunk_span
+        Wp = eng.pages_per_slot
+        Hcap = Wp * eng.page_size
+        lanes = []
+        for i in eng._active():
+            s = eng.slots[i]
+            if s.pos + span * s.inflight >= s.max_total:
+                continue  # masked until in-flight rounds process
+            lanes.append((i, s))
+        if not lanes:
+            return False
+        # claim pages covering the full worst case NOW (the device
+        # cannot allocate mid-chunk, and the fold that would refine the
+        # estimate hasn't happened yet — that's the point)
         for i, s in list(lanes):
-            eng._alloc_lane_pages(i, s, s.pos + eng._chunk_span - 1)
+            eng._alloc_lane_pages(i, s, s.pos + span * (s.inflight + 1) - 1)
         lanes = [(i, s) for i, s in lanes if eng.slots[i] is s]
         if not lanes:
             return True  # preemption work happened
-        W = eng.pages_per_slot
-        H = W * eng.page_size
-        packed = eng._staging("spec_round", (4 + W + H, n))
-        packed[1, :] = H + 1  # inactive lanes: every write lands OOB
+        packed = eng._staging("spec", (5 + Wp, n))
+        packed[1, :] = Hcap + 1  # inactive: every hist/cache write lands OOB
+        packed[2, :] = 1         # inactive lanes are host-arbitrated
         temps = np.zeros((n,), np.float32)
-        packed[4:4 + W] = eng._masked_table({i for i, _ in lanes}).T
+        packed[5:] = eng._masked_table({i for i, _ in lanes}).T
         for i, s in lanes:
-            hist = np.concatenate([
-                np.asarray(s.prompt_tokens, np.int32),
-                np.asarray(s.generated, np.int32),
-            ])
-            packed[0, i] = s.last_token
-            packed[1, i] = hist.shape[0]  # == s.pos + 1
-            packed[4 + W:4 + W + hist.shape[0], i] = hist
+            if s.inflight == 0:
+                # host knows this lane's exact (token, hlen) — it just
+                # (re)joined from prefill or a fully-processed round
+                packed[0, i] = s.last_token
+                packed[1, i] = s.pos + 1
+            else:
+                packed[2, i] = 0  # device carry owns (token, hlen)
             temps[i] = float(s.request.kw.get("temperature", 0.0))
-        packed[2] = temps.view(np.int32)
+        packed[3] = temps.view(np.int32)
         eng._step_count += 1
-        packed[3, 0] = eng._step_count
+        packed[4, 0] = eng._step_count
+        for _, s in lanes:
+            s.inflight += 1
         occupancy = len(lanes) / n
-        eng._inflight = [s.request for _, s in lanes]
         t0 = time.monotonic()
 
-    eng._announce(TAG_SPEC, packed.shape[0], 0, packed)
-    toks_dev, accs_dev, eng.cache = eng._spec_chunk_fn(
-        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed))
-    toks = np.asarray(toks_dev)  # [k, n, g+1] int32 — tokens, never logits
-    accs = np.asarray(accs_dev)  # [k, n]
-
-    with eng._state_lock:
-        eng._inflight = []
-        if eng._poisoned or eng._stop.is_set():
-            return True
-        eng._record_step("decode_spec", time.monotonic() - t0, occupancy,
-                          ("decode_spec", n, k, eng.spec_tokens))
-        _fold_spec(eng, toks, accs, lanes, k)
-        return True
+    eng._announce(TAG_SPEC, packed.shape[0], 1, packed)  # b=1: live, carry applies
+    carry = eng._spec_carry
+    if carry is None:
+        carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
+    toks_dev, accs_dev, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
+        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), carry)
+    eng._dq.append(("spec", (toks_dev, accs_dev), [(i, s) for i, s in lanes],
+                    t0, occupancy, ("decode_spec", n, k, eng.spec_tokens)))
+    return True
 
 
 def dispatch_spec(eng) -> bool:
@@ -188,7 +212,7 @@ def dispatch_spec(eng) -> bool:
         occupancy = len(lanes) / n
         t0 = time.monotonic()
 
-    eng._announce(TAG_SPEC, 1, 0, packed)  # slot spec: a=1 → [5, n] payload
+    eng._announce(TAG_SPEC, packed.shape[0], 1, packed)  # b=1: live, carry applies
     carry = eng._spec_carry
     if carry is None:
         carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))

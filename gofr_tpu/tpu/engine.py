@@ -66,8 +66,8 @@ from gofr_tpu.tpu import executor
 from gofr_tpu.tpu.executor import (
     dispatch_decode,
     dispatch_spec,
+    dispatch_spec_paged,
     process_decode,
-    spec_round,
 )
 from gofr_tpu.tpu.programs import build_programs
 
@@ -1018,13 +1018,23 @@ class GenerateEngine(_EngineBase):
                 "ENGINE_ROLE prefill/decode cannot combine with lockstep")
         self.role = role
 
-        if kv_quantize and kv_quantize != "int8":
-            raise ValueError(f"kv_quantize={kv_quantize!r}: only 'int8' is supported")
+        if kv_quantize not in ("", "int8", "int4"):
+            raise ValueError(
+                f"kv_quantize={kv_quantize!r}: use '', 'int8' or 'int4'")
+        if kv_quantize == "int4" and kv_layout != "paged":
+            # int4 exists as a PAGE format (two nibbles per byte packed
+            # along head_dim; ops/paged.Q4PagedKVCache) — the slot layout
+            # keeps int8 as its only quantized option
+            raise ValueError(
+                "kv_quantize='int4' needs kv_layout='paged' (packed-nibble "
+                "pages); the slot layout supports '' or 'int8'")
         if kv_layout == "paged":
-            if kv_quantize and not hasattr(family, "make_paged_cache_q"):
+            kvq_attr = ("make_paged_cache_q4" if kv_quantize == "int4"
+                        else "make_paged_cache_q")
+            if kv_quantize and not hasattr(family, kvq_attr):
                 raise ValueError(
-                    f"family {getattr(family, '__name__', family)!r} has no int8 "
-                    "paged-KV support"
+                    f"family {getattr(family, '__name__', family)!r} has no "
+                    f"{kv_quantize} paged-KV support ({kvq_attr})"
                 )
             self.kv_quantize = kv_quantize
             # Paged cache (ops.paged): HBM scales with tokens in flight, not
@@ -1083,7 +1093,7 @@ class GenerateEngine(_EngineBase):
             # per-page host-copy footprint across every cache plane (k/v for
             # bf16; k/v/ks/vs for int8) — the page axis is always axis 1
             self._page_bytes = sum(
-                leaf.nbytes // self.total_pages for leaf in jax.tree.leaves(self.cache)
+                leaf.nbytes // self.total_pages for leaf in jax.tree.leaves(self.kv_cache)
             )
             host_budget = int(host_mb * (1 << 20))
             if host_budget and host_budget < self._page_bytes:
@@ -1109,7 +1119,7 @@ class GenerateEngine(_EngineBase):
                     "ENGINE_ROLE=decode needs the prefix cache with a host "
                     "tier (the handoff import target); keep "
                     "ENGINE_PREFIX_CACHE on")
-            self._cache_treedef = jax.tree.structure(self.cache)
+            self._cache_treedef = jax.tree.structure(self.kv_cache)
             # swap-in upload widths: a power-of-two bucket ladder like the
             # prefill buckets — one compiled upload program per bucket, and
             # a 1-page hit never ships pages_per_slot pages of zero padding
@@ -1133,7 +1143,7 @@ class GenerateEngine(_EngineBase):
                 from gofr_tpu.ops.paged import gather_page
 
                 jax.block_until_ready(
-                    jax.tree.leaves(gather_page(self.cache, jnp.int32(0)))[0])
+                    jax.tree.leaves(gather_page(self.kv_cache, jnp.int32(0)))[0])
             self._set_prefix_gauges()  # authoritative from construction on
         else:
             # cache headroom so a chunk never writes past Smax; round to a
@@ -1400,9 +1410,21 @@ class GenerateEngine(_EngineBase):
             lengths = jnp.full((n,), maxp * page, jnp.int32)
             q = jnp.asarray(rng.standard_normal((n, hq, d)), qdtype)
             skey = autotune.shape_key(n, hq, hkv, d, page, maxp, pool)
-            if self.kv_quantize:
-                kq, vq = self.cache.k[0], self.cache.v[0]
-                ks, vs = self.cache.ks[0], self.cache.vs[0]
+            kv = self.kv_cache  # spec mode wraps the pool in (kv, hist)
+            if self.kv_quantize == "int4":
+                kq, vq = kv.k[0], kv.v[0]  # packed uint8, last dim d//2
+                ks, vs = kv.ks[0], kv.vs[0]
+                cands = {"xla": self._at_fn(
+                    attn_ops.paged_decode_attention_q4, "xla",
+                    q, kq, vq, ks, vs, table, lengths)}
+                if pallas_ok and page % 8 == 0:
+                    cands["pallas"] = self._at_fn(
+                        attn_ops.paged_decode_attention_q4, "pallas",
+                        q, kq, vq, ks, vs, table, lengths)
+                tuner.measure("paged_decode_q4", skey, "int4", cands)
+            elif self.kv_quantize:
+                kq, vq = kv.k[0], kv.v[0]
+                ks, vs = kv.ks[0], kv.vs[0]
                 cands = {"xla": self._at_fn(
                     attn_ops.paged_decode_attention_q, "xla",
                     q, kq, vq, ks, vs, table, lengths)}
@@ -1412,7 +1434,7 @@ class GenerateEngine(_EngineBase):
                         q, kq, vq, ks, vs, table, lengths)
                 tuner.measure("paged_decode_q", skey, "int8", cands)
             else:
-                kp, vp = self.cache.k[0], self.cache.v[0]
+                kp, vp = kv.k[0], kv.v[0]
                 cands = {"xla": self._at_fn(
                     attn_ops.paged_decode_attention, "xla",
                     q, kp, vp, table, lengths)}
@@ -1447,11 +1469,14 @@ class GenerateEngine(_EngineBase):
         autotune.set_last_report(self._autotune)
         for op, rec in tuner.decisions.items():
             # info-style gauge: 1 on the pinned (op, backend) pair, 0 on
-            # the loser so a re-tune never leaves both labels asserted
+            # the loser so a re-tune never leaves both labels asserted.
+            # kv_dtype rides as a label so a kv-dtype A/B (bf16/int8/int4
+            # arms pin DIFFERENT ops) stays distinguishable in one scrape.
             for b in ("pallas", "xla"):
                 self.metrics.set_gauge(
                     "app_tpu_kernel_backend",
-                    1.0 if b == rec["backend"] else 0.0, op=op, backend=b)
+                    1.0 if b == rec["backend"] else 0.0, op=op, backend=b,
+                    kv_dtype=str(rec.get("kv_dtype", "")))
             self.logger.infof(
                 "autotune: %s -> %s (%s, shapes %s, %s)", op, rec["backend"],
                 rec["source"], rec["shape"], rec.get("timings_ms") or "untimed")
@@ -1828,12 +1853,34 @@ class GenerateEngine(_EngineBase):
             return (kv, jnp.zeros((self.num_slots, self._cache_len), jnp.int32))
         return kv
 
+    @property
+    def kv_cache(self):
+        """The KV pool alone, regardless of whether the live cache is the
+        bare pool or the (kv, hist) 2-tuple spec decoding wraps around it.
+        Page-granular plumbing (page-byte accounting, gather_page eviction
+        and handoff export, swap-in protos) targets the pool only — the
+        history plane is slot-indexed, not page-indexed."""
+        return self.cache[0] if isinstance(self.cache, tuple) else self.cache
+
     def _build_paged_cache(self):
         """One construction site for ctor AND crash-restart rebuild: the
-        two must always agree on the cache kind (int8 vs dense)."""
-        make = (self.family.make_paged_cache_q if self.kv_quantize
-                else self.family.make_paged_cache)
-        return make(self.cfg, self.total_pages, self.page_size)
+        two must always agree on the cache kind (int4 vs int8 vs dense).
+        With speculative decoding on, the paged cache is the same 2-tuple
+        pytree the slot layout uses — (kv, hist), hist [num_slots, Hcap]
+        int32 with Hcap = pages_per_slot * page_size — so the device keeps
+        the prompt-lookup history and spec rounds ride the pipeline without
+        the host shipping history rows every dispatch (tpu/programs.py)."""
+        if self.kv_quantize == "int4":
+            make = self.family.make_paged_cache_q4
+        elif self.kv_quantize:
+            make = self.family.make_paged_cache_q
+        else:
+            make = self.family.make_paged_cache
+        kv = make(self.cfg, self.total_pages, self.page_size)
+        if self.spec_tokens:
+            hcap = self.pages_per_slot * self.page_size
+            return (kv, jnp.zeros((self.num_slots, hcap), jnp.int32))
+        return kv
 
     def _ref_page(self, p: int) -> None:
         self._page_refs[p] += 1
@@ -1940,7 +1987,7 @@ class GenerateEngine(_EngineBase):
                 from gofr_tpu.ops.paged import gather_page
 
                 payload = tuple(
-                    jax.tree.leaves(gather_page(self.cache, jnp.int32(p)))
+                    jax.tree.leaves(gather_page(self.kv_cache, jnp.int32(p)))
                 )
                 dropped = self._prefix.commit_spill(key, payload, self._page_bytes)
                 self._pending_spills.append((key, payload))
@@ -2108,6 +2155,29 @@ class GenerateEngine(_EngineBase):
                     "KV page pool exhausted for a single request"))
                 break
 
+    def _trim_lane_pages(self, i: int, s: "_Slot", keep_pos: int) -> int:
+        """Release lane i's TRAILING pages beyond the page holding logical
+        position ``keep_pos`` (caller holds the state lock). Only valid
+        with no round in flight for the lane — an in-flight dispatch's
+        table snapshot may write any page claimed at its dispatch time.
+        This is the fold-side release of the over-claim
+        ``decode.dispatch_spec_paged`` makes for the worst-case accepted
+        span; rejected drafts' surplus pages return to the pool here.
+        Pages also held by the prefix cache or other slots stay allocated
+        (refcount discipline). Returns the number of shares released."""
+        keep = keep_pos // self.page_size + 1
+        cur = self._slot_pages[i]
+        released = 0
+        while len(cur) > keep:
+            p = cur.pop()
+            self._table[i, len(cur)] = self.total_pages
+            self._unref_page(p)
+            released += 1
+        if released:
+            self.metrics.set_gauge(
+                "app_tpu_kv_pages_free", len(self._free_pages))
+        return released
+
     def _masked_table(self, live: set) -> np.ndarray:
         """Block-table snapshot with NON-decoding rows forced all-OOB: a
         chunk-prefilling slot owns real pages, and a uniform decode write
@@ -2236,7 +2306,7 @@ class GenerateEngine(_EngineBase):
         if not self._prefix.host_budget:
             raise ValueError("handoff import needs a host-tier budget")
         want = [((leaf.shape[0],) + tuple(leaf.shape[2:]), leaf.dtype)
-                for leaf in jax.tree.leaves(self.cache)]
+                for leaf in jax.tree.leaves(self.kv_cache)]
         for planes in payloads:
             if len(planes) != len(want):
                 raise ValueError(
@@ -2280,10 +2350,11 @@ class GenerateEngine(_EngineBase):
             # here (enqueueing their device futures) and are read back +
             # folded into slot state at DEQUEUE below — so every readback's
             # device→host round trip and host bookkeeping overlap the
-            # compute of whatever was dispatched after it. Paged-layout
-            # spec is the one synchronous discipline left: its next round's
-            # page allocation depends on data the host only learns at
-            # readback (decode.spec_round).
+            # compute of whatever was dispatched after it. Spec rounds ride
+            # the queue on BOTH layouts: the paged dispatcher over-claims
+            # pages for the worst-case accepted span at dispatch time and
+            # the fold releases the surplus, so page allocation never waits
+            # on readback (decode.dispatch_spec_paged).
             if self._chaos_step is not None:
                 self._chaos_step(step=self._step_count)
             if self._ls is not None and self._ls.has_pending():
@@ -2311,7 +2382,7 @@ class GenerateEngine(_EngineBase):
             elif self.kv_layout == "slot":
                 dispatched = dispatch_spec(self)
             else:
-                dispatched = spec_round(self)
+                dispatched = dispatch_spec_paged(self)
             busy = admitted or chunked or dispatched
             # drain to depth-1 in-flight entries while work keeps arriving
             # (each blocking readback overlaps every younger dispatch);
@@ -2669,7 +2740,7 @@ class GenerateEngine(_EngineBase):
             n = len(ready)
             nb = plan.batch_bucket
             lb = plan.len_bucket
-            w = self.pages_per_slot if self.kv_layout == "paged" else 1
+            w = executor.prefill_cols(self)
             rows = free[:n]
             table_rows = (self._table[rows].copy()
                           if self.kv_layout == "paged" else None)
@@ -3083,16 +3154,33 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
                         )
                 prefill_attn = make_seq_parallel_attn(
                     mesh, batch_axes=(), strategy=strategy)
-        # same precedent for the int8 KV cache knob
+        # same precedent for the quantized-KV knob. ENGINE_KV_DTYPE is the
+        # canonical spelling (bf16 | int8 | int4 — the bench A/B axis);
+        # ENGINE_KV_QUANTIZE ("" | int8 | int4) stays as the legacy alias.
         kvq_kw = kw.pop("kv_quantize", None)
-        kv_quantize = str(kvq_kw if kvq_kw is not None
-                          else conf.get_or_default("ENGINE_KV_QUANTIZE", ""))
-        kvq_attr = "make_cache_q" if kv_layout == "slot" else "make_paged_cache_q"
+        kvd_env = str(conf.get_or_default("ENGINE_KV_DTYPE", "")).lower()
+        if kvd_env in ("bf16", "bfloat16"):
+            kvd_env = "dense"  # sentinel: explicit request for the dense pool
+        if kvq_kw is not None:
+            kv_quantize = str(kvq_kw)
+        elif kvd_env:
+            if kvd_env not in ("dense", "int8", "int4"):
+                raise ValueError(
+                    f"ENGINE_KV_DTYPE={kvd_env!r}: use bf16, int8 or int4")
+            kv_quantize = "" if kvd_env == "dense" else kvd_env
+        else:
+            kv_quantize = str(conf.get_or_default("ENGINE_KV_QUANTIZE", ""))
+        if kv_quantize == "int4":
+            kvq_attr = "make_paged_cache_q4"
+        else:
+            kvq_attr = ("make_cache_q" if kv_layout == "slot"
+                        else "make_paged_cache_q")
         if kv_quantize and not hasattr(family, kvq_attr):
-            if kvq_kw is not None:
+            if kvq_kw is not None or kvd_env:
                 raise ValueError(
                     f"kv_quantize: family {getattr(family, '__name__', family)!r} "
-                    f"has no {kvq_attr} (int8 KV support for the {kv_layout} layout)"
+                    f"has no {kvq_attr} (quantized KV support for the "
+                    f"{kv_layout} layout)"
                 )
             container.logger.warn(
                 f"ENGINE_KV_QUANTIZE ignored for family "

@@ -31,10 +31,22 @@ import numpy as np
 from gofr_tpu.tpu.decode import (  # noqa: F401 - the decode half of the façade
     dispatch_decode,
     dispatch_spec,
+    dispatch_spec_paged,
     process_decode,
-    spec_round,
 )
 from gofr_tpu.tpu.lockstep import TAG_CHUNK, TAG_DECODE, TAG_PREFILL, TAG_SPEC
+
+
+def prefill_cols(eng) -> int:
+    """Width of the packed prefill ``rows`` block: the block-table columns
+    (paged) or the slot-id column (slot) — plus, for paged with spec on,
+    ONE trailing slot-id column so the prefill programs can seed the
+    device-resident history rows by lane (tpu/programs.py docstring).
+    Every prefill pack site (dispatch, warmup, lockstep replay) must
+    agree with build_programs' W, so they all call this."""
+    if eng.kv_layout != "paged":
+        return 1
+    return eng.pages_per_slot + (1 if eng.spec_tokens else 0)
 
 
 class PrefillPlan:
@@ -86,18 +98,25 @@ def dispatch_prefill(eng, plan: PrefillPlan) -> None:
     token/temp data rides the immutable ``plan.ready`` list, lanes and
     table rows were snapshotted under the lock."""
     nb, lb, w = plan.nb, plan.lb, plan.w
+    # block-table columns (w may add a trailing slot-id col on top)
+    wp = eng.pages_per_slot if eng.kv_layout == "paged" else 0
     packed = eng._staging("prefill", (nb, lb + w + 3))
     packed[:, lb] = 1  # padding rows: length 1
     temps = np.zeros((nb,), np.float32)
     if eng.kv_layout == "paged":
-        packed[:, lb + 1:lb + 1 + w] = eng.total_pages
+        packed[:, lb + 1:lb + 1 + wp] = eng.total_pages
+        if eng.spec_tokens:
+            # padding rows' hist seeding drops via an OOB lane id
+            packed[:, lb + 1 + wp] = eng.num_slots
     else:
         packed[:, lb + 1] = eng.num_slots
     for i, (req, toks) in enumerate(plan.ready):
         packed[i, : toks.shape[0]] = toks
         packed[i, lb] = toks.shape[0]
         if eng.kv_layout == "paged":
-            packed[i, lb + 1:lb + 1 + w] = plan.table_rows[i]
+            packed[i, lb + 1:lb + 1 + wp] = plan.table_rows[i]
+            if eng.spec_tokens:
+                packed[i, lb + 1 + wp] = plan.rows[i]
         else:
             packed[i, lb + 1] = plan.rows[i]
         temps[i] = float(req.kw.get("temperature", 0.0))
@@ -120,12 +139,15 @@ def dispatch_chunk(eng, plan: ChunkPlan) -> None:
     ``_advance_chunked``). Everything below is immutable
     (prompt_tokens) or snapshotted under the lock (table row, step)."""
     s, lb, chunk, offset = plan.slot, plan.lb, plan.chunk, plan.offset
-    w = eng.pages_per_slot if eng.kv_layout == "paged" else 1
+    w = prefill_cols(eng)
+    wp = eng.pages_per_slot if eng.kv_layout == "paged" else 0
     packed = eng._staging("chunk", (1, lb + w + 4))
     packed[0, :chunk] = s.prompt_tokens[offset:offset + chunk]
     packed[0, lb] = chunk
     if eng.kv_layout == "paged":
-        packed[0, lb + 1:lb + 1 + w] = plan.table_row
+        packed[0, lb + 1:lb + 1 + wp] = plan.table_row
+        if eng.spec_tokens:
+            packed[0, lb + 1 + wp] = plan.idx  # hist row to seed
     else:
         packed[0, lb + 1] = plan.idx
     packed[0, lb + 1 + w] = offset  # chunk offset
@@ -153,7 +175,9 @@ def dispatch_swapins(eng) -> bool:
     import time
 
     items, eng._pending_swapins = eng._pending_swapins, []
-    leaves_proto = jax.tree.leaves(eng.cache)
+    # uploads target the KV pool only (the spec history plane, when the
+    # cache is the (kv, hist) tuple, is slot-indexed — never swapped)
+    leaves_proto = jax.tree.leaves(eng.kv_cache)
     for idx, slot, keys, pids, payloads in items:
         t0 = time.monotonic()
         n = len(pids)
@@ -170,9 +194,11 @@ def dispatch_swapins(eng) -> bool:
                 buf[:, j] = payloads[j][li]
             stacked.append(buf)
         payload_tree = jax.tree.unflatten(eng._cache_treedef, stacked)
-        eng.cache, marker = swap_in_pages(
-            eng.cache, jnp.asarray(ids), payload_tree)
-        leaves_proto = jax.tree.leaves(eng.cache)
+        kv, marker = swap_in_pages(
+            eng.kv_cache, jnp.asarray(ids), payload_tree)
+        eng.cache = ((kv, eng.cache[1])
+                     if isinstance(eng.cache, tuple) else kv)
+        leaves_proto = jax.tree.leaves(kv)
         # the histogram records the ACTUAL transfer (padded width) so
         # swap-in latency and bytes stay comparable
         nbytes = w * eng._page_bytes
@@ -206,7 +232,7 @@ def gather_pages(eng, pages: list[int]) -> list[tuple]:
     payload, so the decode side can register it as a host node."""
     from gofr_tpu.ops.paged import gather_page
 
-    return [tuple(jax.tree.leaves(gather_page(eng.cache, jnp.int32(p))))
+    return [tuple(jax.tree.leaves(gather_page(eng.kv_cache, jnp.int32(p))))
             for p in pages]
 
 
@@ -221,7 +247,8 @@ def warmup_compile(eng, lbs: list[int], bbs: list[int]) -> int:
     count = 0
     warm_prefill = eng.role != "decode"
     warm_decode = eng.role != "prefill"
-    w = eng.pages_per_slot if eng.kv_layout == "paged" else 1
+    w = prefill_cols(eng)
+    wp = eng.pages_per_slot if eng.kv_layout == "paged" else 0
     oob = eng.total_pages if eng.kv_layout == "paged" else eng.num_slots
     if warm_prefill:
         for lb in lbs:
@@ -229,6 +256,8 @@ def warmup_compile(eng, lbs: list[int], bbs: list[int]) -> int:
                 packed = np.zeros((nb, lb + w + 3), np.int32)
                 packed[:, lb] = 1  # lengths
                 packed[:, lb + 1:lb + 1 + w] = oob  # all-OOB rows: writes dropped
+                if eng.kv_layout == "paged" and eng.spec_tokens:
+                    packed[:, lb + 1 + wp] = eng.num_slots  # OOB hist lanes
                 eng._announce(TAG_PREFILL, lb, nb, packed)
                 toks, eng.cache = eng._prefill_sample(
                     eng.params, eng._base_key, eng.cache, jnp.asarray(packed)
@@ -246,6 +275,8 @@ def warmup_compile(eng, lbs: list[int], bbs: list[int]) -> int:
             packed = np.zeros((1, lb + w + 4), np.int32)
             packed[0, lb] = 1
             packed[0, lb + 1:lb + 1 + w] = oob
+            if eng.kv_layout == "paged" and eng.spec_tokens:
+                packed[0, lb + 1 + wp] = eng.num_slots  # OOB hist lane
             eng._announce(TAG_CHUNK, lb, 1, packed)
             toks, eng.cache = eng._chunk_prefill(
                 eng.params, eng._base_key, eng.cache, jnp.asarray(packed)
@@ -272,31 +303,28 @@ def warmup_compile(eng, lbs: list[int], bbs: list[int]) -> int:
         eng._compiled.add(("decode", n, k))
         count += 1
     if warm_decode and eng.spec_tokens:
+        # BOTH layouts: all lanes host-arbitrated and OOB, so no
+        # cache/history write survives. Announced with b=0 (warmup,
+        # mirroring the TAG_DECODE convention): both sides feed a
+        # zeros carry and DISCARD the output carry, so leader and
+        # followers stay carry-identical without relying on a
+        # warmup-produced value (ADVICE r5).
         if eng.kv_layout == "paged":
-            sw, sh = eng.pages_per_slot, eng.pages_per_slot * eng.page_size
-            spec_packed = np.zeros((4 + sw + sh, n), np.int32)
-            spec_packed[1, :] = sh + 1  # all lanes OOB
-            spec_packed[4:4 + sw] = eng.total_pages  # all-OOB tables
-            eng._announce(TAG_SPEC, 4 + sw + sh, 0, spec_packed)
-            toks, _, eng.cache = eng._spec_chunk_fn(
-                eng.params, eng._base_key, eng.cache, k,
-                jnp.asarray(spec_packed))
+            sw = eng.pages_per_slot
+            spec_packed = np.zeros((5 + sw, n), np.int32)
+            spec_packed[1, :] = sw * eng.page_size + 1  # all lanes OOB
+            spec_packed[2, :] = 1
+            spec_packed[5:] = eng.total_pages  # all-OOB tables
         else:
-            # slot layout: all lanes host-arbitrated and OOB, so no
-            # cache/history write survives. Announced with a=0 (warmup,
-            # mirroring the TAG_DECODE convention): both sides feed a
-            # zeros carry and DISCARD the output carry, so leader and
-            # followers stay carry-identical without relying on a
-            # warmup-produced value (ADVICE r5).
             spec_packed = np.zeros((5, n), np.int32)
             spec_packed[1, :] = eng._cache_len + 1
             spec_packed[2, :] = 1
-            eng._announce(TAG_SPEC, 0, 0, spec_packed)
-            carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
-            toks, _, eng.cache, _warm_carry = eng._spec_chunk_fn(
-                eng.params, eng._base_key, eng.cache, k,
-                jnp.asarray(spec_packed), carry)
-            del _warm_carry  # never stored: _loop starts from None
+        eng._announce(TAG_SPEC, spec_packed.shape[0], 0, spec_packed)
+        carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
+        toks, _, eng.cache, _warm_carry = eng._spec_chunk_fn(
+            eng.params, eng._base_key, eng.cache, k,
+            jnp.asarray(spec_packed), carry)
+        del _warm_carry  # never stored: _loop starts from None
         jax.block_until_ready(toks)
         eng._compiled.add(("decode_spec", n, k, eng.spec_tokens))
         count += 1
@@ -311,16 +339,18 @@ def warmup_compile(eng, lbs: list[int], bbs: list[int]) -> int:
         from gofr_tpu.ops.paged import gather_page, swap_in_pages
 
         jax.block_until_ready(
-            jax.tree.leaves(gather_page(eng.cache, jnp.int32(0)))[0])
+            jax.tree.leaves(gather_page(eng.kv_cache, jnp.int32(0)))[0])
         count += 1
         if eng._prefix.host_budget:
             for wb in eng._swapin_buckets:
                 ids = np.full((wb,), eng.total_pages, np.int32)
                 payload = jax.tree.unflatten(eng._cache_treedef, [
                     np.zeros((leaf.shape[0], wb) + tuple(leaf.shape[2:]), leaf.dtype)
-                    for leaf in jax.tree.leaves(eng.cache)])
-                eng.cache, marker = swap_in_pages(
-                    eng.cache, jnp.asarray(ids), payload)
+                    for leaf in jax.tree.leaves(eng.kv_cache)])
+                kv, marker = swap_in_pages(
+                    eng.kv_cache, jnp.asarray(ids), payload)
+                eng.cache = ((kv, eng.cache[1])
+                             if isinstance(eng.cache, tuple) else kv)
                 jax.block_until_ready(marker)
                 eng._compiled.add(("swapin", wb))
                 count += 1
@@ -329,7 +359,7 @@ def warmup_compile(eng, lbs: list[int], bbs: list[int]) -> int:
 
 __all__ = [
     "ChunkPlan", "PrefillPlan", "dispatch_chunk", "dispatch_decode",
-    "dispatch_prefill", "dispatch_spec", "dispatch_swapins",
-    "gather_pages", "materialize_spills", "process_decode", "spec_round",
-    "warmup_compile",
+    "dispatch_prefill", "dispatch_spec", "dispatch_spec_paged",
+    "dispatch_swapins", "gather_pages", "materialize_spills",
+    "prefill_cols", "process_decode", "warmup_compile",
 ]

@@ -10,19 +10,28 @@ prompt gets a prefix hit and the page upload rides the existing
 overlaps live decode steps instead of stalling them.
 
 Wire format (own magic; the framing discipline — length prefix, exact
-reads, loud size cap — is fleet/channel.py's): after a one-time
-``_MAGIC`` handshake, each KV frame is::
+reads, loud size cap — is fleet/channel.py's): the one-time JOIN is
+``_MAGIC`` followed by a hello frame ``<i len> <JSON {"kv_dtype": ...}>``
+naming the exporter's KV pool dtype (``bf16`` | ``int8`` | ``int4`` —
+``ENGINE_KV_DTYPE``); the server ACKs ``<i status>`` and REJECTS a
+mismatched peer right there, because a page payload quantized for one
+pool layout is garbage in another (the int4 planes are packed nibbles —
+shape-compatible with nothing else, but int8 vs bf16 could otherwise
+fail only deep inside ``handoff_import``'s shape check, after megabytes
+moved). After JOIN, each KV frame is::
 
     <i meta_nbytes> <meta JSON> <payload bytes>
 
-where meta carries the prompt tokens, page count, and per-plane
-dtype/shape (the paged cache is a pytree; each page's payload is the
-per-layer K/V planes ``ops.paged.gather_page`` returns, int8 scale
-planes included), and the payload is the pages' planes concatenated in
-chain order. The receiver replies ``<i status>`` (0 = imported) — the
-ACK is what bounds the exporter's wait and closes the ``engine.handoff``
-span. Both sides inherit ``MAX_FRAME_BYTES`` so a corrupt length can
-never silently OOM the importer.
+where meta carries the prompt tokens, page count, the kv dtype tag
+(belt and braces vs the JOIN gate: frames are self-describing for
+capture/replay tooling), and per-plane dtype/shape (the paged cache is
+a pytree; each page's payload is the per-layer K/V planes
+``ops.paged.gather_page`` returns, int8/int4 scale planes included),
+and the payload is the pages' planes concatenated in chain order. The
+receiver replies ``<i status>`` (0 = imported) — the ACK is what bounds
+the exporter's wait and closes the ``engine.handoff`` span. Both sides
+inherit ``MAX_FRAME_BYTES`` so a corrupt length can never silently OOM
+the importer.
 
 Failure contract (the PR 10 deadline plane): the exporter waits at most
 ``min(handoff_timeout_s, request deadline remaining)`` for the ACK; a
@@ -54,6 +63,16 @@ _I32 = struct.Struct("<i")
 
 ACK_OK = 0
 ACK_REJECTED = 1
+ACK_DTYPE_MISMATCH = 2
+
+# the JOIN hello is a few dozen bytes of JSON; anything bigger is not ours
+_MAX_HELLO_BYTES = 4096
+
+
+def engine_kv_dtype(engine) -> str:
+    """The engine's KV pool dtype as it rides the wire: the canonical
+    ENGINE_KV_DTYPE spelling ('' quantize means the dense bf16 pool)."""
+    return getattr(engine, "kv_quantize", "") or "bf16"
 
 
 class HandoffClosed(ConnectionError):
@@ -83,15 +102,18 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def encode_frame(toks: np.ndarray, payloads: list[tuple], nbytes_page: int) -> bytes:
+def encode_frame(toks: np.ndarray, payloads: list[tuple], nbytes_page: int,
+                 kv_dtype: str = "") -> bytes:
     """One KV frame: meta-length + meta JSON + concatenated plane bytes.
     ``payloads`` holds one tuple of HOST numpy planes per full page, in
-    chain order (the caller already read the device buffers back)."""
+    chain order (the caller already read the device buffers back).
+    ``kv_dtype`` tags the pool layout the planes were quantized for."""
     planes = [{"dtype": str(a.dtype), "shape": list(a.shape)} for a in payloads[0]]
     meta = json.dumps({
         "toks": np.asarray(toks, np.int64).tolist(),
         "n_pages": len(payloads),
         "nbytes_page": int(nbytes_page),
+        "kv_dtype": str(kv_dtype),
         "planes": planes,
     }).encode("utf-8")
     parts = [_I32.pack(len(meta)), meta]
@@ -106,10 +128,11 @@ def encode_frame(toks: np.ndarray, payloads: list[tuple], nbytes_page: int) -> b
     return frame
 
 
-def decode_frame(sock: socket.socket) -> tuple[np.ndarray, list[tuple], int]:
+def decode_frame(sock: socket.socket) -> tuple[np.ndarray, list[tuple], int, str]:
     """Read one KV frame off ``sock``: (prompt tokens, per-page plane
-    tuples, nbytes_page). Raises HandoffClosed on sever, ValueError on a
-    frame that lies about its size."""
+    tuples, nbytes_page, kv_dtype tag — "" from a pre-tag peer). Raises
+    HandoffClosed on sever, ValueError on a frame that lies about its
+    size."""
     (meta_len,) = _I32.unpack(_recv_exact(sock, _I32.size))
     if not 0 < meta_len <= MAX_FRAME_BYTES:
         raise ValueError(f"handoff: frame advertises {meta_len} meta bytes — corrupt stream")
@@ -131,7 +154,7 @@ def decode_frame(sock: socket.socket) -> tuple[np.ndarray, list[tuple], int]:
             raw = _recv_exact(sock, int(np.prod(sh)) * dt.itemsize)
             page.append(np.frombuffer(raw, dtype=dt).reshape(sh).copy())
         payloads.append(tuple(page))
-    return toks, payloads, int(meta["nbytes_page"])
+    return toks, payloads, int(meta["nbytes_page"]), str(meta.get("kv_dtype", ""))
 
 
 def _register_handoff_metrics(metrics) -> None:
@@ -199,7 +222,21 @@ class HandoffExporter:
             return self._sock
         s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        s.sendall(_MAGIC)
+        # JOIN: magic + kv-dtype hello; a mismatched pool layout is
+        # rejected HERE, before any multi-MB page frame moves
+        hello = json.dumps({"kv_dtype": engine_kv_dtype(self.engine)}).encode("utf-8")
+        s.sendall(_MAGIC + _I32.pack(len(hello)) + hello)
+        try:
+            (status,) = _I32.unpack(_recv_exact(s, _I32.size))
+        except HandoffClosed:
+            s.close()
+            raise
+        if status != ACK_OK:
+            s.close()
+            raise HandoffClosed(
+                f"decode worker rejected JOIN (status {status}): "
+                f"kv dtype {engine_kv_dtype(self.engine)!r} does not match the "
+                "import pool (ENGINE_KV_DTYPE must agree across the P/D split)")
         self._sock = s
         return s
 
@@ -238,7 +275,8 @@ class HandoffExporter:
             self._fail(job, "request expired before KV export began")
             return
         try:
-            frame = encode_frame(job.prompt_tokens, host_pages, job.nbytes_page)
+            frame = encode_frame(job.prompt_tokens, host_pages, job.nbytes_page,
+                                 kv_dtype=engine_kv_dtype(self.engine))
         except ValueError as e:
             self._fail(job, str(e))
             return
@@ -370,8 +408,33 @@ class HandoffServer:
         try:
             if _recv_exact(conn, len(_MAGIC)) != _MAGIC:
                 return  # not a handoff peer; drop the connection
+            # JOIN hello: the peer names its KV pool dtype; reject a
+            # mismatch before accepting any page frame (module docstring)
+            (hlen,) = _I32.unpack(_recv_exact(conn, _I32.size))
+            if not 0 < hlen <= _MAX_HELLO_BYTES:
+                return  # not a handoff peer; drop the connection
+            hello = json.loads(_recv_exact(conn, hlen).decode("utf-8"))
+            want = engine_kv_dtype(self.engine)
+            got = str(hello.get("kv_dtype", ""))
+            if got != want:
+                with self._lock:
+                    self._stats["rejected"] += 1
+                if self.logger is not None:
+                    self.logger.warn(
+                        f"kv handoff JOIN rejected: peer kv dtype {got!r} != "
+                        f"import pool {want!r}")
+                conn.sendall(_I32.pack(ACK_DTYPE_MISMATCH))
+                return
+            conn.sendall(_I32.pack(ACK_OK))
             while not self._stop.is_set():
-                toks, payloads, nbytes_page = decode_frame(conn)
+                toks, payloads, nbytes_page, frame_dtype = decode_frame(conn)
+                if frame_dtype and frame_dtype != want:
+                    # JOIN said one thing, the frame says another:
+                    # protocol corruption — reject, keep the connection
+                    conn.sendall(_I32.pack(ACK_DTYPE_MISMATCH))
+                    with self._lock:
+                        self._stats["rejected"] += 1
+                    continue
                 # chaos kv.handoff, server side: the frame arrived but is
                 # dropped BEFORE import — the exporter times out waiting
                 # for an ACK that never comes (raise/delay work too)
@@ -431,6 +494,7 @@ class HandoffServer:
 
 
 __all__ = [
-    "ACK_OK", "ACK_REJECTED", "HandoffClosed", "HandoffExporter",
-    "HandoffJob", "HandoffServer", "decode_frame", "encode_frame",
+    "ACK_DTYPE_MISMATCH", "ACK_OK", "ACK_REJECTED", "HandoffClosed",
+    "HandoffExporter", "HandoffJob", "HandoffServer", "decode_frame",
+    "encode_frame", "engine_kv_dtype",
 ]

@@ -242,7 +242,8 @@ class LockstepFollower:
         from gofr_tpu.fleet.channel import ChannelClosed
 
         eng = self.engine
-        w = eng.pages_per_slot if eng.kv_layout == "paged" else 1
+        from gofr_tpu.tpu.executor import prefill_cols
+        w = prefill_cols(eng)  # paged+spec carries a trailing slot-id column
         wt = eng.pages_per_slot if eng.kv_layout == "paged" else 0
         n, k = eng.num_slots, eng.decode_chunk
         rejoinable = getattr(self.channel, "supports_rejoin", False)
@@ -311,31 +312,30 @@ class LockstepFollower:
                             eng._prev_last = last
                         del out
                     elif tag == TAG_SPEC:
-                        if eng.kv_layout == "slot":
-                            # slot spec: a is a live flag (0 = leader warmup:
-                            # zeros carry in, output carry DISCARDED — the
-                            # TAG_DECODE convention), payload is [5, n]. Live
-                            # rounds reproduce the device-resident (token,
-                            # hlen) carry because every process executes the
-                            # same deterministic calls in order (sampled
-                            # requests too: the rng step rides the payload and
-                            # folds into the same config-seeded base key).
-                            live = bool(a)
-                            packed = self._recv((5, n))
-                            carry = eng._spec_carry if live else None
-                            if carry is None:
-                                carry = (jnp.zeros((n,), jnp.int32),
-                                         jnp.zeros((n,), jnp.int32))
-                            toks, accs, eng.cache, carry_out = eng._spec_chunk_fn(
-                                eng.params, eng._base_key, eng.cache, k,
-                                jnp.asarray(packed), carry)
-                            if live:
-                                eng._spec_carry = carry_out
-                        else:
-                            packed = self._recv((a, n))
-                            toks, accs, eng.cache = eng._spec_chunk_fn(
-                                eng.params, eng._base_key, eng.cache, k,
-                                jnp.asarray(packed))
+                        # unified spec frame: a is the packed row count ([5, n]
+                        # slot, [5 + pages_per_slot, n] paged), b is the live
+                        # flag (0 = leader warmup: zeros carry in, output carry
+                        # DISCARDED — the TAG_DECODE convention). Live rounds
+                        # reproduce the device-resident (token, hlen) carry
+                        # because every process executes the same deterministic
+                        # calls in order (sampled requests too: the rng step
+                        # rides the payload and folds into the same
+                        # config-seeded base key). Paged spec rounds stay
+                        # pipelined under lockstep: the leader announces at
+                        # dispatch time, so frame order on the wire is the
+                        # leader's _dq dispatch order and the carry chain
+                        # matches step for step.
+                        live = bool(b)
+                        packed = self._recv((a, n))
+                        carry = eng._spec_carry if live else None
+                        if carry is None:
+                            carry = (jnp.zeros((n,), jnp.int32),
+                                     jnp.zeros((n,), jnp.int32))
+                        toks, accs, eng.cache, carry_out = eng._spec_chunk_fn(
+                            eng.params, eng._base_key, eng.cache, k,
+                            jnp.asarray(packed), carry)
+                        if live:
+                            eng._spec_carry = carry_out
                         del toks, accs
                     else:  # pragma: no cover - protocol corruption
                         raise RuntimeError(f"lockstep follower: unknown tag {tag}")

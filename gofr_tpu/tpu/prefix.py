@@ -38,7 +38,19 @@ KV content equality: a page holding positions [i*P, (i+1)*P) of a given
 token prefix has deterministically identical K/V regardless of which request
 computed it, so chains may interleave pages registered by different requests
 — and a host payload captured from one request's pages is valid content for
-every later request that hits the same chain node.
+every later request that hits the same chain node. This holds per POOL
+DTYPE: quantized pools (int8, packed int4 — ISSUE 13) write deterministic
+quantized planes, so the equality argument carries over unchanged, but
+content from one dtype's pool is meaningless in another's — which is why
+the cross-worker handoff path tags frames and rejects mismatched-dtype
+peers at JOIN (tpu/handoff.py).
+
+Speculative decoding note (ISSUE 13): with spec rounds in the pipeline a
+lane over-claims trailing pages for its in-flight rounds and trims the
+surplus at fold time (engine ``_trim_lane_pages``). Only TRAILING pages —
+beyond the last accepted position — are ever trimmed; cached prefix pages
+are leading prompt pages and carry their own cache refcount besides, so
+the prefix tiers never see a trimmed page disappear from under a chain.
 """
 
 from __future__ import annotations

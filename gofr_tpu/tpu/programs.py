@@ -7,8 +7,10 @@ round trip (~70ms measured on the round-3 tunnel); packing turns 4-6 of
 them into one. This module holds the compiled-program side of that
 contract; the engine (tpu/engine.py) packs the host side.
 
-Packed layouts (W = 1 slot-id column for the slot layout, pages_per_slot
-block-table columns for paged):
+Packed layouts (W = 1 slot-id column for the slot layout; for paged,
+pages_per_slot block-table columns, plus ONE trailing slot-id column when
+speculative decoding is on — the prefill programs need the lane index to
+seed the device-resident history rows):
 
 - Prefill ``[nb, lb + W + 3]``:
   ``[:, :lb]`` tokens | ``[:, lb]`` lengths | ``[:, lb+1:lb+1+W]`` rows
@@ -32,10 +34,15 @@ block-table columns for paged):
   first token) into ``hist`` rows on device, so the host never re-ships
   O(pos) history per round. Inactive lanes ship use_host=1 with
   hlen = H + 1: every cache/history write lands out of bounds and drops.
-- Spec (paged) ``[4 + Wp + Hcap, n]``: ``[0]`` input token | ``[1]``
-  history length | ``[2]`` temps (f32 bitcast) | ``[3, 0]`` rng step
-  | ``[4:4+Wp]`` table.T | ``[4+Wp:]`` history.T. Inactive lanes ship
-  hlen = Hcap + 1 AND an all-OOB table row.
+- Spec (paged) ``[5 + Wp, n]``: ``[0]`` input token | ``[1]`` history
+  length | ``[2]`` use_host flags | ``[3]`` temps (f32 bitcast)
+  | ``[4, 0]`` rng step | ``[5:]`` table.T — the SAME carry arbitration
+  and (kv, hist) cache pytree as the slot layout, so paged spec rounds
+  ride the pipelined dispatch queue too (pages are over-claimed at
+  dispatch for the worst-case accepted span; tpu/decode.py). Inactive
+  lanes ship use_host=1, hlen = Hcap + 1 AND an all-OOB table row, so
+  every cache/history write drops. History never rides the wire in
+  either layout.
 
 Backend resolution is a TRACE-time property of these programs: the decode
 attention ops inside them resolve ``backend="auto"`` when a program first
@@ -189,7 +196,11 @@ def build_programs(
     bit-identical to plain greedy decode regardless of draft quality — the
     draft only moves the acceptance rate."""
     ts = (top_k, top_p)
-    W = pages_per_slot if kv_layout == "paged" else 1
+    Wp = pages_per_slot
+    # paged + spec adds one trailing slot-id column after the block-table
+    # columns: hist rows are indexed by LANE, and the paged layout's packed
+    # prefill otherwise carries only page ids (module docstring)
+    W = (Wp + (1 if spec_tokens else 0)) if kv_layout == "paged" else 1
     # whole-prompt prefill attention override (e.g. ring/Ulysses
     # sequence-parallel attention on an sp mesh — build_engine wires it);
     # chunked prefill keeps the gathered-view attention either way
@@ -198,29 +209,63 @@ def build_programs(
     spec_chunk = None
 
     if kv_layout == "paged":
+        # With spec on, the paged cache is the same 2-tuple pytree the
+        # slot layout uses: (kv, hist) — prefill seeds hist rows on
+        # device, the spec program maintains them, and no program input
+        # ever carries token history (the old paged spec shipped
+        # O(Hcap) history rows per round).
+        tuple_cache = bool(spec_tokens)
+
+        def _split(cache):
+            return cache if tuple_cache else (cache, None)
+
+        def _join(kv, hist):
+            return (kv, hist) if tuple_cache else kv
+
+        def _seed_hist(hist, srows, tokens, lengths, toks, offsets=None):
+            """Write an admitted prompt chunk (and its sampled token) into
+            the device history. OOB lane ids (padding rows) drop. On
+            non-final chunks the sampled-token write at offset+length is
+            garbage the NEXT chunk overwrites — final state is always
+            (prompt .. first sampled token)."""
+            lb = tokens.shape[1]
+            base = offsets if offsets is not None else jnp.zeros_like(lengths)
+            cols = base[:, None] + jnp.arange(lb)[None, :]
+            hist = hist.at[srows[:, None], cols].set(tokens, mode="drop")
+            return hist.at[srows, base + lengths].set(toks, mode="drop")
+
         @partial(jax.jit, donate_argnums=(2,))
         def _prefill_sample(params, base_key, cache, packed):
+            kv, hist = _split(cache)
             tokens, lengths, rows, _, temps, step = unpack_prefill(packed, W)
             key = jax.random.fold_in(base_key, step)
-            logits, cache = family.prefill_paged(cfg, params, tokens, lengths, cache, rows, **pf)
+            logits, kv = family.prefill_paged(
+                cfg, params, tokens, lengths, kv, rows[:, :Wp], **pf)
             toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
-            return toks, cache
+            if tuple_cache:
+                hist = _seed_hist(hist, rows[:, Wp], tokens, lengths, toks)
+            return toks, _join(kv, hist)
 
         @partial(jax.jit, donate_argnums=(2,))
         def _chunk_prefill(params, base_key, cache, packed):
+            kv, hist = _split(cache)
             tokens, lengths, rows, offsets, temps, step = unpack_prefill(
                 packed, W, chunked=True)
             key = jax.random.fold_in(base_key, step)
-            logits, cache = family.prefill_paged(
-                cfg, params, tokens, lengths, cache, rows, offsets
+            logits, kv = family.prefill_paged(
+                cfg, params, tokens, lengths, kv, rows[:, :Wp], offsets
             )
             toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
-            return toks, cache
+            if tuple_cache:
+                hist = _seed_hist(hist, rows[:, Wp], tokens, lengths, toks,
+                                  offsets)
+            return toks, _join(kv, hist)
 
         chunk_prefill = _chunk_prefill
 
         @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
         def _decode_chunk(params, base_key, cache, steps, packed, prev_last):
+            kv, hist = _split(cache)
             tokens = jnp.where(packed[4] != 0, packed[0], prev_last)
             positions = packed[1]
             temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
@@ -228,56 +273,60 @@ def build_programs(
             table = packed[5:].T
 
             def body(carry, _):
-                toks, pos, cache, key = carry
-                logits, cache = family.decode_step_paged(cfg, params, toks, pos, cache, table)
+                toks, pos, kv, key = carry
+                logits, kv = family.decode_step_paged(cfg, params, toks, pos, kv, table)
                 key, sub = jax.random.split(key)
                 nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
-                return (nxt, pos + 1, cache, key), nxt
+                return (nxt, pos + 1, kv, key), nxt
 
-            (toks, pos, cache, key), out = jax.lax.scan(
-                body, (tokens, positions, cache, key), None, length=steps
+            (toks, pos, kv, key), out = jax.lax.scan(
+                body, (tokens, positions, kv, key), None, length=steps
             )
-            return out.T, toks, cache  # [slots, K], [slots] carry
+            return out.T, toks, _join(kv, hist)  # [slots, K], [slots] carry
 
         if spec_tokens:
             g = spec_tokens
-            Wp = pages_per_slot
             Hcap = Wp * page_size  # logical per-slot capacity
 
-            @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
-            def _spec_chunk(params, base_key, cache, steps, packed):
+            @partial(jax.jit, static_argnums=(3,), donate_argnums=(2, 5))
+            def _spec_chunk(params, base_key, cache, steps, packed, carry):
+                kv, hist0 = cache
                 n_l = packed.shape[1]
-                tok0 = packed[0]
-                hlen0 = packed[1]
-                temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
-                key0 = jax.random.fold_in(base_key, packed[3, 0])
-                table = packed[4:4 + Wp].T      # [n, Wp]
-                hist0 = packed[4 + Wp:].T       # [n, Hcap]
+                use_host = packed[2] != 0
+                tok0 = jnp.where(use_host, packed[0], carry[0])
+                hlen0 = jnp.where(use_host, packed[1], carry[1])
+                temps = jax.lax.bitcast_convert_type(packed[3], jnp.float32)
+                key0 = jax.random.fold_in(base_key, packed[4, 0])
+                table = packed[5:].T            # [n, Wp]
                 idx = jnp.arange(Hcap)
 
-                def outer(carry, _):
-                    tok, hlen, hist, cache, key = carry
+                def outer(loop, _):
+                    tok, hlen, hist, kv, key = loop
                     key, ks = jax.random.split(key)
                     pos = hlen - 1
+                    # prompt-lookup draft: continuation after the most
+                    # recent EARLIER occurrence of the current token
+                    # (a DETERMINISTIC proposal — one-hot q)
                     match = (hist == tok[:, None]) & (idx[None, :] < pos[:, None])
                     j = jnp.where(match, idx[None, :], -1).max(axis=1)
                     take = jnp.clip(j[:, None] + 1 + jnp.arange(g)[None, :], 0, Hcap - 1)
                     drafts = jnp.take_along_axis(hist, take, axis=1)
                     seq = jnp.concatenate([tok[:, None], drafts], axis=1)
-                    logits, cache = family.verify_step_paged(
-                        cfg, params, seq, pos, cache, table)
+                    logits, kv = family.verify_step_paged(
+                        cfg, params, seq, pos, kv, table)
                     out, acc = speculative_sample(ks, logits, drafts, temps,
                                                   None, ts[0], ts[1])
                     nxt = jnp.take_along_axis(out, acc[:, None], axis=1)[:, 0]
                     emit = jnp.arange(g + 1)[None, :] <= acc[:, None]
                     wpos = jnp.where(emit, hlen[:, None] + jnp.arange(g + 1)[None, :], Hcap)
                     hist = hist.at[jnp.arange(n_l)[:, None], wpos].set(out, mode="drop")
-                    return (nxt, hlen + acc + 1, hist, cache, key), (out, acc)
+                    return (nxt, hlen + acc + 1, hist, kv, key), (out, acc)
 
-                (_, _, _, cache, _), (toks, accs) = jax.lax.scan(
-                    outer, (tok0, hlen0, hist0, cache, key0), None, length=steps
+                (tok_f, hlen_f, hist, kv, _), (toks, accs) = jax.lax.scan(
+                    outer, (tok0, hlen0, hist0, kv, key0), None, length=steps
                 )
-                return toks, accs, cache
+                # [K, n, g+1], [K, n], cache, next-round (token, hlen) carry
+                return toks, accs, (kv, hist), (tok_f, hlen_f)
 
             spec_chunk = _spec_chunk
     else:

@@ -284,6 +284,77 @@ def test_ci_runs_the_disagg_smoke():
     assert "tpot" in checks and "handoff" in checks and "token_exact" in checks
 
 
+def test_kv_int4_suite_is_in_quick_tier():
+    """ISSUE 13 satellite: the packed-int4 KV suite — nibble pack/unpack
+    round trips, pool write/append/gather, fused-kernel vs gathered-XLA
+    parity under the interpreter, and int4 engine plausibility — runs on
+    CPU in seconds and must ride the `-m quick` CI job on every push."""
+    path = REPO / "tests" / "test_kv_int4.py"
+    assert path.exists(), "tests/test_kv_int4.py missing"
+    text = path.read_text()
+    assert "pytestmark = pytest.mark.quick" in text, (
+        "test_kv_int4.py must be quick-marked module-wide"
+    )
+    assert "test_kv_int4.py" not in QUICK_EXEMPT, (
+        "test_kv_int4.py must not be exempted from the quick tier"
+    )
+    # the tentpole's acceptance pieces: lossless packing, kernel parity
+    # against the gather reference, the ENGINE_KV_DTYPE config plane, and
+    # clean page accounting on the int4 engine
+    assert "pack_int4" in text and "unpack_int4" in text
+    assert "paged_decode_attention_q4" in text and 'backend="xla"' in text
+    assert "ENGINE_KV_DTYPE" in text
+    assert "assert_paged_pool_consistent" in text
+
+
+def test_spec_pipeline_suite_is_in_quick_tier():
+    """ISSUE 13 satellite: the spec-in-the-pipeline suite — the queue-spy
+    proof that paged spec rounds dispatch while older entries are still in
+    flight, the depth-1 synchronous escape hatch, and the over-claim/trim
+    page-lifecycle drills (cancel mid-round, tight-pool preemption) — is
+    CPU-fast and must ride the `-m quick` CI job."""
+    path = REPO / "tests" / "test_spec_pipeline.py"
+    assert path.exists(), "tests/test_spec_pipeline.py missing"
+    text = path.read_text()
+    assert "pytestmark = pytest.mark.quick" in text, (
+        "test_spec_pipeline.py must be quick-marked module-wide"
+    )
+    assert "test_spec_pipeline.py" not in QUICK_EXEMPT, (
+        "test_spec_pipeline.py must not be exempted from the quick tier"
+    )
+    assert "_dq" in text and "spec" in text
+    assert "cancel" in text and "assert_paged_pool_consistent" in text
+
+
+def test_ci_runs_the_kvdtype_smoke():
+    """ISSUE 13 satellite: CI must run the bf16/int8/int4 paged-pool A/B
+    as an EXPLICIT CPU run and assert the archive carries all three arms
+    with strictly decreasing pool bytes per decode token plus the
+    token_exact/parity correctness fields — otherwise the decode-bandwidth
+    harness can rot between TPU rounds."""
+    ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+    smoke_runs = [
+        step.get("run", "")
+        for job in ci["jobs"].values()
+        for step in job.get("steps", [])
+        if "GOFR_BENCH_KVDTYPE=1" in step.get("run", "")
+    ]
+    assert smoke_runs, "ci.yml has no job running the GOFR_BENCH_KVDTYPE smoke"
+    joined = " ".join(smoke_runs)
+    assert "GOFR_BENCH_PLATFORM=cpu" in joined
+    assert "bench.py" in joined
+    # the verdict step must actually check the archived structure
+    checks = " ".join(
+        step.get("run", "")
+        for job in ci["jobs"].values()
+        for step in job.get("steps", [])
+        if "kvdtype" in step.get("run", ""))
+    assert "kv_bytes_per_decode_token" in checks
+    assert "token_exact" in checks and "parity" in checks
+    for arm in ("bf16", "int8", "int4"):
+        assert arm in checks, f"verdict step never mentions the {arm} arm"
+
+
 def test_ci_has_py310_compat_gate():
     """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
     syntax (same-quote nested f-strings) passes every 3.12 job silently and

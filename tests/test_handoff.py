@@ -6,7 +6,8 @@ frame-size cap). Engine tier proves the acceptance properties on the CPU
 mesh: a prefill-role worker completes prompts with ``finish_reason=
 "handoff"`` and ships bit-identical pages to a decode-role worker whose
 generation is TOKEN-EXACT vs a colocated (``ENGINE_ROLE=both``) engine on
-both paged KV layouts (bf16 and int8 scale planes); a stuck transfer is
+all three paged pool dtypes (bf16, int8, packed int4 — ISSUE 13), a
+mismatched-dtype peer is rejected at JOIN; a stuck transfer is
 shed by the PR 10 deadline plane as a 504 with ``where="handoff"``; and a
 chaos-severed transfer (``kv.handoff``, either side) leaks zero pool
 pages on BOTH workers (``assert_page_refs_consistent``).
@@ -52,7 +53,7 @@ class TestWireCodec:
              np.full((2, 2), i, np.int8))
             for i in range(3)
         ]
-        toks, out, nbytes = _roundtrip(pages, [1, 2, 3, 4, 5])
+        toks, out, nbytes, _dt = _roundtrip(pages, [1, 2, 3, 4, 5])
         assert toks.tolist() == [1, 2, 3, 4, 5] and nbytes == 64
         assert len(out) == 3
         for want, got in zip(pages, out):
@@ -63,9 +64,22 @@ class TestWireCodec:
         import ml_dtypes
 
         page = (np.asarray([[1.5, -2.0]], ml_dtypes.bfloat16),)
-        _, out, _ = _roundtrip([page], [7])
+        _, out, _, _ = _roundtrip([page], [7])
         assert out[0][0].dtype == ml_dtypes.bfloat16
         assert (np.asarray(out[0][0], np.float32) == [[1.5, -2.0]]).all()
+
+    def test_frame_carries_kv_dtype_tag(self):
+        page = (np.zeros((2, 2), np.uint8),)
+        frame = handoff.encode_frame(np.asarray([3], np.int32), [page], 16,
+                                     kv_dtype="int4")
+        a, b = socket.socketpair()
+        try:
+            a.sendall(frame)
+            _, _, _, dt = handoff.decode_frame(b)
+            assert dt == "int4"
+        finally:
+            a.close()
+            b.close()
 
     def test_encode_refuses_oversized_frame(self, monkeypatch):
         monkeypatch.setattr(handoff, "MAX_FRAME_BYTES", 64)
@@ -168,6 +182,34 @@ class TestDisaggServing:
 
     def test_disagg_token_exact_int8(self, setup):
         self._token_exact(setup, kv_quantize="int8")
+
+    def test_disagg_token_exact_int4(self, setup):
+        """ISSUE 13: the packed-int4 pool's nibble planes + per-position
+        scale planes ship through the same frame codec, and disagg decode
+        stays token-exact vs an int4 colocated engine."""
+        self._token_exact(setup, kv_quantize="int4")
+
+    def test_join_rejects_mismatched_kv_dtype(self, setup):
+        """ISSUE 13 satellite: an int4 prefill worker dialing a bf16
+        decode worker is rejected at JOIN (before any page frame moves)
+        and the request is shed cleanly — no import, no page leak."""
+        cfg, params = setup
+        dec = make_engine(cfg, params, role="decode")  # bf16 pool
+        pre = make_engine(cfg, params, role="prefill", kv_quantize="int4",
+                          handoff_target=dec.handoff_addr,
+                          handoff_timeout_s=1.0)
+        try:
+            with pytest.raises(DeadlineExceeded, match="handoff"):
+                pre.generate(PROMPT, max_new_tokens=4, timeout=300)
+            assert pre._handoff_exporter.stats()["failed"] == 1
+            assert dec._handoff_server.stats()["imported"] == 0
+            assert dec._handoff_server.stats()["rejected"] == 1
+            assert dec._prefix.host_pages == 0
+            assert_page_refs_consistent(pre)
+            assert_page_refs_consistent(dec)
+        finally:
+            pre.stop()
+            dec.stop()
 
     def test_role_validation(self, setup):
         cfg, params = setup
