@@ -190,33 +190,30 @@ def acquire_backend() -> tuple[str, str]:
     return "cpu", f"TPU unavailable, CPU fallback ({detail})"
 
 
+def _device_peaks(device) -> tuple[float, float] | None:
+    """(peak FLOPs/s, peak HBM bytes/s) for the bench device, resolved by
+    the SAME table/override chain the live engine perf plane uses
+    (metrics/perf.py): GOFR_TPU_PEAK_* > GOFR_DEVICE_PEAKS JSON > builtin
+    spec sheet. One source of truth — bench and serving can't disagree."""
+    from gofr_tpu.metrics import perf as perf_mod
+
+    kind = (getattr(device, "device_kind", "") or
+            getattr(device, "platform", "") or "")
+    return perf_mod.device_peaks(str(kind))
+
+
 def _peak_flops(device) -> float:
-    """bf16 peak for MFU. Known TPU generations; env override wins."""
-    env = os.environ.get("GOFR_TPU_PEAK_TFLOPS")
-    if env:
-        return float(env) * 1e12
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    table = {"v6e": 918e12, "v6": 918e12, "v5p": 459e12, "v5e": 197e12,
-             "v5": 197e12, "v4": 275e12, "v3": 123e12}
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 197e12  # assume v5e-class when unknown
+    """bf16 peak for MFU; assume v5e-class when unknown."""
+    peaks = _device_peaks(device)
+    return peaks[0] if peaks else 197e12
 
 
 def _peak_bw(device) -> float:
     """HBM bandwidth for MBU — decode is bandwidth-bound, so MBU (not MFU)
-    is the utilization that matters for the generate bench. Env override wins."""
-    env = os.environ.get("GOFR_TPU_PEAK_GBS")
-    if env:
-        return float(env) * 1e9
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    table = {"v6e": 1638e9, "v6": 1638e9, "v5p": 2765e9, "v5e": 819e9,
-             "v5": 819e9, "v4": 1228e9, "v3": 900e9}
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 819e9  # assume v5e-class when unknown
+    is the utilization that matters for the generate bench; assume
+    v5e-class when unknown."""
+    peaks = _device_peaks(device)
+    return peaks[1] if peaks else 819e9
 
 
 def _pallas_active() -> bool:
@@ -301,6 +298,10 @@ def _run_once(engine_kw: dict, cfg, params, container, family, prompts,
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
         elapsed = time.monotonic() - t0
+        # live perf plane (metrics/perf.py): the per-kind roofline the run
+        # actually measured, snapshotted before stop() tears the engine down
+        perf_snap = (engine.perf.snapshot(time.monotonic())
+                     if getattr(engine, "perf", None) is not None else None)
     finally:
         engine.stop()
 
@@ -312,6 +313,7 @@ def _run_once(engine_kw: dict, cfg, params, container, family, prompts,
         "elapsed": elapsed,
         "new_tokens": new_tokens,
         "ttfts": [r["ttft_s"] for r in results],
+        "perf": perf_snap,
     }
     if os.environ.get("GOFR_BENCH_DEBUG") == "1":
         # device-call accounting from the engine's own histograms: how much
@@ -532,15 +534,44 @@ def main() -> None:
     # (attention FLOPs are <2% at these lengths; ignored — conservative).
     # NB: the image's TPU plugin registers as platform 'axon', not 'tpu' —
     # gate accelerator-only reporting on != 'cpu', same as the probe.
+    # Utilization is reported whenever the peak table resolves — on CPU
+    # that is the NOMINAL envelope (metrics/perf.py), flagged below so a
+    # CPU number is never mistaken for silicon utilization.
+    from gofr_tpu.metrics import perf as _perf
+    from gofr_tpu.ops.paged import kv_plane_bytes_per_position
+
     device = jax.devices()[0]
     on_accel = device.platform != "cpu"
+    peaks = _device_peaks(device)
     total_flops = 2.0 * n_params * (m["new_tokens"] + n_requests * prompt_len)
-    mfu = total_flops / elapsed / _peak_flops(device) if on_accel else None
-    # decode-side MBU lower bound: every device decode step re-reads the
-    # full weights (param_bytes reflects quantization) and serves ≤ slots
-    # tokens, so useful bytes ≥ param_bytes * new_tokens / slots. Occupancy
-    # < 1 makes the true bandwidth draw higher; this is the *useful* fraction.
-    mbu = (param_bytes * m["new_tokens"] / best[0]) / elapsed / _peak_bw(device) if on_accel else None
+    mfu = total_flops / elapsed / peaks[0] if peaks else None
+    # decode-side MBU lower bound via the SHARED estimator (perf.
+    # decode_lb_bytes): weight re-reads per micro-step PLUS the KV-pool
+    # traffic at the active plane width — the pre-perf-plane weights-only
+    # formula undercounted every byte the cache streams. kv_bytes_per_pos
+    # comes from the engine's own perf plane (exact pool footprint) with
+    # the analytic plane-width formula as the engine-less fallback; the
+    # old bound is kept as mbu_decode_lb_params for trajectory continuity.
+    eng_model = (m.get("perf") or {}).get("model") or {}
+    kv_bytes_pos = float(eng_model.get("kv_bytes_per_pos") or 0.0)
+    if not kv_bytes_pos:
+        kv_bytes_pos = kv_plane_bytes_per_position(
+            cfg.num_layers, cfg.num_kv_heads, cfg.head_size,
+            kv_dtype=kv_quantize or "bf16",
+            dense_bytes=4 if on_cpu else 2)
+    lb_inputs = {
+        "weight_bytes": float(param_bytes),
+        "new_tokens": int(m["new_tokens"]),
+        "slots": int(best[0]),
+        "kv_bytes_per_pos": float(kv_bytes_pos),
+        "hist_len": int(prompt_len),
+    }
+    mbu = (_perf.mbu_decode_lb(**lb_inputs, elapsed_s=elapsed, peak_bw=peaks[1])
+           if peaks else None)
+    mbu_params = (_perf.mbu_decode_lb_params(
+        weight_bytes=float(param_bytes), new_tokens=int(m["new_tokens"]),
+        slots=int(best[0]), elapsed_s=elapsed, peak_bw=peaks[1])
+        if peaks else None)
 
     extra = {
         "decode_tokens_per_s": round(tok_per_s, 1),
@@ -559,8 +590,19 @@ def main() -> None:
         "param_bytes": int(param_bytes),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "mbu_decode_lb": round(mbu, 4) if mbu is not None else None,
+        "mbu_decode_lb_params": (round(mbu_params, 4)
+                                 if mbu_params is not None else None),
+        "peaks_nominal": bool(peaks) and not on_accel,
         "ttft_p50_s": round(_percentile(m["ttfts"], 50), 4),
         "ttft_p99_s": round(_percentile(m["ttfts"], 99), 4),
+    }
+    # the per-kind roofline breakdown the headline engine measured, plus
+    # the EXACT estimator inputs: CI recomputes mbu_decode_lb from these
+    # via the shared module and asserts bit-for-bit agreement.
+    extra["perf"] = {
+        "inputs": dict(lb_inputs, elapsed_s=elapsed,
+                       peak_bw=peaks[1] if peaks else None),
+        "engine": m.get("perf"),
     }
     # warmup autotuner decision table (ops/autotune.py): which backend each
     # decode op pinned for this run's engine, with the measured timings —
@@ -1512,9 +1554,20 @@ def main() -> None:
                     "kv_bytes_per_decode_token": round(kv_bytes_tok, 2),
                     "tpot_p50_s": round(_percentile(tpots, 50), 5) if tpots else None,
                     "tpot_p99_s": round(_percentile(tpots, 99), 5) if tpots else None,
-                    "mbu_decode_lb": (round((param_bytes * new_toks / best[0])
-                                            / el / _peak_bw(device), 4)
-                                      if on_accel else None),
+                    # shared estimator with THIS arm's exact pool width —
+                    # the pre-perf-plane per-arm bound counted only weight
+                    # bytes, so all three arms reported the SAME number and
+                    # the A/B's entire point (the KV-plane width) was
+                    # invisible in the utilization field
+                    "mbu_decode_lb": (round(_perf.mbu_decode_lb(
+                        weight_bytes=float(param_bytes), new_tokens=new_toks,
+                        slots=int(best[0]), kv_bytes_per_pos=kv_bytes_tok,
+                        hist_len=int(prompt_len), elapsed_s=el,
+                        peak_bw=peaks[1]), 4) if peaks else None),
+                    "mbu_decode_lb_params": (round(_perf.mbu_decode_lb_params(
+                        weight_bytes=float(param_bytes), new_tokens=new_toks,
+                        slots=int(best[0]), elapsed_s=el,
+                        peak_bw=peaks[1]), 4) if peaks else None),
                 }
             except Exception as e:  # noqa: BLE001
                 kvd[arm] = f"error: {e}"[:200]

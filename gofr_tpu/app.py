@@ -299,6 +299,7 @@ class App:
             http_app.router.add_get("/debug/profile", self._profile_handler)
             http_app.router.add_get("/debug/requests", self._debug_requests_handler)
             http_app.router.add_get("/debug/engine", self._debug_engine_handler)
+            http_app.router.add_get("/debug/perf", self._debug_perf_handler)
 
         for method, path, handler in self._routes:
             http_app.router.add_route(method, path, self._wrap(handler))
@@ -759,6 +760,48 @@ class App:
             engines[name] = snap
         return web.json_response(
             {"data": {"count": len(steps), "steps": steps, "engines": engines}})
+
+    async def _debug_perf_handler(self, request: web.Request) -> web.Response:
+        """GET /debug/perf → the live roofline view (metrics/perf.py): per
+        engine a windowed MFU/MBU snapshot per step kind, the pipeline
+        bubble ratio, the page-pool waste stats, and every autotune-pinned
+        op joined with the roofline estimate of the step kind it runs in —
+        "is the pinned kernel the bottleneck, or is the device starved?"
+        answered from one endpoint (docs/observability.md)."""
+        import time as _time
+
+        now = _time.monotonic()
+        engines = {}
+        for name, engine in self.container.engines.items():
+            plane = getattr(engine, "perf", None)
+            if plane is None:
+                continue
+            snap = plane.snapshot(now)
+            stats_fn = getattr(engine, "page_pool_stats", None)
+            stats = stats_fn() if callable(stats_fn) else None
+            if stats:
+                snap["page_pool"] = stats
+            report = getattr(engine, "autotune_report", None)
+            rep = report() if report is not None else None
+            if rep and rep.get("decisions"):
+                # every warmed op today is a decode-step kernel, so each
+                # pin joins the "decode" kind's roofline; spec engines
+                # fold the same pinned op inside "spec" steps too
+                kinds = snap.get("kinds", {})
+                joined = {}
+                for op, rec in rep["decisions"].items():
+                    roof = {k: kinds[k] for k in ("decode", "spec")
+                            if k in kinds}
+                    joined[op] = {"pin": rec, "roofline": roof or None}
+                snap["autotune"] = joined
+            engines[name] = snap
+        totals = self.container.perf_totals()
+        fleet = None
+        if totals is not None:
+            from gofr_tpu.metrics import perf as perf_mod
+
+            fleet = {"totals": totals, **perf_mod.derive(totals)}
+        return web.json_response({"data": {"engines": engines, "rollup": fleet}})
 
     def _add_openapi_routes(self, http_app: web.Application) -> None:
         from gofr_tpu.swagger import openapi_handler, swagger_ui_handler

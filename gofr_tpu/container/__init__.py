@@ -224,6 +224,37 @@ class Container:
         m.new_counter("app_router_hedged_total",
                       "hedged dispatches fired by the router "
                       "(winner = primary|hedge|none)")
+        # live performance plane (metrics/perf.py, docs/observability.md):
+        # windowed roofline utilization per step kind, derived at scrape
+        # time from the engines' exact numerator/denominator sums — never
+        # set per engine (the _sample_tpu_metrics discipline)
+        m.new_gauge("app_tpu_mfu",
+                    "windowed model-FLOPs utilization vs device peak "
+                    "(kind, kv_dtype; absent while peaks are unknown)")
+        m.new_gauge("app_tpu_mbu",
+                    "windowed HBM-bandwidth utilization vs device peak "
+                    "(kind, kv_dtype; absent while peaks are unknown)")
+        m.new_gauge("app_tpu_perf_flops_window",
+                    "analytical FLOPs folded in the perf window (kind, kv_dtype)")
+        m.new_gauge("app_tpu_perf_bytes_window",
+                    "analytical HBM bytes folded in the perf window (kind, kv_dtype)")
+        m.new_gauge("app_tpu_perf_device_seconds_window",
+                    "device-queue residency folded in the perf window (kind, kv_dtype)")
+        m.new_histogram("app_tpu_step_device_seconds",
+                        "per-step device-queue residency, pipeline overlap "
+                        "deduplicated (kind)",
+                        buckets=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                                 0.05, 0.1, 0.25, 1.0])
+        m.new_gauge("app_tpu_pipeline_bubble_ratio",
+                    "device-idle-while-work-queued fraction of the perf window")
+        m.new_counter("app_tpu_spec_pages_trimmed_total",
+                      "KV pages claimed for spec over-claim and released at fold")
+        m.new_counter("app_tpu_spec_tokens_rejected_total",
+                      "spec draft tokens the target verification rejected")
+        m.new_gauge("app_tpu_kv_pool_occupancy",
+                    "allocated fraction of the paged KV pool (engine)")
+        m.new_gauge("app_tpu_kv_pool_fragmentation",
+                    "claimed-but-unwritten fraction of slot-held pages (engine)")
 
     def _sample_tpu_metrics(self, _registry=None) -> None:
         """Collect hook: live HBM gauges on every /metrics scrape (the
@@ -241,6 +272,60 @@ class Container:
         self.metrics.set_gauge(
             "app_tpu_inflight_requests",
             sum(getattr(e, "_inflight_requests", 0) for e in self._engines.values()))
+        self._sample_perf_metrics()
+
+    def perf_totals(self) -> dict | None:
+        """Exact sum-of-parts merge of every registered engine's perf
+        window (metrics/perf.py payload shape) — the one rollup the
+        scrape gauges, the gossip digest, and capture bundles all share.
+        None when no engine carries a perf plane."""
+        planes = [e.perf for e in self._engines.values()
+                  if getattr(e, "perf", None) is not None]
+        if not planes:
+            return None
+        import time
+
+        from gofr_tpu.metrics import perf as perf_mod
+
+        now = time.monotonic()
+        return perf_mod.merge_totals(p.window_totals(now) for p in planes)
+
+    def _sample_perf_metrics(self) -> None:
+        """Roofline gauges from the merged engine windows: numerators and
+        capacity denominators are summed exactly across engines, the
+        ratios derived once here (never averaged)."""
+        totals = self.perf_totals()
+        if totals is None:
+            return
+        from gofr_tpu.metrics import perf as perf_mod
+
+        for key, rec in totals["kinds"].items():
+            kind, _, dtype = key.partition("|")
+            labels = {"kind": kind, "kv_dtype": dtype}
+            self.metrics.set_gauge(
+                "app_tpu_perf_flops_window", rec["flops"], **labels)
+            self.metrics.set_gauge(
+                "app_tpu_perf_bytes_window", rec["bytes"], **labels)
+            self.metrics.set_gauge(
+                "app_tpu_perf_device_seconds_window", rec["device_s"], **labels)
+            if rec["flops_cap"]:
+                self.metrics.set_gauge(
+                    "app_tpu_mfu", rec["flops"] / rec["flops_cap"], **labels)
+            if rec["bytes_cap"]:
+                self.metrics.set_gauge(
+                    "app_tpu_mbu", rec["bytes"] / rec["bytes_cap"], **labels)
+        ratio = perf_mod.derive(totals)["bubble_ratio"]
+        if ratio is not None:
+            self.metrics.set_gauge("app_tpu_pipeline_bubble_ratio", ratio)
+        for name, e in self._engines.items():
+            stats_fn = getattr(e, "page_pool_stats", None)
+            stats = stats_fn() if callable(stats_fn) else None
+            if stats:
+                self.metrics.set_gauge(
+                    "app_tpu_kv_pool_occupancy", stats["occupancy"], engine=name)
+                self.metrics.set_gauge(
+                    "app_tpu_kv_pool_fragmentation", stats["fragmentation"],
+                    engine=name)
 
     def _maybe_remote_log_level(self) -> None:
         url = self.config.get("REMOTE_LOG_URL")

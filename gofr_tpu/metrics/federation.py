@@ -44,6 +44,7 @@ __all__ = [
     "DIGEST_COUNTERS",
     "DIGEST_GAUGES",
     "DIGEST_HISTOGRAMS",
+    "aggregate_perf",
     "aggregate_slo",
     "digest",
     "fleet_text",
@@ -78,6 +79,7 @@ def _ls_from_json(pairs: Iterable[Iterable[str]]) -> LabelSet:
 
 
 def digest(registry: Registry, *, slo=None, inflight: int | None = None,
+           perf: Mapping[str, Any] | None = None,
            counters: Iterable[str] = DIGEST_COUNTERS,
            histograms: Iterable[str] = DIGEST_HISTOGRAMS,
            gauges: Iterable[str] = DIGEST_GAUGES) -> dict[str, Any]:
@@ -110,6 +112,11 @@ def digest(registry: Registry, *, slo=None, inflight: int | None = None,
         out["slo"] = slo.snapshot()
     if inflight is not None:
         out["inflight"] = int(inflight)
+    if perf is not None:
+        # the perf-plane window totals (metrics/perf.py merge_totals
+        # payload): exact numerator/denominator sums, so the router can
+        # merge replicas the same way it merges SLO counts
+        out["perf"] = dict(perf)
     return out
 
 
@@ -237,6 +244,7 @@ def fleet_text(digests: Mapping[str, Mapping[str, Any]],
                 lines.append(f"{name}{_fmt_labels(ls)} {_fmt_value(v)}")
 
     _slo_lines(digests, lines)
+    _perf_lines(digests, lines)
     _state_lines(digests, states or {}, lines)
     return "\n".join(lines) + "\n"
 
@@ -287,6 +295,66 @@ def _slo_lines(digests: Mapping[str, Mapping[str, Any]],
     lines.extend(att)
     lines.append("# TYPE app_slo_burn_rate gauge")
     lines.extend(burn)
+
+
+def _perf_lines(digests: Mapping[str, Mapping[str, Any]],
+                lines: list[str]) -> None:
+    """Fleet roofline gauges from the perf digests: like SLO attainment,
+    the aggregate MFU/MBU is recomputed from summed FLOPs/bytes over
+    summed capacity (device_s x peak) — never an average of per-replica
+    ratios, which would weight an idle replica the same as a saturated
+    one."""
+    from gofr_tpu.metrics import perf as perf_mod
+
+    have = any(d.get("perf") for d in digests.values())
+    if not have:
+        return
+    fleet = aggregate_perf(digests)
+    derived = perf_mod.derive(fleet)
+    for gname, util in (("app_tpu_mfu", derived["mfu"]),
+                        ("app_tpu_mbu", derived["mbu"])):
+        lines.append(f"# TYPE {gname} gauge")
+        for key in sorted(util):
+            kind, _, dtype = key.partition("|")
+            ls: LabelSet = tuple(sorted(
+                (("kind", kind), ("kv_dtype", dtype))))
+            lines.append(f"{gname}{_fmt_labels(ls)} {_fmt_value(util[key])}")
+        for replica in sorted(digests):
+            part = digests[replica].get("perf")
+            if not part:
+                continue
+            rd = perf_mod.derive(part)["mfu" if gname.endswith("mfu") else "mbu"]
+            for key in sorted(rd):
+                kind, _, dtype = key.partition("|")
+                ls = tuple(sorted((("kind", kind), ("kv_dtype", dtype),
+                                   ("replica", replica))))
+                lines.append(
+                    f"{gname}{_fmt_labels(ls)} {_fmt_value(rd[key])}")
+    lines.append("# TYPE app_tpu_pipeline_bubble_ratio gauge")
+    ratio = derived["bubble_ratio"]
+    if ratio is not None:
+        lines.append(f"app_tpu_pipeline_bubble_ratio {_fmt_value(ratio)}")
+    for replica in sorted(digests):
+        part = digests[replica].get("perf")
+        if not part:
+            continue
+        r = perf_mod.derive(part)["bubble_ratio"]
+        if r is not None:
+            ls = (("replica", replica),)
+            lines.append(
+                f"app_tpu_pipeline_bubble_ratio{_fmt_labels(ls)} "
+                f"{_fmt_value(r)}")
+
+
+def aggregate_perf(digests: Mapping[str, Mapping[str, Any]]) -> dict[str, Any]:
+    """Exact fleet perf roll-up: merge every replica's perf-window totals
+    (metrics/perf.py payload) by summing FLOPs/bytes numerators and
+    capacity denominators per (kind, kv_dtype). Feed the result to
+    ``perf.derive`` for fleet MFU/MBU/bubble ratios."""
+    from gofr_tpu.metrics import perf as perf_mod
+
+    return perf_mod.merge_totals(
+        d.get("perf") for d in digests.values() if d.get("perf"))
 
 
 def _state_lines(digests: Mapping[str, Mapping[str, Any]],

@@ -36,21 +36,34 @@ class FlightRecorder:
             self._requests.append(entry)
 
     def record_step(self, kind: str, seconds: float, occupancy: float,
-                    signature: Any, backlog: int = 0, inflight: int = 0) -> None:
+                    signature: Any, backlog: int = 0, inflight: int = 0,
+                    device_s: float | None = None, bytes_: float | None = None,
+                    flops: float | None = None,
+                    bubble_s: float | None = None) -> None:
         # With the unified async pipeline, steps are recorded at COMPLETION
         # (dequeue) time; `seconds` spans dispatch→fold and `inflight` is
         # the in-flight queue depth left after this entry was dequeued —
         # 0 on every step means the pipeline is running synchronously.
+        # The perf plane (metrics/perf.py) adds the roofline view per step:
+        # `device_s` is overlap-deduplicated device-queue residency,
+        # `bytes`/`flops` the analytical cost from the step's actual
+        # shapes, `bubble` the device-idle-while-work-queued gap in front.
+        entry = {
+            "at": time.time(),
+            "kind": kind,
+            "seconds": round(float(seconds), 6),
+            "occupancy": round(float(occupancy), 4),
+            "signature": str(signature),
+            "backlog": int(backlog),
+            "inflight": int(inflight),
+        }
+        if device_s is not None:
+            entry["device_s"] = round(float(device_s), 6)
+            entry["bytes"] = float(bytes_ or 0.0)
+            entry["flops"] = float(flops or 0.0)
+            entry["bubble"] = round(float(bubble_s or 0.0), 6)
         with self._lock:
-            self._steps.append({
-                "at": time.time(),
-                "kind": kind,
-                "seconds": round(float(seconds), 6),
-                "occupancy": round(float(occupancy), 4),
-                "signature": str(signature),
-                "backlog": int(backlog),
-                "inflight": int(inflight),
-            })
+            self._steps.append(entry)
 
     # -- inspection (debug endpoints / tests) ----------------------------------
 

@@ -509,6 +509,20 @@ class CaptureWatcher:
                                  if hasattr(engine, "health_check") else {})
             except Exception as e:  # noqa: BLE001 - a broken probe is itself evidence
                 engines[name] = {"status": "DOWN", "error": repr(e)}
+        perf = None
+        try:
+            # the roofline state at breach time: was the device starved
+            # (bubble) or saturated (MFU/MBU) when the burn started?
+            planes = {
+                name: e.perf.snapshot(time.monotonic())
+                for name, e in getattr(self.container, "engines", {}).items()
+                if getattr(e, "perf", None) is not None}
+            perf_fn = getattr(self.container, "perf_totals", None)
+            totals = perf_fn() if callable(perf_fn) else None
+            if planes or totals:
+                perf = {"engines": planes, "totals": totals}
+        except Exception:  # noqa: BLE001 - capture is best-effort diagnostics
+            perf = None
         bundle = {
             "ts": self._clock(),
             "reason": breaches,
@@ -520,6 +534,7 @@ class CaptureWatcher:
                           if flight is not None else []),
             },
             "engines": engines,
+            "perf": perf,
         }
         with open(os.path.join(path, "bundle.json"), "w") as f:
             json.dump(bundle, f, indent=1, default=str)

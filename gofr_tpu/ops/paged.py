@@ -197,6 +197,27 @@ class Q4PagedKVCache:
         return self.k.shape[3]
 
 
+def kv_plane_bytes_per_position(layers: int, kv_heads: int, head_dim: int,
+                                kv_dtype: str = "bf16",
+                                dense_bytes: int = 2) -> int:
+    """Analytic per-position pool footprint across every cache plane, by
+    layout contract: dense pools carry k+v at ``dense_bytes`` per element
+    (bf16 on TPU; pass 4 where the backend promotes to fp32, as CPU
+    does), the int8 pool carries k+v int8 plus the two bf16 scale planes,
+    and the packed-int4 pool halves the nibble planes. This is the
+    cross-check for the EXACT accounting the live perf plane reads off
+    the pool leaves (metrics/perf.py) and what bench archives as
+    ``kv_bytes_per_decode_token`` — on the tiny CPU config the three
+    layouts come out 512 / 144 / 80."""
+    if kv_dtype == "int4":
+        per = 2 * (head_dim // 2) + 4   # packed k+v nibbles + bf16 scales
+    elif kv_dtype in ("int8", "q", "quant"):
+        per = 2 * head_dim + 4          # int8 k+v + bf16 scale planes
+    else:
+        per = 2 * head_dim * int(dense_bytes)
+    return layers * kv_heads * per
+
+
 def write_prompts_paged_q(
     cache_q: jnp.ndarray,  # int8 [P, Hkv, page, D] (one of k/v)
     cache_s: jnp.ndarray,  # [P, Hkv, page]

@@ -740,6 +740,10 @@ class Router:
                 digests[name] = r.digest
                 d["inflight"] = r.digest.get("inflight")
                 d["slo"] = _slo_brief(r.digest.get("slo"))
+                if r.digest.get("perf"):
+                    from gofr_tpu.metrics import perf as perf_mod
+
+                    d["perf"] = perf_mod.derive(r.digest["perf"])
             counts = per_replica.get(name)
             if counts:
                 sent = counts["home"] + counts["spill"]
@@ -751,11 +755,19 @@ class Router:
                 # gossip (disaggregated serving, docs/serving.md)
                 d["handoff"] = r.handoff
             replicas.append(d)
-        return {
+        out: dict[str, Any] = {
             "replicas": replicas,
             "classes": federation.aggregate_slo(digests),
             "stats": stats,
         }
+        if any(d.get("perf") for d in digests.values()):
+            from gofr_tpu.metrics import perf as perf_mod
+
+            totals = federation.aggregate_perf(digests)
+            # fleet MFU/MBU recomputed from the summed windows — the same
+            # sum-of-parts discipline as the SLO roll-up above
+            out["perf"] = {"totals": totals, **perf_mod.derive(totals)}
+        return out
 
     def debug_view(self) -> dict[str, Any]:
         """The /debug/router payload: ring membership, per-replica state,

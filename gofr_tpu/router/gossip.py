@@ -118,9 +118,11 @@ class GossipReporter:
             try:
                 from gofr_tpu.metrics import federation
 
+                perf_fn = getattr(self.container, "perf_totals", None)
                 snap["digest"] = federation.digest(
                     self.container.metrics,
                     slo=getattr(self.container, "slo", None),
+                    perf=perf_fn() if callable(perf_fn) else None,
                     inflight=sum(
                         int(getattr(e, "_inflight_requests", 0))
                         for e in self.container.engines.values()))

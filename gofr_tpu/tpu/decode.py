@@ -36,11 +36,11 @@ from gofr_tpu.http.errors import RequestTimeout
 from gofr_tpu.tpu.lockstep import TAG_DECODE, TAG_SPEC
 
 
-def _fold_spec(eng, toks, accs, meta, k) -> None:
+def _fold_spec(eng, toks, accs, meta, k, dev_s: float = 0.0) -> None:
     """Replay one spec round's device acceptance into slot state. Caller
     holds the state lock. ``toks`` [k, n, g+1], ``accs`` [k, n]."""
     now = time.monotonic()
-    emitted = accepted = folded = 0
+    emitted = accepted = folded = trimmed = 0
     for i, s in meta:
         if eng.slots[i] is not s:
             continue  # freed/preempted/reassigned while in flight
@@ -57,6 +57,8 @@ def _fold_spec(eng, toks, accs, meta, k) -> None:
         # include the finishing round). Surfaces as the spec.accept_rate
         # span attribute and flight-recorder field.
         kw = s.request.kw
+        if dev_s:
+            kw["_dev_decode_s"] = kw.get("_dev_decode_s", 0.0) + dev_s
         kw["_spec_proposed"] = kw.get("_spec_proposed", 0) + k * eng.spec_tokens
         for kk in range(k):
             a = int(accs[kk, i])
@@ -81,7 +83,7 @@ def _fold_spec(eng, toks, accs, meta, k) -> None:
             # table snapshot may write to any page claimed at its
             # dispatch (dispatch_spec_paged over-claims for the
             # worst-case accepted span)
-            eng._trim_lane_pages(i, s, max(s.pos - 1, 0))
+            trimmed += eng._trim_lane_pages(i, s, max(s.pos - 1, 0))
     eng.metrics.increment_counter("app_tpu_tokens_total", emitted)
     # proposed counts only lanes whose acceptance was folded — a lane
     # discarded mid-flight (freed/preempted/cancelled) contributes to
@@ -89,6 +91,16 @@ def _fold_spec(eng, toks, accs, meta, k) -> None:
     eng.metrics.increment_counter(
         "app_tpu_spec_proposed", k * eng.spec_tokens * folded)
     eng.metrics.increment_counter("app_tpu_spec_accepted", accepted)
+    # over-claim policy waste, metered where it happens: pages claimed at
+    # dispatch for drafts the fold rejected, and the rejected tokens
+    # themselves — target flops spent without tokens emitted
+    if trimmed:
+        eng.metrics.increment_counter(
+            "app_tpu_spec_pages_trimmed_total", trimmed)
+    rejected = k * eng.spec_tokens * folded - accepted
+    if rejected > 0:
+        eng.metrics.increment_counter(
+            "app_tpu_spec_tokens_rejected_total", rejected)
 
 
 def dispatch_spec_paged(eng) -> bool:
@@ -154,6 +166,9 @@ def dispatch_spec_paged(eng) -> bool:
         for _, s in lanes:
             s.inflight += 1
         occupancy = len(lanes) / n
+        # perf-plane history floor: pages the attention stream can touch
+        # this round (the tables snapshotted above), in positions
+        hist = sum(len(eng._slot_pages[i]) for i, _ in lanes) * eng.page_size
         t0 = time.monotonic()
 
     eng._announce(TAG_SPEC, packed.shape[0], 1, packed)  # b=1: live, carry applies
@@ -162,8 +177,11 @@ def dispatch_spec_paged(eng) -> bool:
         carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
     toks_dev, accs_dev, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
         eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), carry)
+    pstep = (eng.perf.step_spec(len(lanes), k, eng.spec_tokens, hist, t0)
+             if eng.perf is not None else None)
     eng._dq.append(("spec", (toks_dev, accs_dev), [(i, s) for i, s in lanes],
-                    t0, occupancy, ("decode_spec", n, k, eng.spec_tokens)))
+                    t0, occupancy, ("decode_spec", n, k, eng.spec_tokens),
+                    pstep))
     return True
 
 
@@ -210,6 +228,10 @@ def dispatch_spec(eng) -> bool:
         for _, s in lanes:
             s.inflight += 1
         occupancy = len(lanes) / n
+        # perf-plane history floor: worst-case positions this round's
+        # attention streams per lane (device carry may be ahead of pos)
+        hist = sum(min(s.pos + span * s.inflight + 1, s.max_total)
+                   for _, s in lanes)
         t0 = time.monotonic()
 
     eng._announce(TAG_SPEC, packed.shape[0], 1, packed)  # b=1: live, carry applies
@@ -218,8 +240,11 @@ def dispatch_spec(eng) -> bool:
         carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
     toks_dev, accs_dev, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
         eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), carry)
+    pstep = (eng.perf.step_spec(len(lanes), k, eng.spec_tokens, hist, t0)
+             if eng.perf is not None else None)
     eng._dq.append(("spec", (toks_dev, accs_dev), [(i, s) for i, s in lanes],
-                    t0, occupancy, ("decode_spec", n, k, eng.spec_tokens)))
+                    t0, occupancy, ("decode_spec", n, k, eng.spec_tokens),
+                    pstep))
     return True
 
 
@@ -290,6 +315,13 @@ def dispatch_decode(eng) -> bool:
         for _, s, _ in lanes:
             s.inflight += 1
         occupancy = len(lanes) / n
+        # perf-plane history floor: positions (slot) / pages-touched
+        # (paged) this chunk's attention streams, from dispatch shapes
+        if eng.kv_layout == "paged":
+            hist = sum(len(eng._slot_pages[i])
+                       for i, _, _ in lanes) * eng.page_size
+        else:
+            hist = sum(p + 1 for _, _, p in lanes)
         t0 = time.monotonic()
 
     eng._announce(TAG_DECODE, 1, 0, packed)  # a=1: live, carry applies
@@ -300,8 +332,10 @@ def dispatch_decode(eng) -> bool:
         eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), prev
     )
     eng._prev_last = last_dev
+    pstep = (eng.perf.step_decode(len(lanes), k, hist, t0)
+             if eng.perf is not None else None)
     eng._dq.append(("plain", chunk_dev, [(i, s) for i, s, _ in lanes],
-                    t0, occupancy, ("decode", n, k)))
+                    t0, occupancy, ("decode", n, k), pstep))
     return True
 
 
@@ -315,12 +349,16 @@ def process_decode(eng) -> bool:
     prefix-cache host→device page swap-ins."""
     if not eng._dq:
         return False
-    kind, dev, meta, t0, occupancy, sig = eng._dq.popleft()
+    kind, dev, meta, t0, occupancy, sig, pstep = eng._dq.popleft()
     if kind == "spec":
         toks = np.asarray(dev[0])  # [k, n, g+1] int32 — tokens, never logits
         accs = np.asarray(dev[1])  # [k, n]
     else:
         chunk = np.asarray(dev)  # int32 tokens, never logits
+    if pstep is not None:
+        # the result just landed on the host: everything from here on is
+        # fold time, not device time (perf plane separates the two)
+        pstep.t_ready = time.monotonic()
     if eng._poisoned:
         # stop() declared this thread wedged and already failed/cleared
         # everything; the slot/page state now belongs to the caller.
@@ -328,22 +366,24 @@ def process_decode(eng) -> bool:
     if kind == "swapin":
         # chunk is the upload's completion marker (already read back above,
         # i.e. the host→device page copy has landed); fold is bookkeeping
-        eng._fold_swapin(meta, t0, occupancy, sig)
+        eng._fold_swapin(meta, t0, occupancy, sig, pstep)
         return True
     if kind == "prefill":
-        eng._fold_prefill(chunk, meta, t0, occupancy, sig)
+        eng._fold_prefill(chunk, meta, t0, occupancy, sig, pstep)
         return True
     if kind == "chunk":
-        eng._fold_chunk(chunk, meta, t0, occupancy, sig)
+        eng._fold_chunk(chunk, meta, t0, occupancy, sig, pstep)
         return True
     n, k = sig[1], sig[2]
     with eng._state_lock:
         if kind == "spec":
-            eng._record_step("decode_spec", time.monotonic() - t0, occupancy,
-                              ("decode_spec", n, k, eng.spec_tokens))
-            _fold_spec(eng, toks, accs, meta, k)
+            dev_s = eng._record_step(
+                "decode_spec", time.monotonic() - t0, occupancy,
+                ("decode_spec", n, k, eng.spec_tokens), pstep)
+            _fold_spec(eng, toks, accs, meta, k, dev_s)
             return True
-        eng._record_step("decode", time.monotonic() - t0, occupancy, ("decode", n, k))
+        dev_s = eng._record_step("decode", time.monotonic() - t0, occupancy,
+                                 ("decode", n, k), pstep)
 
         now = time.monotonic()
         accepted = 0
@@ -351,6 +391,9 @@ def process_decode(eng) -> bool:
             if eng.slots[i] is not s:
                 continue  # freed/preempted/reassigned while in flight
             s.inflight -= 1
+            if dev_s:
+                kw = s.request.kw
+                kw["_dev_decode_s"] = kw.get("_dev_decode_s", 0.0) + dev_s
             if s.request.cancelled or s.request.expired(now):
                 # slot invalidation: free the lane; in-flight work is discarded
                 eng._free_slot(i)

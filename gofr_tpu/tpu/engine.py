@@ -507,6 +507,15 @@ class _EngineBase:
             "preemptions": kw.get("_preemptions", 0),
             "trace_id": rt.trace_id if rt is not None else None,
         }
+        dev = {label: round(kw[f], 6) for label, f in (
+            ("prefill_s", "_dev_prefill_s"), ("decode_s", "_dev_decode_s"),
+            ("swapin_s", "_dev_swapin_s")) if kw.get(f)}
+        if dev:
+            # device-queue residency while this request had work in flight,
+            # per phase (folds accumulate it from the perf plane's clipped
+            # step times) — with queue_wait_s and e2e_s this answers
+            # "queue, device, or fold?" for a slow request
+            entry["device"] = dev
         proposed = kw.get("_spec_proposed")
         if proposed:
             entry["spec_accept_rate"] = round(
@@ -555,15 +564,33 @@ class _EngineBase:
                 self.slo.observe(req.kw.get("_qos_class"), "ttft",
                                  ft - req.enqueued_at)
 
-    def _record_step(self, kind: str, seconds: float, occupancy: float, signature: tuple) -> None:
+    def _record_step(self, kind: str, seconds: float, occupancy: float,
+                     signature: tuple, pstep=None) -> float:
         # called at COMPLETION (dequeue) time under the unified pipeline:
         # `seconds` spans dispatch→fold, so it includes the overlapped
-        # in-flight wait, not just device compute
+        # in-flight wait, not just device compute. `pstep` (a perf.StepPerf
+        # built at dispatch, t_ready stamped right after readback) carries
+        # the roofline side: the perf plane clips it to true device-queue
+        # residency and bubble, recorded separately from this wall span.
         self.metrics.record_histogram("app_tpu_step_seconds", seconds, kind=kind)
         self.metrics.record_histogram("app_tpu_batch_occupancy", occupancy, kind=kind)
+        device_s = 0.0
+        perf = getattr(self, "perf", None)
+        if pstep is not None and perf is not None:
+            perf.note(pstep, time.monotonic())
+            device_s = pstep.device_s
+            self.metrics.record_histogram(
+                "app_tpu_step_device_seconds", device_s, kind=kind)
         if self.flight is not None:
-            self.flight.record_step(kind, seconds, occupancy, signature,
-                                    self._backlog(), len(getattr(self, "_dq", ())))
+            if pstep is not None:
+                self.flight.record_step(
+                    kind, seconds, occupancy, signature,
+                    self._backlog(), len(getattr(self, "_dq", ())),
+                    device_s=device_s, bytes_=pstep.bytes,
+                    flops=pstep.flops, bubble_s=pstep.bubble_s)
+            else:
+                self.flight.record_step(kind, seconds, occupancy, signature,
+                                        self._backlog(), len(getattr(self, "_dq", ())))
         if self.qos is not None:
             self.qos.observe_step(seconds)  # feeds the queue-wait estimator
         if signature in self._compiled:
@@ -571,6 +598,7 @@ class _EngineBase:
         else:
             self._compiled.add(signature)
             self.tpu.record_compile()
+        return device_s
 
     def health_check(self) -> dict[str, Any]:
         if self._startup_error is not None:
@@ -1174,6 +1202,40 @@ class GenerateEngine(_EngineBase):
                 self.params = params
             self.cache = self._build_slot_cache()
             self._prefix = None  # prefix caching needs the paged layout
+        # -- live perf plane (metrics/perf.py; ROADMAP O3) -------------------
+        # Exact accounting from the live pytrees: parameter bytes post-
+        # quantization and the per-position pool footprint read off the
+        # cache leaves (the 512/144/80 bf16/int8/int4 planes on the tiny
+        # CPU config — NOT a nominal-dtype estimate, which would be 2x off
+        # on backends that promote bf16 to fp32). Defensive: an exotic
+        # family/pytree must never take the engine down with its meter.
+        try:
+            from gofr_tpu.metrics.perf import CostModel, PerfPlane
+            from gofr_tpu.ops.quant import quantized_bytes
+
+            if kv_layout == "paged":
+                positions = self.total_pages * self.page_size
+            else:
+                positions = slots * self._cache_len
+            pool_bytes = sum(
+                leaf.nbytes for leaf in jax.tree.leaves(self.kv_cache))
+            devices = getattr(self.tpu, "devices", None)
+            dev_kind = (getattr(devices[0], "device_kind", None) if devices
+                        else None) or getattr(self.tpu, "platform", "cpu")
+            self.perf = PerfPlane(
+                CostModel(
+                    n_params=sum(
+                        leaf.size for leaf in jax.tree.leaves(self.params)),
+                    weight_bytes=quantized_bytes(self.params),
+                    kv_bytes_per_pos=pool_bytes / max(1, positions),
+                    page_bytes=getattr(self, "_page_bytes", 0.0),
+                    page_size=page_size if kv_layout == "paged" else 0,
+                    kv_dtype=self.kv_quantize or "bf16",
+                ),
+                str(dev_kind))
+        except Exception as e:  # pragma: no cover - meter must not gate serving
+            container.logger.warn(f"perf plane disabled: {e}")
+            self.perf = None
         # multi-host lockstep (tpu/lockstep.py): the leader announces every
         # device call so follower processes issue the same global programs.
         # ``fleet`` (a fleet.FleetConfig) switches the announce transport to
@@ -1494,6 +1556,28 @@ class GenerateEngine(_EngineBase):
         when autotune is disabled) — surfaced at /debug/engine and recorded
         in the bench JSON."""
         return self._autotune
+
+    def page_pool_stats(self) -> dict | None:
+        """Paged-pool waste view for the perf plane: occupancy (allocated
+        fraction of usable pages) and fragmentation (claimed page positions
+        no live sequence has written yet — trailing partial pages plus
+        spec over-claim not yet trimmed). None on the slot layout."""
+        if self.kv_layout != "paged":
+            return None
+        with self._state_lock:
+            free = len(self._free_pages)
+            held = sum(len(p) for p in self._slot_pages)
+            live = sum(s.pos for s in self.slots if s is not None)
+        usable = max(1, self.total_pages - self._page_sink)
+        covered = held * self.page_size
+        return {
+            "total_pages": self.total_pages,
+            "free_pages": free,
+            "slot_pages": held,
+            "occupancy": round(1.0 - free / usable, 4),
+            "fragmentation": round(1.0 - min(1.0, live / covered), 4)
+            if covered else 0.0,
+        }
 
     def submit(
         self,
@@ -2398,6 +2482,10 @@ class GenerateEngine(_EngineBase):
                 # round trip would skew QoS wait metrics and fair credits,
                 # and could reorder same-class FIFO arrivals)
                 self._queue.wait_nonempty(0.2)
+                if self.perf is not None:
+                    # nothing queued, nothing in flight: advance the bubble
+                    # floor so true idleness never counts as pipeline bubble
+                    self.perf.mark_no_work(time.monotonic())
 
     # -- admission / prefill ---------------------------------------------------
 
@@ -2520,21 +2608,24 @@ class GenerateEngine(_EngineBase):
         return True
 
     def _fold_chunk(self, first: np.ndarray, meta, t0: float,
-                    occupancy: float, sig: tuple) -> None:
+                    occupancy: float, sig: tuple, pstep=None) -> None:
         """Dequeue side of one prefill chunk (called by process_decode with
         the tokens already read back). Lanes freed/preempted since dispatch
         are discarded by identity — the same discipline decode uses."""
         idx, s, chunk, offset, last = meta
         lb = sig[1]
         with self._state_lock:
-            self._record_step("prefill_chunk", time.monotonic() - t0,
-                              occupancy, sig)
+            dev_s = self._record_step("prefill_chunk", time.monotonic() - t0,
+                                      occupancy, sig, pstep)
             if self.slots[idx] is not s:
                 return  # stop()/preemption/cancel took over while in flight
             if s.request.cancelled or s.request.expired(time.monotonic()):
                 self._free_slot(idx)
                 s.request.complete(error=RequestTimeout())
                 return
+            if dev_s:
+                kw = s.request.kw
+                kw["_dev_prefill_s"] = kw.get("_dev_prefill_s", 0.0) + dev_s
             self.metrics.increment_counter("app_tpu_tokens_total", chunk)
             s.written += chunk
             rt = s.request.kw.get("_rt")
@@ -2579,7 +2670,8 @@ class GenerateEngine(_EngineBase):
         locking/fold contract."""
         return executor.dispatch_swapins(self)
 
-    def _fold_swapin(self, meta, t0: float, occupancy: float, sig: tuple) -> None:
+    def _fold_swapin(self, meta, t0: float, occupancy: float, sig: tuple,
+                     pstep=None) -> None:
         """Dequeue side of one swap-in (process_decode already blocked on
         the upload's completion marker). Settles the promoted nodes — they
         become spillable again — whatever happened to the slot; per-slot
@@ -2590,7 +2682,7 @@ class GenerateEngine(_EngineBase):
         idx, s, keys, n_pages, nbytes = meta
         now = time.monotonic()
         with self._state_lock:
-            self._record_step("swapin", now - t0, occupancy, sig)
+            dev_s = self._record_step("swapin", now - t0, occupancy, sig, pstep)
             if self._prefix is not None:
                 for key in keys:
                     self._prefix.settle(key)
@@ -2602,6 +2694,9 @@ class GenerateEngine(_EngineBase):
                 "app_tpu_prefix_swapin_bytes", nbytes)
             if self.slots[idx] is not s:
                 return  # freed/preempted/cancelled mid-swap-in
+            if dev_s:
+                kw = s.request.kw
+                kw["_dev_swapin_s"] = kw.get("_dev_swapin_s", 0.0) + dev_s
             rt = s.request.kw.get("_rt")
             if rt is not None:
                 rt.event("engine.prefill", "swapin",
@@ -2779,14 +2874,15 @@ class GenerateEngine(_EngineBase):
         return True
 
     def _fold_prefill(self, first: np.ndarray, meta, t0: float,
-                      occupancy: float, sig: tuple) -> None:
+                      occupancy: float, sig: tuple, pstep=None) -> None:
         """Dequeue side of a batched prefill: activate each slot claimed at
         dispatch with its sampled first token. Lanes whose slot object
         changed since dispatch (stop()'s _fail_all, preemption, cancel)
         are discarded by identity — their requests were already completed
         and their pages returned by _free_slot."""
         with self._state_lock:
-            self._record_step("prefill", time.monotonic() - t0, occupancy, sig)
+            dev_s = self._record_step("prefill", time.monotonic() - t0,
+                                      occupancy, sig, pstep)
             now = time.monotonic()
             tokens = 0
             for row, (idx, s) in enumerate(meta):
@@ -2796,6 +2892,9 @@ class GenerateEngine(_EngineBase):
                     self._free_slot(idx)
                     s.request.complete(error=RequestTimeout())
                     continue
+                if dev_s:
+                    kw = s.request.kw
+                    kw["_dev_prefill_s"] = kw.get("_dev_prefill_s", 0.0) + dev_s
                 tokens += s.prompt_len + 1
                 rt = s.request.kw.get("_rt")
                 if rt is not None:

@@ -130,8 +130,11 @@ def dispatch_prefill(eng, plan: PrefillPlan) -> None:
     # tokens, never logits — and NEVER read back here: the future rides
     # the in-flight queue; _fold_prefill activates the claimed slots at
     # dequeue, overlapped with whatever dispatches after this call
+    pstep = (eng.perf.step_prefill(
+        sum(toks.shape[0] for _, toks in plan.ready), plan.t0)
+        if eng.perf is not None else None)
     eng._dq.append(("prefill", first_dev, plan.meta, plan.t0,
-                    len(plan.ready) / nb, ("prefill", lb, nb)))
+                    len(plan.ready) / nb, ("prefill", lb, nb), pstep))
 
 
 def dispatch_chunk(eng, plan: ChunkPlan) -> None:
@@ -158,9 +161,11 @@ def dispatch_chunk(eng, plan: ChunkPlan) -> None:
     first_dev, eng.cache = eng._chunk_prefill(
         eng.params, eng._base_key, eng.cache, jnp.asarray(packed)
     )
+    pstep = (eng.perf.step_chunk(chunk, offset, plan.t0)
+             if eng.perf is not None else None)
     eng._dq.append(("chunk", first_dev,
                     (plan.idx, s, chunk, offset, plan.last),
-                    plan.t0, chunk / lb, ("prefill_chunk", lb, 1)))
+                    plan.t0, chunk / lb, ("prefill_chunk", lb, 1), pstep))
 
 
 def dispatch_swapins(eng) -> bool:
@@ -202,8 +207,10 @@ def dispatch_swapins(eng) -> bool:
         # the histogram records the ACTUAL transfer (padded width) so
         # swap-in latency and bytes stay comparable
         nbytes = w * eng._page_bytes
+        pstep = (eng.perf.step_swapin(nbytes, t0)
+                 if eng.perf is not None else None)
         eng._dq.append(("swapin", marker, (idx, slot, keys, n, nbytes),
-                        t0, n / w, ("swapin", w)))
+                        t0, n / w, ("swapin", w), pstep))
     return True
 
 

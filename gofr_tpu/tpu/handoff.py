@@ -270,7 +270,15 @@ class HandoffExporter:
         req = job.request
         # device→host readback OUTSIDE every engine lock: the gathers were
         # dispatched at activation; np.asarray blocks on them here
+        t_rb = time.monotonic()
         host_pages = [tuple(np.asarray(a) for a in page) for page in job.payloads]
+        plane = getattr(self.engine, "perf", None)
+        if plane is not None:
+            # off-device-thread transfer: contributes bytes/device_s to the
+            # roofline window but never moves the _dq bubble floor
+            now = time.monotonic()
+            flops, bytes_ = plane.model.handoff_export(len(host_pages))
+            plane.note_external("handoff_export", now - t_rb, flops, bytes_, now)
         if req.cancelled or req.expired(time.monotonic()):
             self._fail(job, "request expired before KV export began")
             return

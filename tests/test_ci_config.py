@@ -355,6 +355,51 @@ def test_ci_runs_the_kvdtype_smoke():
         assert arm in checks, f"verdict step never mentions the {arm} arm"
 
 
+def test_perf_plane_suite_is_in_quick_tier():
+    """ISSUE 14 satellite: the live-perf-plane suite — cost model vs
+    hand-computed FLOPs/bytes for every step kind and all three KV dtype
+    planes, fake-clock bubble accounting, GOFR_DEVICE_PEAKS resolution,
+    sum-of-parts federation merges, and the capture/debug surfaces — is
+    CPU-fast and must ride the `-m quick` CI job on every push."""
+    path = REPO / "tests" / "test_perf_plane.py"
+    assert path.exists(), "tests/test_perf_plane.py missing"
+    text = path.read_text()
+    assert "pytestmark = pytest.mark.quick" in text, (
+        "test_perf_plane.py must be quick-marked module-wide"
+    )
+    assert "test_perf_plane.py" not in QUICK_EXEMPT, (
+        "test_perf_plane.py must not be exempted from the quick tier"
+    )
+    # the tentpole's acceptance pieces: per-dtype plane widths, bubble
+    # semantics, peak overrides, exact merges, and the joined surfaces
+    assert "kv_plane_bytes_per_position" in text
+    assert "mark_no_work" in text and "GOFR_DEVICE_PEAKS" in text
+    assert "merge_totals" in text and "aggregate_perf" in text
+    assert "_debug_perf_handler" in text and "CaptureWatcher" in text
+    assert "app_tpu_mbu" in text
+
+
+def test_ci_runs_the_perf_smoke():
+    """ISSUE 14 satellite: CI must run a short CPU-labelled bench and
+    assert the archive carries the per-kind roofline breakdown
+    (extra.perf) AND that the headline mbu_decode_lb matches a bit-for-bit
+    recomputation from the shared estimator — the one-estimator contract
+    between bench and the live serving plane cannot rot silently."""
+    ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+    job = ci["jobs"].get("bench-perf-smoke")
+    assert job, "ci.yml has no bench-perf-smoke job"
+    runs = " ".join(step.get("run", "") for step in job.get("steps", []))
+    assert "GOFR_BENCH_PLATFORM=cpu" in runs
+    assert "bench.py" in runs
+    # the verdict step recomputes through the SHARED module and checks
+    # the structure the round archives ride on
+    assert "perf.mbu_decode_lb" in runs
+    assert "mbu_decode_lb_params" in runs
+    assert "peaks_nominal" in runs
+    for kind in ("prefill", "decode"):
+        assert kind in runs, f"verdict step never checks the {kind} kind"
+
+
 def test_ci_has_py310_compat_gate():
     """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
     syntax (same-quote nested f-strings) passes every 3.12 job silently and

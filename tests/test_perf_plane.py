@@ -1,0 +1,445 @@
+"""Live engine performance plane (metrics/perf.py): analytical cost
+model vs hand-computed FLOPs/bytes for every step kind and all three KV
+dtype planes, the shared bench/engine decode-MBU estimator, peak-table
+resolution (GOFR_DEVICE_PEAKS / GOFR_TPU_PEAK_* overrides, unknown
+silicon degrades to None), fake-clock ``_dq`` bubble accounting
+(saturated pipeline ~0, forced stall rises, ``mark_no_work`` keeps true
+idleness out), exact sum-of-parts merges (container + fleet federation —
+never averaged ratios), the capture-bundle and ``/debug/perf`` surfaces,
+and a live tiny-engine end-to-end check: ``/metrics`` exposes a non-zero
+decode MBU and the bf16/int8/int4 plane widths order strictly."""
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+import jax
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.metrics import federation, perf
+from gofr_tpu.models import LlamaConfig, llama
+from gofr_tpu.ops.paged import kv_plane_bytes_per_position
+from gofr_tpu.tpu.engine import GenerateEngine
+
+pytestmark = pytest.mark.quick
+
+
+# -- cost model: hand-computed FLOPs/bytes per step kind -----------------------
+
+
+def _model(**kw):
+    base = dict(n_params=1000, weight_bytes=500.0, kv_bytes_per_pos=8.0,
+                page_bytes=64.0, page_size=8, kv_dtype="bf16")
+    base.update(kw)
+    return perf.CostModel(**base)
+
+
+class TestCostModel:
+    def test_prefill(self):
+        m = _model()
+        flops, bytes_ = m.prefill(10)
+        assert flops == 2 * 1000 * 10
+        assert bytes_ == 500 + 10 * 8  # one weight pass + every KV write
+
+    def test_chunk_pays_the_re_read(self):
+        m = _model()
+        flops, bytes_ = m.chunk(4, offset=6)
+        assert flops == 2 * 1000 * 4
+        # weights + attention re-read of offset+chunk cached positions + writes
+        assert bytes_ == 500 + (6 + 4) * 8 + 4 * 8
+
+    def test_decode(self):
+        m = _model()
+        flops, bytes_ = m.decode(lanes=3, k=2, hist_positions=30)
+        assert flops == 2 * 1000 * 3 * 2
+        assert bytes_ == 2 * 500 + 2 * 30 * 8 + 3 * 2 * 8
+
+    def test_spec_counts_every_proposed_position(self):
+        m = _model()
+        flops, bytes_ = m.spec(lanes=2, k=2, g=3, hist_positions=20)
+        # g drafts + 1 bonus verified per lane per micro-step, accepted or not
+        assert flops == 2 * 1000 * 2 * 2 * (3 + 1)
+        assert bytes_ == 2 * 500 + 2 * 20 * 8 + 2 * 2 * 4 * 8
+
+    def test_transfers_have_no_flops(self):
+        m = _model()
+        assert m.swapin(999.0) == (0.0, 999.0)
+        assert m.handoff_export(3) == (0.0, 3 * 64.0)
+
+    @pytest.mark.parametrize("dtype,want", [
+        ("bf16", 2 * 2 * (2 * 16 * 4)),       # dense fp32 (CPU promotion)
+        ("int8", 2 * 2 * (2 * 16 + 4)),       # int8 k+v + bf16 scales
+        ("int4", 2 * 2 * (2 * (16 // 2) + 4)),  # packed nibbles + scales
+    ])
+    def test_plane_widths_match_archived_accounting(self, dtype, want):
+        """The analytic widths reproduce the archived 512/144/80 numbers
+        for the tiny CPU config (layers=2, kv_heads=2, head_dim=16)."""
+        got = kv_plane_bytes_per_position(2, 2, 16, kv_dtype=dtype,
+                                          dense_bytes=4)
+        assert got == want
+        assert want in (512, 144, 80)
+
+    def test_cost_model_uses_each_dtype_width(self):
+        """Same step, three planes: bytes order int4 < int8 < dense —
+        the whole point of the kv-dtype A/B, now visible per step."""
+        outs = {}
+        for dtype in ("bf16", "int8", "int4"):
+            w = kv_plane_bytes_per_position(2, 2, 16, kv_dtype=dtype,
+                                            dense_bytes=4)
+            m = _model(kv_bytes_per_pos=float(w), kv_dtype=dtype)
+            outs[dtype] = m.decode(lanes=2, k=4, hist_positions=64)[1]
+        assert outs["int4"] < outs["int8"] < outs["bf16"]
+
+
+# -- the shared bench/engine estimator -----------------------------------------
+
+
+class TestSharedEstimator:
+    def test_decode_lb_bytes_terms(self):
+        got = perf.decode_lb_bytes(weight_bytes=1000.0, new_tokens=20,
+                                   slots=4, kv_bytes_per_pos=10.0, hist_len=7)
+        assert got == 1000.0 * (20 / 4) + 20 * 7 * 10.0 + 20 * 10.0
+
+    def test_mbu_decode_lb_is_bytes_over_capacity(self):
+        kw = dict(weight_bytes=1000.0, new_tokens=20, slots=4,
+                  kv_bytes_per_pos=10.0, hist_len=7)
+        lb = perf.decode_lb_bytes(**kw)
+        got = perf.mbu_decode_lb(**kw, elapsed_s=2.0, peak_bw=500.0)
+        assert got == pytest.approx(lb / 2.0 / 500.0)
+
+    def test_params_variant_is_the_legacy_weights_only_bound(self):
+        got = perf.mbu_decode_lb_params(weight_bytes=1000.0, new_tokens=20,
+                                        slots=4, elapsed_s=2.0, peak_bw=500.0)
+        assert got == pytest.approx(1000.0 * 20 / 4 / 2.0 / 500.0)
+        # folding KV bytes in strictly raises the bound
+        assert perf.mbu_decode_lb(
+            weight_bytes=1000.0, new_tokens=20, slots=4, kv_bytes_per_pos=10.0,
+            hist_len=7, elapsed_s=2.0, peak_bw=500.0) > got
+
+
+# -- peak resolution -----------------------------------------------------------
+
+
+class TestDevicePeaks:
+    @pytest.fixture(autouse=True)
+    def _clean_env(self, monkeypatch):
+        for var in ("GOFR_DEVICE_PEAKS", "GOFR_TPU_PEAK_TFLOPS",
+                    "GOFR_TPU_PEAK_GBS"):
+            monkeypatch.delenv(var, raising=False)
+
+    def test_builtin_table_substring_match(self):
+        assert perf.device_peaks("TPU v5e") == (197e12, 819e9)
+        assert perf.device_peaks("TPU v5p") == (459e12, 2765e9)  # not "v5"
+        assert perf.device_peaks("cpu")[0] == 1e12  # nominal envelope
+
+    def test_unknown_device_degrades_to_none(self):
+        assert perf.device_peaks("quantum-annealer-9000") is None
+        assert perf.device_peaks("") is None
+
+    def test_gofr_device_peaks_json_override(self, monkeypatch):
+        monkeypatch.setenv("GOFR_DEVICE_PEAKS",
+                           json.dumps({"weird-silicon": [100, 1000]}))
+        assert perf.device_peaks("weird-silicon mk2") == (100e12, 1000e9)
+        # an override can also re-spec a builtin kind
+        monkeypatch.setenv("GOFR_DEVICE_PEAKS", json.dumps({"v5e": [2, 3]}))
+        assert perf.device_peaks("TPU v5e") == (2e12, 3e9)
+
+    def test_component_env_override_wins_over_table(self, monkeypatch):
+        monkeypatch.setenv("GOFR_TPU_PEAK_TFLOPS", "5")
+        assert perf.device_peaks("TPU v5e") == (5e12, 819e9)
+        monkeypatch.setenv("GOFR_TPU_PEAK_GBS", "100")
+        assert perf.device_peaks("TPU v5e") == (5e12, 100e9)
+        # env alone cannot complete an unknown kind's missing component
+        monkeypatch.delenv("GOFR_TPU_PEAK_GBS")
+        assert perf.device_peaks("quantum") is None
+
+    def test_malformed_json_is_ignored(self, monkeypatch):
+        monkeypatch.setenv("GOFR_DEVICE_PEAKS", "{not json")
+        assert perf.device_peaks("TPU v5e") == (197e12, 819e9)
+
+
+# -- bubble accounting on a fake clock -----------------------------------------
+
+
+def _plane(device_kind="TPU v5e", **kw):
+    return perf.PerfPlane(_model(**kw), device_kind)
+
+
+class TestBubbleAccounting:
+    def test_saturated_pipeline_has_no_bubble(self):
+        """Entry t+1 dispatched before entry t folds: residency tiles the
+        device timeline (no double count) and the bubble stays ~0."""
+        p = _plane()
+        s1 = p.step_decode(2, 4, 10, t0=100.0)
+        s1.t_ready = 100.5
+        p.note(s1, 100.5)
+        s2 = p.step_decode(2, 4, 10, t0=100.2)  # overlapped dispatch
+        s2.t_ready = 101.0
+        p.note(s2, 101.0)
+        assert s1.device_s == pytest.approx(0.5)
+        assert s2.bubble_s == 0.0
+        assert s2.device_s == pytest.approx(0.5)  # clipped to floor=100.5
+        tot = p.window_totals(101.0)
+        assert tot["bubble"]["bubble_s"] == pytest.approx(0.0)
+        assert tot["bubble"]["busy_s"] == pytest.approx(1.0)
+        snap = p.snapshot(101.0)
+        assert snap["bubble"]["ratio"] == pytest.approx(0.0)
+
+    def test_forced_stall_raises_the_ratio(self):
+        """Work existed (no mark_no_work) but the next dispatch came 2s
+        after the previous fold — that gap is pipeline bubble."""
+        p = _plane()
+        s1 = p.step_decode(1, 1, 4, t0=100.0)
+        s1.t_ready = 101.0
+        p.note(s1, 101.0)
+        s2 = p.step_decode(1, 1, 4, t0=103.0)  # 2s device-idle gap
+        s2.t_ready = 104.0
+        p.note(s2, 104.0)
+        assert s2.bubble_s == pytest.approx(2.0)
+        snap = p.snapshot(104.0)
+        assert snap["bubble"]["ratio"] == pytest.approx(2.0 / (2.0 + 2.0))
+
+    def test_mark_no_work_keeps_idleness_out(self):
+        """The engine loop's idle branch advances the floor: a genuinely
+        empty queue must not read as pipeline bubble."""
+        p = _plane()
+        s1 = p.step_decode(1, 1, 4, t0=100.0)
+        s1.t_ready = 101.0
+        p.note(s1, 101.0)
+        p.mark_no_work(103.0)  # queue was empty 101 -> 103
+        s2 = p.step_decode(1, 1, 4, t0=103.5)
+        s2.t_ready = 104.0
+        p.note(s2, 104.0)
+        assert s2.bubble_s == pytest.approx(0.5)  # only 103.0 -> 103.5
+
+    def test_note_external_never_moves_the_floor(self):
+        p = _plane()
+        s1 = p.step_decode(1, 1, 4, t0=100.0)
+        s1.t_ready = 101.0
+        p.note(s1, 101.0)
+        p.note_external("handoff_export", 5.0, 0.0, 4096.0, 110.0)
+        s2 = p.step_decode(1, 1, 4, t0=101.5)
+        s2.t_ready = 102.0
+        p.note(s2, 102.0)
+        assert s2.bubble_s == pytest.approx(0.5)  # floor still 101.0
+        tot = p.window_totals(110.0)
+        key = "handoff_export|bf16"
+        assert tot["kinds"][key]["bytes"] == pytest.approx(4096.0)
+        assert tot["kinds"][key]["device_s"] == pytest.approx(5.0)
+
+    def test_window_totals_caps_and_snapshot_utilization(self):
+        # model sized so the utilization survives the snapshot's 6-decimal
+        # rounding (a toy 1000-param model at v5e peaks rounds to 0.0)
+        p = _plane(n_params=1e12, weight_bytes=2e12, kv_bytes_per_pos=1e9)
+        s = p.step_decode(2, 4, 10, t0=50.0)
+        s.t_ready = 52.0
+        p.note(s, 52.0)
+        tot = p.window_totals(52.0)
+        rec = tot["kinds"]["decode|bf16"]
+        flops, bytes_ = p.model.decode(2, 4, 10)
+        assert rec["flops"] == pytest.approx(flops)
+        assert rec["bytes"] == pytest.approx(bytes_)
+        assert rec["flops_cap"] == pytest.approx(rec["device_s"] * 197e12)
+        assert rec["bytes_cap"] == pytest.approx(rec["device_s"] * 819e9)
+        snap = p.snapshot(52.0)
+        k = snap["kinds"]["decode"]
+        assert k["mfu"] == pytest.approx(flops / rec["flops_cap"], rel=1e-4)
+        assert k["mbu"] == pytest.approx(bytes_ / rec["bytes_cap"], rel=1e-4)
+
+    def test_unknown_device_reports_raw_sums_but_no_utilization(self, monkeypatch):
+        for var in ("GOFR_DEVICE_PEAKS", "GOFR_TPU_PEAK_TFLOPS",
+                    "GOFR_TPU_PEAK_GBS"):
+            monkeypatch.delenv(var, raising=False)
+        p = _plane(device_kind="mystery-chip")
+        s = p.step_prefill(16, t0=10.0)
+        s.t_ready = 11.0
+        p.note(s, 11.0)
+        tot = p.window_totals(11.0)
+        rec = tot["kinds"]["prefill|bf16"]
+        assert rec["flops"] > 0 and rec["flops_cap"] == 0.0
+        snap = p.snapshot(11.0)
+        assert snap["peaks"]["flops"] is None
+        assert snap["kinds"]["prefill"]["mfu"] is None
+        assert snap["kinds"]["prefill"]["mbu"] is None
+
+
+# -- exact merges: container and fleet ----------------------------------------
+
+
+def _part(flops, bytes_, device_s, fcap, bcap, bubble=0.0, busy=1.0,
+          key="decode|bf16"):
+    return {"v": 1, "window_s": 60.0,
+            "kinds": {key: {"flops": flops, "bytes": bytes_,
+                            "device_s": device_s, "steps": 1.0,
+                            "flops_cap": fcap, "bytes_cap": bcap}},
+            "bubble": {"bubble_s": bubble, "busy_s": busy}}
+
+
+class TestMerges:
+    def test_merge_is_sum_of_parts_never_an_average(self):
+        a = _part(100.0, 1000.0, 1.0, 1e3, 2e3)    # mbu 0.5
+        b = _part(300.0, 200.0, 3.0, 9e3, 4e3)     # mbu 0.05
+        merged = perf.merge_totals([a, b])
+        d = perf.derive(merged)
+        assert d["mbu"]["decode|bf16"] == pytest.approx(1200.0 / 6000.0)
+        averaged = (0.5 + 0.05) / 2
+        assert d["mbu"]["decode|bf16"] != pytest.approx(averaged)
+        assert d["mfu"]["decode|bf16"] == pytest.approx(400.0 / 10e3)
+
+    def test_merge_is_associative_and_skips_junk(self):
+        a = _part(1.0, 2.0, 1.0, 10.0, 10.0)
+        b = _part(3.0, 4.0, 1.0, 10.0, 10.0)
+        c = _part(5.0, 6.0, 1.0, 10.0, 10.0, key="prefill|int8")
+        left = perf.merge_totals([perf.merge_totals([a, b]), c])
+        flat = perf.merge_totals([a, b, c, None, {"not": "perf"}])
+        assert left["kinds"] == flat["kinds"]
+        assert left["bubble"] == flat["bubble"]
+        assert set(flat["kinds"]) == {"decode|bf16", "prefill|int8"}
+
+    def test_bubble_ratio_merges_from_sums(self):
+        a = _part(1.0, 1.0, 1.0, 0.0, 0.0, bubble=2.0, busy=2.0)  # 0.5
+        b = _part(1.0, 1.0, 1.0, 0.0, 0.0, bubble=0.0, busy=6.0)  # 0.0
+        d = perf.derive(perf.merge_totals([a, b]))
+        assert d["bubble_ratio"] == pytest.approx(2.0 / 10.0)  # not 0.25
+
+    def test_aggregate_perf_matches_direct_merge(self):
+        a = _part(100.0, 1000.0, 1.0, 1e3, 2e3)
+        b = _part(300.0, 200.0, 3.0, 9e3, 4e3)
+        digests = {"r0": {"perf": a}, "r1": {"perf": b}, "r2": {}}
+        fleet = federation.aggregate_perf(digests)
+        assert fleet["kinds"] == perf.merge_totals([a, b])["kinds"]
+
+    def test_digest_carries_perf_and_fleet_text_exposes_it(self):
+        c = new_mock_container()
+        a = _part(100.0, 1000.0, 1.0, 1e3, 2e3)
+        b = _part(300.0, 200.0, 3.0, 9e3, 4e3)
+        d0 = federation.digest(c.metrics, perf=a)
+        assert d0["perf"] == a
+        assert "perf" not in federation.digest(c.metrics)
+        text = federation.fleet_text({"r0": d0,
+                                      "r1": federation.digest(c.metrics, perf=b)})
+        agg = [ln for ln in text.splitlines()
+               if ln.startswith("app_tpu_mbu{") and "replica" not in ln]
+        assert len(agg) == 1
+        assert float(agg[0].rsplit(" ", 1)[1]) == pytest.approx(0.2)
+        per = [ln for ln in text.splitlines()
+               if ln.startswith("app_tpu_mbu{") and 'replica="r0"' in ln]
+        assert per and float(per[0].rsplit(" ", 1)[1]) == pytest.approx(0.5)
+
+
+# -- capture bundle + /debug/perf surfaces ------------------------------------
+
+
+def _fake_engine(plane, decisions=None):
+    rep = ({"decisions": decisions} if decisions else None)
+    return SimpleNamespace(
+        perf=plane, autotune_report=lambda: rep,
+        health_check=lambda: {"status": "UP"})
+
+
+class TestSurfaces:
+    def _lively_plane(self):
+        import time as _t
+
+        p = _plane()
+        now = _t.monotonic()
+        s = p.step_decode(2, 4, 10, t0=now - 0.5)
+        s.t_ready = now
+        p.note(s, now)
+        return p
+
+    def test_capture_bundle_contains_perf_state(self, tmp_path):
+        from gofr_tpu.metrics.slo import CaptureWatcher
+
+        c = new_mock_container()
+        c.register_engine("lm", _fake_engine(self._lively_plane()))
+        w = CaptureWatcher(c, SimpleNamespace(snapshot=dict),
+                           out_dir=str(tmp_path))
+        path = w.on_breach([{"class": "c", "objective": "ttft"}])
+        bundle = json.loads(open(f"{path}/bundle.json").read())
+        assert bundle["perf"]["engines"]["lm"]["kinds"]["decode"]["steps"] >= 1
+        assert "decode|bf16" in bundle["perf"]["totals"]["kinds"]
+
+    def test_debug_perf_joins_autotune_pins(self):
+        from tests.test_http_server import make_app
+
+        app = make_app({"APP_ENV": "DEBUG"})
+        pins = {"decode": {"backend": "xla", "source": "measured"}}
+        app.container.register_engine(
+            "lm", _fake_engine(self._lively_plane(), decisions=pins))
+        resp = asyncio.run(app._debug_perf_handler(None))
+        data = json.loads(resp.body)["data"]
+        snap = data["engines"]["lm"]
+        assert snap["kinds"]["decode"]["mbu"] is not None
+        joined = snap["autotune"]["decode"]
+        assert joined["pin"]["backend"] == "xla"
+        assert joined["roofline"]["decode"]["steps"] >= 1
+        assert data["rollup"]["mbu"]["decode|bf16"] is not None
+
+
+# -- live engine end to end ----------------------------------------------------
+
+
+class TestLiveEngine:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        cfg = LlamaConfig.tiny()
+        return cfg, llama.init(cfg, jax.random.key(3))
+
+    def test_serving_lights_up_the_plane_and_planes_order(self, setup):
+        """Acceptance: after live traffic the engine's decode MBU is
+        non-zero on /metrics (CPU nominal peaks), flight steps carry the
+        roofline fields, requests carry per-phase device totals, and the
+        bf16/int8/int4 byte numerators order strictly (512/144/80
+        plane accounting)."""
+        cfg, params = setup
+        bytes_by_dtype = {}
+        for dtype in ("", "int8", "int4"):
+            c = new_mock_container()
+            kw = dict(slots=2, max_len=32, max_prefill_batch=2,
+                      kv_layout="paged", page_size=8)
+            if dtype:
+                kw["kv_quantize"] = dtype
+            eng = GenerateEngine(llama, cfg, params, c, **kw)
+            c.register_engine("lm", eng)
+            try:
+                assert eng.perf is not None
+                out = eng.generate([5, 3, 9], max_new_tokens=6, timeout=300)
+                assert len(out["tokens"]) == 6
+                import time as _t
+
+                snap = eng.perf.snapshot(_t.monotonic())
+                assert snap["kinds"]["decode"]["steps"] >= 1
+                assert snap["kinds"]["prefill"]["steps"] >= 1
+                bytes_by_dtype[dtype or "bf16"] = snap["kinds"]["decode"]["bytes"]
+                # exact pool accounting matches the analytic plane width
+                dense = eng.kv_cache.k.dtype.itemsize if not dtype else 2
+                want = kv_plane_bytes_per_position(
+                    cfg.num_layers, cfg.num_kv_heads, cfg.head_size,
+                    kv_dtype=dtype or "bf16", dense_bytes=dense)
+                assert snap["model"]["kv_bytes_per_pos"] == pytest.approx(want)
+                # scrape surfaces container-merged gauges
+                text = c.metrics.expose_text()
+                mbu = [ln for ln in text.splitlines()
+                       if ln.startswith("app_tpu_mbu{") and 'kind="decode"' in ln]
+                assert mbu, text[:2000]
+                assert float(mbu[0].rsplit(" ", 1)[1]) > 0.0
+                assert "app_tpu_kv_pool_occupancy" in text
+                # flight recorder step + request roofline fields
+                steps = c.flight.steps()
+                dec = [s for s in steps if s["kind"] == "decode"]
+                assert dec and {"device_s", "bytes", "flops", "bubble"} <= set(dec[0])
+                reqs = c.flight.requests()
+                assert reqs and "device" in reqs[0]
+                assert reqs[0]["device"].get("decode_s", 0) > 0
+            finally:
+                eng.stop()
+        assert (bytes_by_dtype["int4"] < bytes_by_dtype["int8"]
+                < bytes_by_dtype["bf16"])
+
+    def test_spec_waste_counters_registered(self):
+        c = new_mock_container()
+        assert c.metrics.get("app_tpu_spec_pages_trimmed_total") is not None
+        assert c.metrics.get("app_tpu_spec_tokens_rejected_total") is not None
+        assert c.metrics.get("app_tpu_step_device_seconds") is not None
