@@ -99,6 +99,12 @@ Env knobs:
     GOFR_BENCH_DISAGG_RESIDENTS  resident decode streams per phase (default 4)
     GOFR_BENCH_DISAGG_WAVE    concurrent prefill-wave size (default
                               max(4, requests/2))
+    GOFR_BENCH_ADAPTERS       1 = also run the multi-LoRA consolidation A/B:
+                              N adapters multiplexed on ONE engine vs N
+                              dedicated single-adapter engines, same seeded
+                              workload — archives chip-seconds/request at
+                              equal attainment and per-arm token-exactness
+    GOFR_BENCH_ADAPTERS_N     adapter count for the A/B (default 3)
     GOFR_BENCH_ALLOW_CPU      1 = a TPU-probe CPU fallback stays a valid
                               (labelled) CPU run instead of failing loud
     GOFR_BENCH_PIPELINE       device pipeline depth (default 2; 1 = sync, up to 4)
@@ -1405,6 +1411,97 @@ def main() -> None:
             extra["disagg"] = disagg
         except Exception as e:  # noqa: BLE001
             extra["disagg"] = f"error: {e}"[:160]
+
+    # multi-LoRA consolidation A/B (ISSUE 16): the COGS question — what
+    # does serving N tenants' adapters cost on ONE multiplexed engine vs
+    # N dedicated engines? Both arms serve the identical seeded workload
+    # (requests round-robined across adapters) to completion (equal
+    # attainment), so the comparison is pure chip-seconds/request: the
+    # dedicated arm pays N sets of idle decode slots and N prefill
+    # pipelines, the multiplexed arm co-batches all tenants into shared
+    # steps (lm_head-only LoRA gather; gofr_tpu/adapters). Token-exactness
+    # per arm pair is archived — consolidation must not change answers.
+    if os.environ.get("GOFR_BENCH_ADAPTERS") == "1":
+        from gofr_tpu.adapters import random_adapter as _rand_ad
+        from gofr_tpu.container import new_mock_container as _ad_container
+        from gofr_tpu.tpu.engine import GenerateEngine as _AdEngine
+
+        n_ad = max(2, int(os.environ.get("GOFR_BENCH_ADAPTERS_N", "3")))
+        ad_rank = 8 if on_cpu else 16
+        ad_specs = [_rand_ad(f"tenant{i}", cfg.hidden_size, cfg.vocab_size,
+                             rank=ad_rank, seed=100 + i)
+                    for i in range(n_ad)]
+        ad_reqs = max(n_ad * 2, n_requests // 2)
+        ad_jobs = [(rng.randint(1, cfg.vocab_size,
+                                size=prompt_len).tolist(),
+                    ad_specs[i % n_ad].name)
+                   for i in range(ad_reqs)]
+
+        def _device_s(eng) -> float:
+            if eng.perf is None:
+                return 0.0
+            tot = eng.perf.window_totals(time.monotonic())
+            return sum(rec["device_s"] for rec in tot["kinds"].values())
+
+        def _run_adapter_arm(mux: bool) -> tuple[dict, dict]:
+            kw = dict(engine_kw(*best))
+            kw.update(adapter_rank=ad_rank,
+                      adapter_slots=(n_ad + 1) if mux else 2)
+            toks: dict = {}
+            if mux:
+                engines = {None: _AdEngine(llama, cfg, params,
+                                           _ad_container(), **kw)}
+                for s in ad_specs:
+                    engines[None].register_adapter(s)
+            else:
+                engines = {}
+                for s in ad_specs:
+                    engines[s.name] = _AdEngine(llama, cfg, params,
+                                                _ad_container(), **kw)
+                    engines[s.name].register_adapter(s)
+            try:
+                for e in engines.values():
+                    e.warmup()
+                    e.start()
+                t0 = time.monotonic()
+                pend = [(i, engines[None if mux else name].submit(
+                            p, max_new_tokens=max_new, timeout=timeout,
+                            adapter_id=name))
+                        for i, (p, name) in enumerate(ad_jobs)]
+                for i, r in pend:
+                    toks[i] = r.result(timeout)["tokens"]
+                elapsed = time.monotonic() - t0
+                dev_s = sum(_device_s(e) for e in engines.values())
+                arm = {"engines": len(engines),
+                       "elapsed_s": round(elapsed, 3),
+                       "req_per_s": round(len(ad_jobs) / elapsed, 3),
+                       "device_s": round(dev_s, 3),
+                       "chip_s_per_req": round(dev_s / len(ad_jobs), 5)}
+                if mux:
+                    st = next(iter(engines.values())).adapter_stats()
+                    arm["pool"] = {"uploads": st["pool"]["uploads"],
+                                   "evictions": st["pool"]["evictions"]}
+                return arm, toks
+            finally:
+                for e in engines.values():
+                    e.stop()
+
+        try:
+            mux_arm, mux_toks = _run_adapter_arm(True)
+            ded_arm, ded_toks = _run_adapter_arm(False)
+            extra["adapters"] = {
+                "n_adapters": n_ad, "requests": ad_reqs, "rank": ad_rank,
+                "multiplexed": mux_arm, "dedicated": ded_arm,
+                # < 1.0 = consolidation serves the same attainment on
+                # fewer chip-seconds (the headline per-tenant COGS win)
+                "chip_s_ratio": round(
+                    mux_arm["chip_s_per_req"]
+                    / max(ded_arm["chip_s_per_req"], 1e-9), 3),
+                # co-batching tenants must not change any tenant's answer
+                "token_exact": bool(mux_toks == ded_toks),
+            }
+        except Exception as e:  # noqa: BLE001
+            extra["adapters"] = f"error: {e}"[:160]
 
     # NB: on the CPU fallback the "device" compute runs on the same host
     # cores as the packing/readback, so overlap has nothing to hide behind

@@ -1,0 +1,329 @@
+"""Multi-LoRA adapter multiplexing: the host registry + device pool tier.
+
+Thousands of fine-tuned variants of one base model share one engine
+(ROADMAP O4): adapter weights live as a refcounted paged side-cache next
+to the KV pool, and decode gathers each lane's adapter out of the pool so
+ONE device call serves a mixed-adapter batch (ops/lora.py holds the math
+and the exactness contract). Two tiers, mirroring the prefix cache's
+HBM/host-DRAM split (tpu/prefix.py):
+
+- **Host tier** — :class:`AdapterRegistry`. The source of truth: numpy
+  factor matrices in host DRAM, bounded by ``ADAPTER_HOST_MB``. Unlike
+  the prefix cache's host tier this one never silently evicts — an
+  adapter was *registered*, so dropping it would turn requests into
+  errors; registration past the budget raises instead. The registry also
+  owns the per-adapter concurrency caps (``max_concurrency`` per spec,
+  the per-tenant analog of QoS per-class caps) and each adapter's default
+  QoS class, so ``adapter_id`` keys both admission and scheduling.
+- **Device tier** — :class:`AdapterPool`. ``S`` fixed-shape pool slots in
+  HBM (``ADAPTER_SLOTS`` / ``ADAPTER_POOL_MB``), refcounted by the engine
+  slots currently decoding with each adapter, LRU-evicted only at
+  ``refs == 0`` — eviction is just forgetting the device copy; the next
+  acquire re-uploads from the registry (host-DRAM "swap-in", an async
+  ``.at[slot].set`` dispatch that is safe under the engine state lock by
+  the ``gather_pages`` discipline: dispatch-only, no readback). Slot 0 is
+  the reserved all-zeros BASE adapter — ``adapter_id=None`` lanes select
+  it and stay bit-identical to the pre-adapter engine.
+
+The pool's arrays ride every packed program call as *dynamic* jit
+arguments (like ``params``), so uploads and evictions never recompile —
+the same property the live weight hot-swap path (engine.adopt_weights)
+relies on for full-model adoption without a restart.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from gofr_tpu.http.errors import TooManyRequests
+
+__all__ = [
+    "AdapterPool",
+    "AdapterRegistry",
+    "AdapterSpec",
+    "random_adapter",
+]
+
+
+@dataclass
+class AdapterSpec:
+    """One registered LoRA adapter (host-tier record).
+
+    ``a`` is the down-projection ``[embed, rank]``, ``b`` the
+    up-projection ``[rank, vocab]`` (lm_head-site LoRA; ops/lora.py).
+    ``scale`` is the usual alpha/rank factor. ``qos_class`` (optional)
+    is the default QoS class for requests naming this adapter — the
+    per-adapter SLO hook: map an adapter to a class and the SLO /
+    autoscaler planes key on it. ``max_concurrency`` caps
+    submitted-but-unfinished requests for this adapter (0 = uncapped)."""
+
+    name: str
+    a: np.ndarray
+    b: np.ndarray
+    scale: float = 1.0
+    qos_class: str | None = None
+    max_concurrency: int = 0
+
+    def __post_init__(self):
+        self.a = np.asarray(self.a, np.float32)
+        self.b = np.asarray(self.b, np.float32)
+        if self.a.ndim != 2 or self.b.ndim != 2 or self.a.shape[1] != self.b.shape[0]:
+            raise ValueError(
+                f"adapter {self.name!r}: a must be [embed, rank] and b "
+                f"[rank, vocab] with matching rank; got {self.a.shape} / "
+                f"{self.b.shape}")
+
+    @property
+    def rank(self) -> int:
+        return int(self.a.shape[1])
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.a.nbytes + self.b.nbytes)
+
+
+def random_adapter(name: str, embed: int, vocab: int, *, rank: int = 4,
+                   scale: float = 1.0, seed: int = 0, **kw) -> AdapterSpec:
+    """Deterministic random adapter for tests / examples / benches. Small
+    magnitudes (~1e-2) so deltas perturb logits without drowning them."""
+    rng = np.random.default_rng(seed)
+    return AdapterSpec(
+        name=name,
+        a=rng.standard_normal((embed, rank)).astype(np.float32) * 0.1,
+        b=rng.standard_normal((rank, vocab)).astype(np.float32) * 0.1,
+        scale=scale, **kw)
+
+
+class AdapterRegistry:
+    """Host-DRAM adapter tier: registration, budget, concurrency caps.
+
+    Thread-safe (registration arrives from app handlers, admission from
+    ``_submit``, lookups from the engine device thread)."""
+
+    def __init__(self, host_budget_mb: float = 256.0):
+        self.host_budget_bytes = int(host_budget_mb * (1 << 20))
+        self._specs: dict[str, AdapterSpec] = {}
+        self._inflight: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, spec: AdapterSpec, pool: "AdapterPool | None" = None) -> None:
+        """Admit ``spec`` into the host tier. Raises when the host budget
+        would overflow (registered adapters are never silently evicted)
+        or when replacing an adapter that is live on device (``pool``
+        passed and the name has device refs) — replacing weights under
+        an in-flight request would mix adapters mid-request."""
+        with self._lock:
+            current = self._specs.get(spec.name)
+            total = sum(s.nbytes for n, s in self._specs.items()
+                        if n != spec.name) + spec.nbytes
+            if total > self.host_budget_bytes:
+                raise ValueError(
+                    f"adapter {spec.name!r} ({spec.nbytes >> 20} MiB) would "
+                    f"overflow ADAPTER_HOST_MB "
+                    f"({self.host_budget_bytes >> 20} MiB); registered "
+                    f"adapters are never evicted — raise the budget or "
+                    f"unregister first")
+            if current is not None and pool is not None:
+                pool.invalidate(spec.name)  # raises if device refs > 0
+            self._specs[spec.name] = spec
+
+    def unregister(self, name: str, pool: "AdapterPool | None" = None) -> None:
+        with self._lock:
+            if pool is not None:
+                pool.invalidate(name)
+            self._specs.pop(name, None)
+
+    def get(self, name: str) -> AdapterSpec:
+        with self._lock:
+            spec = self._specs.get(name)
+        if spec is None:
+            raise KeyError(f"unknown adapter {name!r}; registered: "
+                           f"{sorted(self._specs)}")
+        return spec
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._specs)
+
+    def digest(self) -> str:
+        """Order-independent fingerprint of the loaded adapter set, for
+        the disaggregated handoff JOIN gate (tpu/handoff.py): prefill and
+        decode peers must agree on which adapters exist (names + ranks +
+        scales — factor bytes are deliberately excluded so re-registering
+        identical metadata after a restart still matches)."""
+        h = hashlib.blake2b(digest_size=8)
+        with self._lock:
+            for name in sorted(self._specs):
+                s = self._specs[name]
+                h.update(f"{name}:{s.rank}:{s.scale:.6g}\n".encode())
+        return h.hexdigest()
+
+    # -- per-adapter admission --------------------------------------------
+
+    def admit(self, name: str) -> AdapterSpec:
+        """Resolve + acquire one concurrency share for ``name``. Raises
+        ``KeyError`` for unknown adapters and 429 ``TooManyRequests`` at
+        the adapter's cap (mirrors qos.admit_engine's per-class gate —
+        release via :meth:`release` on the request's done callback)."""
+        spec = self.get(name)
+        if spec.max_concurrency:
+            with self._lock:
+                if self._inflight.get(name, 0) >= spec.max_concurrency:
+                    raise TooManyRequests(
+                        f"adapter {name!r} at its concurrency cap "
+                        f"({spec.max_concurrency})", retry_after=1.0)
+                self._inflight[name] = self._inflight.get(name, 0) + 1
+        return spec
+
+    def release(self, name: str) -> None:
+        with self._lock:
+            if name in self._inflight:
+                self._inflight[name] = max(0, self._inflight[name] - 1)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "registered": len(self._specs),
+                "host_bytes": sum(s.nbytes for s in self._specs.values()),
+                "host_budget_bytes": self.host_budget_bytes,
+                "inflight": {k: v for k, v in self._inflight.items() if v},
+            }
+
+
+class AdapterPool:
+    """Device (HBM) adapter tier: ``slots`` fixed-shape pool entries.
+
+    All device state is three arrays — ``a [S, E, R]``, ``b [S, R, V]``,
+    ``scale [S]`` — passed to every adapter-enabled program call as
+    dynamic jit args. Host-side bookkeeping (slot map, refcounts, LRU
+    ticks) is guarded by the ENGINE's state lock: acquire/release happen
+    where KV pages are claimed/freed, so no separate lock is taken here
+    (the registry above, which sees other threads, has its own).
+
+    Ranks up to ``rank`` are supported; shorter ranks are zero-padded on
+    upload (exact — padded columns contribute 0.0 to the delta)."""
+
+    BASE_SLOT = 0
+
+    def __init__(self, slots: int, embed: int, vocab: int, rank: int):
+        import jax.numpy as jnp  # deferred: host-only users never pay jax
+
+        if slots < 2:
+            raise ValueError("adapter pool needs >= 2 slots (slot 0 is the "
+                             "reserved base-model slot)")
+        self.slots, self.embed, self.vocab, self.rank = slots, embed, vocab, rank
+        self.a = jnp.zeros((slots, embed, rank), jnp.float32)
+        self.b = jnp.zeros((slots, rank, vocab), jnp.float32)
+        self.scale = jnp.zeros((slots,), jnp.float32)
+        self._slot_of: dict[str, int] = {}
+        self._name_of: dict[int, str] = {}
+        self._refs = [0] * slots
+        self._tick = 0
+        self._lru = [0] * slots
+        self.uploads = 0
+        self.evictions = 0
+
+    @property
+    def pool_bytes(self) -> int:
+        return int(self.a.nbytes + self.b.nbytes + self.scale.nbytes)
+
+    @classmethod
+    def slots_for_budget(cls, pool_mb: float, embed: int, vocab: int,
+                         rank: int) -> int:
+        """How many pool slots fit in ``pool_mb`` MiB of HBM (f32 factors)."""
+        per_slot = 4 * (embed * rank + rank * vocab)
+        return max(2, int(pool_mb * (1 << 20)) // max(1, per_slot))
+
+    # -- device-tier paging ------------------------------------------------
+
+    def acquire(self, spec: AdapterSpec) -> int | None:
+        """Pin ``spec`` into a pool slot (upload if not resident) and take
+        one reference. Returns the slot id, or ``None`` when every slot
+        is referenced by a live lane — the caller requeues the request,
+        exactly like KV page exhaustion in ``_admit_prefill``. Called
+        under the engine state lock; the upload is an async dispatch."""
+        slot = self._slot_of.get(spec.name)
+        if slot is None:
+            slot = self._pick_victim()
+            if slot is None:
+                return None
+            self._upload(slot, spec)
+        self._refs[slot] += 1
+        self._tick += 1
+        self._lru[slot] = self._tick
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Drop one reference (engine ``_free_slot``). Slot 0 is the base
+        adapter — never refcounted, never evicted."""
+        if slot != self.BASE_SLOT and self._refs[slot] > 0:
+            self._refs[slot] -= 1
+
+    def invalidate(self, name: str) -> None:
+        """Forget the device copy of ``name`` (weights replaced in the
+        registry). Raises while lanes still reference it."""
+        slot = self._slot_of.get(name)
+        if slot is None:
+            return
+        if self._refs[slot] > 0:
+            raise ValueError(
+                f"adapter {name!r} has {self._refs[slot]} in-flight "
+                f"lane(s); drain before replacing its weights")
+        self._forget(slot)
+
+    def _pick_victim(self) -> int | None:
+        best, best_tick = None, None
+        for s in range(1, self.slots):
+            if self._refs[s]:
+                continue
+            if s not in self._name_of:       # empty slot: take immediately
+                return s
+            if best_tick is None or self._lru[s] < best_tick:
+                best, best_tick = s, self._lru[s]
+        if best is not None:
+            self._forget(best)
+            self.evictions += 1
+        return best
+
+    def _forget(self, slot: int) -> None:
+        name = self._name_of.pop(slot, None)
+        if name is not None:
+            self._slot_of.pop(name, None)
+
+    def _upload(self, slot: int, spec: AdapterSpec) -> None:
+        import jax.numpy as jnp
+
+        r = spec.rank
+        if r > self.rank:
+            raise ValueError(
+                f"adapter {spec.name!r} rank {r} exceeds the pool rank "
+                f"{self.rank} (ADAPTER_RANK)")
+        a = np.zeros((self.embed, self.rank), np.float32)
+        b = np.zeros((self.rank, self.vocab), np.float32)
+        a[:, :r] = spec.a
+        b[:r, :] = spec.b
+        # functional updates: new arrays, same shape/dtype -> the packed
+        # programs never recompile; async dispatch, safe under the lock
+        self.a = self.a.at[slot].set(jnp.asarray(a))
+        self.b = self.b.at[slot].set(jnp.asarray(b))
+        self.scale = self.scale.at[slot].set(jnp.float32(spec.scale))
+        self._slot_of[spec.name] = slot
+        self._name_of[slot] = spec.name
+        self.uploads += 1
+
+    def stats(self) -> dict:
+        return {
+            "slots": self.slots,
+            "resident": len(self._slot_of),
+            "referenced": sum(1 for s in range(1, self.slots) if self._refs[s]),
+            "rank": self.rank,
+            "pool_bytes": self.pool_bytes,
+            "uploads": self.uploads,
+            "evictions": self.evictions,
+        }

@@ -757,6 +757,12 @@ class App:
             if rep:
                 snap = dict(snap)
                 snap["autotune"] = rep
+            ad_stats = getattr(engine, "adapter_stats", None)
+            if callable(ad_stats):
+                # adapter plane occupancy + the base-weight epoch
+                # (gofr_tpu.adapters; docs/serving.md)
+                snap = dict(snap)
+                snap["adapters"] = ad_stats()
             engines[name] = snap
         return web.json_response(
             {"data": {"count": len(steps), "steps": steps, "engines": engines}})

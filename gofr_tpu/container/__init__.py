@@ -247,6 +247,23 @@ class Container:
                                  0.05, 0.1, 0.25, 1.0])
         m.new_gauge("app_tpu_pipeline_bubble_ratio",
                     "device-idle-while-work-queued fraction of the perf window")
+        # per-adapter attribution (multi-LoRA multiplexing; docs/serving.md):
+        # proportional share of each mixed-adapter step's roofline terms,
+        # an exact partition — summed over adapters they equal the step's
+        m.new_gauge("app_tpu_adapter_mfu",
+                    "windowed MFU share attributed to one adapter (adapter)")
+        m.new_gauge("app_tpu_adapter_mbu",
+                    "windowed MBU share attributed to one adapter (adapter)")
+        m.new_gauge("app_tpu_adapter_device_seconds",
+                    "windowed device-seconds attributed to one adapter "
+                    "(adapter) — the per-tenant COGS meter")
+        m.new_gauge("app_tpu_weights_epoch",
+                    "live base-weight epoch (bumped by every hot-swap "
+                    "adoption; engine.adopt_weights)")
+        m.new_counter("app_tpu_weight_swaps_total",
+                      "full-model live weight adoptions (zero-drop hot-swap)")
+        m.new_gauge("app_tpu_adapters_registered",
+                    "adapters resident in the host registry tier")
         m.new_counter("app_tpu_spec_pages_trimmed_total",
                       "KV pages claimed for spec over-claim and released at fold")
         m.new_counter("app_tpu_spec_tokens_rejected_total",
@@ -314,7 +331,18 @@ class Container:
             if rec["bytes_cap"]:
                 self.metrics.set_gauge(
                     "app_tpu_mbu", rec["bytes"] / rec["bytes_cap"], **labels)
-        ratio = perf_mod.derive(totals)["bubble_ratio"]
+        derived = perf_mod.derive(totals)
+        for aid, rec in derived.get("adapters", {}).items():
+            labels = {"adapter": aid}
+            self.metrics.set_gauge(
+                "app_tpu_adapter_device_seconds", rec["device_s"], **labels)
+            if rec.get("mfu") is not None:
+                self.metrics.set_gauge("app_tpu_adapter_mfu", rec["mfu"],
+                                       **labels)
+            if rec.get("mbu") is not None:
+                self.metrics.set_gauge("app_tpu_adapter_mbu", rec["mbu"],
+                                       **labels)
+        ratio = derived["bubble_ratio"]
         if ratio is not None:
             self.metrics.set_gauge("app_tpu_pipeline_bubble_ratio", ratio)
         for name, e in self._engines.items():

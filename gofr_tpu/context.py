@@ -168,6 +168,19 @@ class Context:
             kw["timeout"] = rem if t is None else min(t, rem)
         # track the submitted Request so a client disconnect can cancel it
         kw.setdefault("_on_submit", self._engine_requests.append)
+        # adapter routing header (X-Adapter-ID / gRPC x-adapter-id): a
+        # handler-set adapter_id wins — the header is transport-level
+        # default routing, same precedence rule as the QoS class below
+        if "adapter_id" not in kw and "_adapter" not in kw:
+            ad = req_ctx.get("adapter_id")
+            if not ad:
+                headers = getattr(req, "headers", None)
+                if headers is not None:  # HTTP: case-insensitive header dict
+                    ad = headers.get("X-Adapter-ID")
+                elif hasattr(req, "param"):  # gRPC metadata (lowercased keys)
+                    ad = req.param("x-adapter-id") or None
+            if ad:
+                kw["adapter_id"] = ad
         if "qos_class" in kw or "_qos_class" in kw:
             return kw
         cls = req_ctx.get("qos_class")

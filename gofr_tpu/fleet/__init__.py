@@ -87,10 +87,15 @@ def epoch_of(engine) -> int:
     rebuilt, which is what ring re-admission keys on (router/registry.py:
     a replica dropped during its restart window must come back at a
     strictly bumped epoch). Max of the two on a fleet leader: a restart IS
-    an epoch bump there, but the counters can briefly disagree mid-window."""
+    an epoch bump there, but the counters can briefly disagree mid-window.
+    Live weight hot-swaps (engine.adopt_weights) ADD their own counter on
+    top: an adoption rebuilds per-epoch device state the same way, and the
+    router must see a strictly bumped epoch so it never keeps routing a
+    sticky (tenant, adapter) ring slot across mismatched weights."""
     ls = getattr(engine, "_ls", None)
     epoch = int(getattr(ls, "epoch", 0) or 0)
-    return max(epoch, int(getattr(engine, "_restarts", 0) or 0))
+    base = max(epoch, int(getattr(engine, "_restarts", 0) or 0))
+    return base + int(getattr(engine, "weights_epoch", 0) or 0)
 
 
 @dataclass

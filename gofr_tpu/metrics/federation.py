@@ -330,6 +330,34 @@ def _perf_lines(digests: Mapping[str, Mapping[str, Any]],
                                    ("replica", replica))))
                 lines.append(
                     f"{gname}{_fmt_labels(ls)} {_fmt_value(rd[key])}")
+    # per-adapter attribution (multi-LoRA multiplexing): fleet rows are
+    # exact sum-of-parts like the kind rows above; device_seconds is the
+    # per-tenant COGS meter (docs/serving.md)
+    ad_fleet = derived.get("adapters") or {}
+    ad_parts = {replica: (perf_mod.derive(digests[replica]["perf"])
+                          .get("adapters") or {})
+                for replica in sorted(digests)
+                if digests[replica].get("perf")}
+    if ad_fleet or any(ad_parts.values()):
+        for gname, field in (("app_tpu_adapter_mfu", "mfu"),
+                             ("app_tpu_adapter_mbu", "mbu"),
+                             ("app_tpu_adapter_device_seconds", "device_s")):
+            lines.append(f"# TYPE {gname} gauge")
+            for aid in sorted(ad_fleet):
+                val = ad_fleet[aid].get(field)
+                if val is None:
+                    continue
+                ls = (("adapter", aid),)
+                lines.append(f"{gname}{_fmt_labels(ls)} {_fmt_value(val)}")
+            for replica, rows in ad_parts.items():
+                for aid in sorted(rows):
+                    val = rows[aid].get(field)
+                    if val is None:
+                        continue
+                    ls = tuple(sorted(
+                        (("adapter", aid), ("replica", replica))))
+                    lines.append(
+                        f"{gname}{_fmt_labels(ls)} {_fmt_value(val)}")
     lines.append("# TYPE app_tpu_pipeline_bubble_ratio gauge")
     ratio = derived["bubble_ratio"]
     if ratio is not None:

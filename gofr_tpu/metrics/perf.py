@@ -366,6 +366,36 @@ class PerfPlane:
                    "busy_s": p.device_s})
         return p
 
+    def note_adapters(self, ids: Iterable[str | None], p: StepPerf,
+                      now: float) -> None:
+        """Per-adapter attribution of one folded step (multi-LoRA
+        multiplexing; gofr_tpu.adapters). ``ids`` carries one entry per
+        live lane the fold credited — ``None`` lanes are the base model,
+        attributed as ``"base"`` so the per-step adapter shares are a
+        COMPLETE partition: summed over adapters they equal the step's
+        own flops/bytes/device_s exactly, which is what keeps fleet
+        rollups sum-of-parts per tenant (device_s per adapter is the
+        per-tenant COGS number). The split is proportional by lane count
+        — lanes share the batched step uniformly. Call AFTER :meth:`note`
+        (residency must be filled)."""
+        ids = list(ids)
+        if not ids:
+            return
+        share = 1.0 / len(ids)
+        counts: dict[str, int] = {}
+        for aid in ids:
+            key = str(aid) if aid is not None else "base"
+            counts[key] = counts.get(key, 0) + 1
+        with self._lock:
+            for aid, c in counts.items():
+                f = c * share
+                self._ring.add(
+                    now,
+                    **{f"ad.{aid}.flops": p.flops * f,
+                       f"ad.{aid}.bytes": p.bytes * f,
+                       f"ad.{aid}.device_s": p.device_s * f,
+                       f"ad.{aid}.steps": f})
+
     def note_external(self, kind: str, device_s: float, flops: float,
                       bytes_: float, now: float) -> None:
         """Account work measured off the device thread (the handoff
@@ -398,15 +428,21 @@ class PerfPlane:
         with self._lock:
             sums = self._ring.sums(now)
         kinds: dict[str, dict[str, float]] = {}
+        adapters: dict[str, dict[str, float]] = {}
+        proto = {"flops": 0.0, "bytes": 0.0, "device_s": 0.0,
+                 "steps": 0.0, "flops_cap": 0.0, "bytes_cap": 0.0}
         for key, val in sums.items():
             if key in ("bubble_s", "busy_s"):
                 continue
             kind, field = key.rsplit(".", 1)
-            kinds.setdefault(f"{kind}|{self.model.kv_dtype}",
-                             {"flops": 0.0, "bytes": 0.0, "device_s": 0.0,
-                              "steps": 0.0, "flops_cap": 0.0,
-                              "bytes_cap": 0.0})[field] = val
-        for rec in kinds.values():
+            if kind.startswith("ad."):
+                # per-adapter attribution rows (note_adapters) — their own
+                # section, never mixed into the step kinds
+                adapters.setdefault(kind[3:], dict(proto))[field] = val
+            else:
+                kinds.setdefault(f"{kind}|{self.model.kv_dtype}",
+                                 dict(proto))[field] = val
+        for rec in list(kinds.values()) + list(adapters.values()):
             if peaks is not None:
                 rec["flops_cap"] = rec["device_s"] * peaks[0]
                 rec["bytes_cap"] = rec["device_s"] * peaks[1]
@@ -414,6 +450,7 @@ class PerfPlane:
             "v": 1,
             "window_s": self.window_s,
             "kinds": kinds,
+            "adapters": adapters,
             "bubble": {"bubble_s": sums.get("bubble_s", 0.0),
                        "busy_s": sums.get("busy_s", 0.0)},
         }
@@ -436,6 +473,18 @@ class PerfPlane:
                 "mbu": (round(rec["bytes"] / rec["bytes_cap"], 6)
                         if rec["bytes_cap"] else None),
             }
+        adapters: dict[str, Any] = {}
+        for aid, rec in totals.get("adapters", {}).items():
+            adapters[aid] = {
+                "steps": round(rec["steps"], 3),
+                "flops": rec["flops"],
+                "bytes": rec["bytes"],
+                "device_s": round(rec["device_s"], 6),
+                "mfu": (round(rec["flops"] / rec["flops_cap"], 6)
+                        if rec["flops_cap"] else None),
+                "mbu": (round(rec["bytes"] / rec["bytes_cap"], 6)
+                        if rec["bytes_cap"] else None),
+            }
         bub = totals["bubble"]
         denom = bub["bubble_s"] + bub["busy_s"]
         return {
@@ -449,6 +498,7 @@ class PerfPlane:
             },
             "model": self.model.describe(),
             "kinds": kinds,
+            "adapters": adapters,
             "bubble": {
                 "bubble_s": round(bub["bubble_s"], 6),
                 "busy_s": round(bub["busy_s"], 6),
@@ -466,17 +516,19 @@ def merge_totals(parts: Iterable[dict[str, Any] | None]) -> dict[str, Any]:
     numerators and capacity denominators field by field; NEVER averages
     a ratio — ``merge(merge(a, b), c) == merge(a, b, c)`` exactly."""
     out: dict[str, Any] = {"v": 1, "window_s": 0.0, "kinds": {},
+                           "adapters": {},
                            "bubble": {"bubble_s": 0.0, "busy_s": 0.0}}
     for part in parts:
         if not isinstance(part, dict) or "kinds" not in part:
             continue
         out["window_s"] = max(out["window_s"], float(part.get("window_s", 0.0)))
-        for key, rec in part["kinds"].items():
-            dst = out["kinds"].setdefault(key, {
-                "flops": 0.0, "bytes": 0.0, "device_s": 0.0,
-                "steps": 0.0, "flops_cap": 0.0, "bytes_cap": 0.0})
-            for f in dst:
-                dst[f] += float(rec.get(f, 0.0))
+        for section in ("kinds", "adapters"):
+            for key, rec in (part.get(section) or {}).items():
+                dst = out[section].setdefault(key, {
+                    "flops": 0.0, "bytes": 0.0, "device_s": 0.0,
+                    "steps": 0.0, "flops_cap": 0.0, "bytes_cap": 0.0})
+                for f in dst:
+                    dst[f] += float(rec.get(f, 0.0))
         bub = part.get("bubble") or {}
         out["bubble"]["bubble_s"] += float(bub.get("bubble_s", 0.0))
         out["bubble"]["busy_s"] += float(bub.get("busy_s", 0.0))
@@ -494,11 +546,21 @@ def derive(totals: dict[str, Any]) -> dict[str, Any]:
             mfu[key] = rec["flops"] / rec["flops_cap"]
         if rec.get("bytes_cap"):
             mbu[key] = rec["bytes"] / rec["bytes_cap"]
+    adapters: dict[str, Any] = {}
+    for aid, rec in (totals.get("adapters") or {}).items():
+        adapters[aid] = {
+            "device_s": float(rec.get("device_s", 0.0)),
+            "mfu": (rec["flops"] / rec["flops_cap"]
+                    if rec.get("flops_cap") else None),
+            "mbu": (rec["bytes"] / rec["bytes_cap"]
+                    if rec.get("bytes_cap") else None),
+        }
     bub = totals.get("bubble") or {}
     denom = float(bub.get("bubble_s", 0.0)) + float(bub.get("busy_s", 0.0))
     return {
         "mfu": mfu,
         "mbu": mbu,
+        "adapters": adapters,
         "bubble_ratio": (float(bub.get("bubble_s", 0.0)) / denom
                          if denom else None),
     }

@@ -57,6 +57,11 @@ from gofr_tpu.ops.paged import (
     write_prompts_paged_q4,
 )
 from gofr_tpu.ops.quant import fake_quant_row_int4
+from gofr_tpu.ops.lora import lora_logits_delta
+
+# Serving entry points accept a per-lane LoRA pool (``adapters`` kwarg:
+# (sel, a, b, scale); ops/lora.py) — build_programs keys on this flag.
+SUPPORTS_ADAPTERS = True
 
 
 @dataclass(frozen=True)
@@ -294,7 +299,8 @@ def forward_pipelined(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
 def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray,
             cache: SlotKVCache, slots: jnp.ndarray,
             offsets: jnp.ndarray | None = None, *,
-            attn_fn: Any = None) -> tuple[jnp.ndarray, SlotKVCache]:
+            attn_fn: Any = None,
+            adapters=None) -> tuple[jnp.ndarray, SlotKVCache]:
     """Prefill prompts (or prompt CHUNKS) into cache slots.
 
     tokens [B,S] (padded), lengths [B] = live tokens in this call, slots
@@ -369,12 +375,15 @@ def prefill(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.nd
     last = x[row, lengths - 1]  # [B,E]
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(last, head).astype(jnp.float32)
+    if adapters is not None:
+        logits = logits + lora_logits_delta(last, adapters)
     return logits, out_cache
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
 def verify_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
-                positions: jnp.ndarray, cache: SlotKVCache) -> tuple[jnp.ndarray, SlotKVCache]:
+                positions: jnp.ndarray, cache: SlotKVCache,
+                adapters=None) -> tuple[jnp.ndarray, SlotKVCache]:
     """Speculative-decoding verification (engine.spec_tokens): one forward
     over ``tokens`` [N, T] per slot — the current input token plus T-1
     draft tokens — written and attended at positions ``positions[n]`` ..
@@ -430,12 +439,15 @@ def verify_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(x, head).astype(jnp.float32)
+    if adapters is not None:
+        logits = logits + lora_logits_delta(x, adapters)
     return logits, out_cache
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
 def decode_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, positions: jnp.ndarray,
-                cache: SlotKVCache) -> tuple[jnp.ndarray, SlotKVCache]:
+                cache: SlotKVCache,
+                adapters=None) -> tuple[jnp.ndarray, SlotKVCache]:
     """One decode step over every slot.
 
     tokens [N] (next input token per slot), positions [N] (where it goes in
@@ -480,6 +492,8 @@ def decode_step(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, positions: 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(x, head).astype(jnp.float32)
+    if adapters is not None:
+        logits = logits + lora_logits_delta(x, adapters)
     return logits, out_cache
 
 
@@ -504,7 +518,8 @@ def make_cache_q(cfg: LlamaConfig, slots: int, max_len: int | None = None) -> QS
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
 def verify_step_paged(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
-                      positions: jnp.ndarray, cache, table: jnp.ndarray):
+                      positions: jnp.ndarray, cache, table: jnp.ndarray,
+                      adapters=None):
     """Speculative-decoding verification against the paged pool — the
     contract and stale-draft-KV invariants of ``verify_step``, with writes
     routed through per-slot block tables (``table`` [N, MaxP]; OOB rows
@@ -559,6 +574,8 @@ def verify_step_paged(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(x, head).astype(jnp.float32)
+    if adapters is not None:
+        logits = logits + lora_logits_delta(x, adapters)
     return logits, out_cache
 
 
@@ -590,7 +607,7 @@ def make_paged_cache_q4(cfg: LlamaConfig, pages: int, page_size: int = 128) -> Q
 def prefill_paged(
     cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray,
     cache: PagedKVCache, pages: jnp.ndarray, offsets: jnp.ndarray | None = None,
-    *, attn_fn: Any = None,
+    *, attn_fn: Any = None, adapters=None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """Prefill prompts (or prompt CHUNKS) through per-row block tables.
 
@@ -674,13 +691,15 @@ def prefill_paged(
     last = x[row, lengths - 1]  # [B,E]
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(last, head).astype(jnp.float32)
+    if adapters is not None:
+        logits = logits + lora_logits_delta(last, adapters)
     return logits, out_cache
 
 
 @partial(jax.jit, static_argnums=0, donate_argnums=4)
 def decode_step_paged(
     cfg: LlamaConfig, params: dict, tokens: jnp.ndarray, positions: jnp.ndarray,
-    cache: PagedKVCache, table: jnp.ndarray,
+    cache: PagedKVCache, table: jnp.ndarray, adapters=None,
 ) -> tuple[jnp.ndarray, PagedKVCache]:
     """One decode step over every slot, K/V appended through the block
     table. Contract matches ``decode_step`` with ``table`` [N, MaxP]."""
@@ -725,4 +744,6 @@ def decode_step_paged(
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qdot(x, head).astype(jnp.float32)
+    if adapters is not None:
+        logits = logits + lora_logits_delta(x, adapters)
     return logits, out_cache

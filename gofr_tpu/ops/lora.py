@@ -1,0 +1,66 @@
+"""Batched multi-LoRA logits delta for mixed-adapter decode.
+
+One device call serves many adapters: every lane (slot) in a decode /
+prefill / verify step carries an ``adapter slot id`` into the program, the
+program gathers that lane's low-rank factors out of the device-resident
+adapter pool (adapters/pool.py), and the per-lane delta
+
+    delta = scale[sel] * (x @ a[sel]) @ b[sel]
+
+is added to the base-model logits at the (single, uniform) lm_head site.
+Applying LoRA at the head only — rather than per-layer q/k/v/o — is the v1
+contract that keeps the rest of the serving plane valid: the KV cache stays
+adapter-independent, so the prefix cache, paged handoff, and ring affinity
+all keep working unchanged across adapters.
+
+Exactness contract (tested by tests/test_adapters.py):
+
+- Pool slot 0 is the reserved BASE slot: zero factors, zero scale. A lane
+  with ``adapter_id=None`` selects slot 0 and its delta is exactly 0.0 in
+  f32, so base-lane logits are bit-identical to the pre-adapter engine
+  (adding 0.0 is exact; the only representable difference would be -0.0,
+  which is invisible to argmax and softmax alike).
+- Ranks below the pool's Rmax are zero-padded; padded columns contribute
+  exact zeros, so a rank-4 adapter in a rank-16 pool produces the same
+  delta as in a rank-4 pool.
+- Lanes are independent (the gather + two einsums never mix the lane
+  axis), so a mixed-adapter batch is token-exact vs running each adapter
+  in isolation.
+
+The math runs in f32 regardless of the base dtype: deltas are small and
+the head matmul already casts logits to f32, so this adds no precision
+cliff relative to the base path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def lora_logits_delta(x: jnp.ndarray, adapters) -> jnp.ndarray:
+    """Per-lane low-rank logits delta, gathered from the adapter pool.
+
+    ``x`` is the hidden state entering the lm_head: ``[N, E]`` (prefill
+    last-token rows / decode) or ``[N, T, E]`` (verify: T speculative
+    positions per lane). ``adapters`` is the 4-tuple the engine threads
+    through the packed program call:
+
+    - ``sel``   int32 ``[N]``   — per-lane pool slot id (0 = base)
+    - ``a``     ``[S, E, R]``   — down-projection pool (R = Rmax)
+    - ``b``     ``[S, R, V]``   — up-projection pool
+    - ``scale`` f32 ``[S]``     — per-slot alpha/r scaling (0 for slot 0)
+
+    Returns an f32 delta shaped like the logits (``x.shape[:-1] + (V,)``).
+    """
+    sel, a, b, scale = adapters
+    aw = a[sel].astype(jnp.float32)        # [N, E, R]
+    bw = b[sel].astype(jnp.float32)        # [N, R, V]
+    xf = x.astype(jnp.float32)
+    # "..." spans the optional verify T axis; lanes never mix.
+    low = jnp.einsum("n...e,ner->n...r", xf, aw)
+    delta = jnp.einsum("n...r,nrv->n...v", low, bw)
+    s = scale[sel].astype(jnp.float32)
+    return delta * s.reshape(s.shape + (1,) * (delta.ndim - 1))
+
+
+__all__ = ["lora_logits_delta"]

@@ -193,25 +193,38 @@ class Router:
     def request_key(self, req) -> int:
         """Shard key of an HTTP request: token-prefix chain key when the
         JSON body carries ``key_field`` (ids or text), else a digest of the
-        raw body — unkeyable requests still distribute uniformly."""
+        raw body — unkeyable requests still distribute uniformly. Requests
+        naming an adapter (``adapter_id`` body field or ``X-Adapter-ID``
+        header) mix it into the key, so ring affinity is effectively on
+        (prefix, adapter): one adapter's traffic converges on replicas
+        whose device pool already holds its weights — the adapter-cache
+        analog of the prefix-affinity argument above."""
         body = getattr(req, "body", b"") or b""
         try:
             data = json.loads(body) if body else None
         except (ValueError, UnicodeDecodeError):
             data = None
+        adapter = data.get("adapter_id") if isinstance(data, dict) else None
+        if not adapter:
+            for k, v in (getattr(req, "headers", None) or {}).items():
+                if k.lower() == "x-adapter-id":
+                    adapter = v
+                    break
+        mix = (hash_point(f"adapter:{adapter}".encode())
+               if isinstance(adapter, str) and adapter else 0)
         val = data.get(self.policy.key_field) if isinstance(data, dict) else None
         if isinstance(val, str) and val:
             # bounded text prefix (≈4 chars/token), mirroring the token
             # path's key_pages truncation: prompts sharing a long preamble
             # but differing tails must still share a shard key
-            return hash_point(
+            return mix ^ hash_point(
                 val[: self.policy.key_pages * self.policy.page_size * 4].encode())
         if isinstance(val, (list, tuple)) and val:
             try:
-                return self.shard_key(val)
+                return mix ^ self.shard_key(val)
             except (ValueError, TypeError, OverflowError):
                 pass
-        return hash_point(body or getattr(req, "path", "/").encode())
+        return mix ^ hash_point(body or getattr(req, "path", "/").encode())
 
     # -- decision plane --------------------------------------------------------
 

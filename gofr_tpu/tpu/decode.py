@@ -146,11 +146,15 @@ def dispatch_spec_paged(eng) -> bool:
         lanes = [(i, s) for i, s in lanes if eng.slots[i] is s]
         if not lanes:
             return True  # preemption work happened
-        packed = eng._staging("spec", (5 + Wp, n))
+        # ae: one extra packed row carrying each lane's adapter pool slot
+        # (row 5; zero = base). OFF keeps the layout byte-identical to the
+        # pre-adapter engine (tpu/programs.py documents both).
+        ae = 1 if eng._adapters_enabled else 0
+        packed = eng._staging("spec", (5 + ae + Wp, n))
         packed[1, :] = Hcap + 1  # inactive: every hist/cache write lands OOB
         packed[2, :] = 1         # inactive lanes are host-arbitrated
         temps = np.zeros((n,), np.float32)
-        packed[5:] = eng._masked_table({i for i, _ in lanes}).T
+        packed[5 + ae:] = eng._masked_table({i for i, _ in lanes}).T
         for i, s in lanes:
             if s.inflight == 0:
                 # host knows this lane's exact (token, hlen) — it just
@@ -159,6 +163,8 @@ def dispatch_spec_paged(eng) -> bool:
                 packed[1, i] = s.pos + 1
             else:
                 packed[2, i] = 0  # device carry owns (token, hlen)
+            if ae:
+                packed[5, i] = s.adapter_slot
             temps[i] = float(s.request.kw.get("temperature", 0.0))
         packed[3] = temps.view(np.int32)
         eng._step_count += 1
@@ -176,7 +182,8 @@ def dispatch_spec_paged(eng) -> bool:
     if carry is None:
         carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
     toks_dev, accs_dev, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
-        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), carry)
+        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), carry,
+        *((eng._adapter_args(),) if ae else ()))
     pstep = (eng.perf.step_spec(len(lanes), k, eng.spec_tokens, hist, t0)
              if eng.perf is not None else None)
     eng._dq.append(("spec", (toks_dev, accs_dev), [(i, s) for i, s in lanes],
@@ -209,7 +216,8 @@ def dispatch_spec(eng) -> bool:
             lanes.append((i, s))
         if not lanes:
             return False
-        packed = eng._staging("spec", (5, n))
+        ae = 1 if eng._adapters_enabled else 0  # row 5: adapter pool slots
+        packed = eng._staging("spec", (5 + ae, n))
         packed[1, :] = eng._cache_len + 1  # inactive: every write lands OOB
         packed[2, :] = 1                   # inactive lanes are host-arbitrated
         temps = np.zeros((n,), np.float32)
@@ -221,6 +229,8 @@ def dispatch_spec(eng) -> bool:
                 packed[1, i] = s.pos + 1
             else:
                 packed[2, i] = 0  # device carry owns (token, hlen)
+            if ae:
+                packed[5, i] = s.adapter_slot
             temps[i] = float(s.request.kw.get("temperature", 0.0))
         packed[3] = temps.view(np.int32)
         eng._step_count += 1
@@ -239,7 +249,8 @@ def dispatch_spec(eng) -> bool:
     if carry is None:
         carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
     toks_dev, accs_dev, eng.cache, eng._spec_carry = eng._spec_chunk_fn(
-        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), carry)
+        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), carry,
+        *((eng._adapter_args(),) if ae else ()))
     pstep = (eng.perf.step_spec(len(lanes), k, eng.spec_tokens, hist, t0)
              if eng.perf is not None else None)
     eng._dq.append(("spec", (toks_dev, accs_dev), [(i, s) for i, s in lanes],
@@ -288,7 +299,8 @@ def dispatch_decode(eng) -> bool:
         # slots' tables carry the same slack via pages_per_slot). All host
         # inputs ride ONE packed array (layout at the jit definitions).
         wt = eng.pages_per_slot if eng.kv_layout == "paged" else 0
-        packed = eng._staging("decode", (5 + wt, n))
+        ae = 1 if eng._adapters_enabled else 0  # row 5: adapter pool slots
+        packed = eng._staging("decode", (5 + ae + wt, n))
         temps = np.zeros((n,), np.float32)
         if eng.kv_layout != "paged":
             # non-decoding rows (empty, chunk-prefilling, or dead-lane-
@@ -305,12 +317,14 @@ def dispatch_decode(eng) -> bool:
                 packed[0, i] = s.last_token
                 packed[4, i] = 1
             packed[1, i] = p
+            if ae:
+                packed[5, i] = s.adapter_slot
             temps[i] = float(s.request.kw.get("temperature", 0.0))
         packed[2] = temps.view(np.int32)
         eng._step_count += 1
         packed[3, 0] = eng._step_count
         if eng.kv_layout == "paged":
-            packed[5:] = eng._masked_table({i for i, _, _ in lanes}).T
+            packed[5 + ae:] = eng._masked_table({i for i, _, _ in lanes}).T
 
         for _, s, _ in lanes:
             s.inflight += 1
@@ -329,7 +343,8 @@ def dispatch_decode(eng) -> bool:
     if prev is None:
         prev = jnp.zeros((n,), jnp.int32)
     chunk_dev, last_dev, eng.cache = eng._decode_chunk(
-        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), prev
+        eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed), prev,
+        *((eng._adapter_args(),) if ae else ())
     )
     eng._prev_last = last_dev
     pstep = (eng.perf.step_decode(len(lanes), k, hist, t0)
@@ -376,14 +391,19 @@ def process_decode(eng) -> bool:
         return True
     n, k = sig[1], sig[2]
     with eng._state_lock:
+        # per-adapter attribution covers DISPATCHED lanes — a lane freed
+        # while in flight still had device time spent on its behalf
+        ads = ([s.adapter_id or "base" for _, s in meta]
+               if eng._adapters_enabled else None)
         if kind == "spec":
             dev_s = eng._record_step(
                 "decode_spec", time.monotonic() - t0, occupancy,
-                ("decode_spec", n, k, eng.spec_tokens), pstep)
+                ("decode_spec", n, k, eng.spec_tokens), pstep,
+                adapter_ids=ads)
             _fold_spec(eng, toks, accs, meta, k, dev_s)
             return True
         dev_s = eng._record_step("decode", time.monotonic() - t0, occupancy,
-                                 ("decode", n, k), pstep)
+                                 ("decode", n, k), pstep, adapter_ids=ads)
 
         now = time.monotonic()
         accepted = 0

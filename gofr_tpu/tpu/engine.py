@@ -44,6 +44,7 @@ from __future__ import annotations
 import collections
 import itertools
 import math
+import os
 import queue
 from functools import partial
 import threading
@@ -425,17 +426,51 @@ class _EngineBase:
                 "app_request_deadline_exceeded_total", 1, where="engine")
             raise DeadlineExceeded(
                 "request deadline already expired at submission")
+        # multi-LoRA routing (gofr_tpu.adapters; docs/serving.md): resolve
+        # the adapter BEFORE QoS admission — an adapter's declared default
+        # class must key the class gates below — and take its per-adapter
+        # concurrency share (429 at the cap, the per-tenant analog of the
+        # per-class cap; released on the done callback like qos.track).
+        if "adapter_id" in kw:  # public spelling of the internal routing key
+            kw["_adapter"] = kw.pop("adapter_id")
+        registry = getattr(self, "adapters", None)
+        aname = kw.get("_adapter") or None
+        aspec = None
+        if aname:
+            if registry is None:
+                raise ValueError(
+                    f"request names adapter {aname!r} but this engine has no "
+                    "adapter plane (set ADAPTER_SLOTS or ADAPTER_POOL_MB)")
+            try:
+                aspec = registry.admit(aname)
+            except KeyError as e:
+                raise ValueError(str(e.args[0]) if e.args else str(e)) from None
+            if aspec.qos_class and not kw.get("_qos_class"):
+                kw["_qos_class"] = aspec.qos_class
+        we = getattr(self, "weights_epoch", None)
+        if we is not None:
+            # base-weight epoch at submission: surfaced by the flight
+            # recorder so "which weights answered this" stays debuggable
+            # across live hot-swaps (engine.adopt_weights)
+            kw["_weights_epoch"] = we
         qos, cls = self.qos, None
         if qos is not None:
             # admission BEFORE the request exists: backlog cap, per-class
             # concurrency cap, and the predicted-wait-vs-deadline check —
             # hopeless work is rejected with 429/503 + Retry-After here
             # instead of burning a slot and timing out later (docs/qos.md)
-            cls = qos.admit_engine(self, kw.get("_qos_class"), eff_timeout)
+            try:
+                cls = qos.admit_engine(self, kw.get("_qos_class"), eff_timeout)
+            except Exception:
+                if aspec is not None:
+                    registry.release(aname)  # the class gate shed us first
+                raise
             kw["_qos_class"] = cls.name
         req = Request(inputs, kw, eff_timeout, stream)
         if cls is not None:
             qos.track(req, cls)
+        if aspec is not None:
+            req.add_done_callback(lambda _r, _n=aname: registry.release(_n))
         if on_submit is not None:
             on_submit(req)
         self._observe_submit(req, parent_span)
@@ -507,6 +542,12 @@ class _EngineBase:
             "preemptions": kw.get("_preemptions", 0),
             "trace_id": rt.trace_id if rt is not None else None,
         }
+        if kw.get("_adapter"):
+            # which LoRA adapter served this request (None lanes omit the
+            # field entirely — the common base-model case stays compact)
+            entry["adapter"] = kw.get("_adapter")
+        if kw.get("_weights_epoch") is not None:
+            entry["weights_epoch"] = kw.get("_weights_epoch")
         dev = {label: round(kw[f], 6) for label, f in (
             ("prefill_s", "_dev_prefill_s"), ("decode_s", "_dev_decode_s"),
             ("swapin_s", "_dev_swapin_s")) if kw.get(f)}
@@ -565,7 +606,7 @@ class _EngineBase:
                                  ft - req.enqueued_at)
 
     def _record_step(self, kind: str, seconds: float, occupancy: float,
-                     signature: tuple, pstep=None) -> float:
+                     signature: tuple, pstep=None, adapter_ids=None) -> float:
         # called at COMPLETION (dequeue) time under the unified pipeline:
         # `seconds` spans dispatch→fold, so it includes the overlapped
         # in-flight wait, not just device compute. `pstep` (a perf.StepPerf
@@ -577,7 +618,14 @@ class _EngineBase:
         device_s = 0.0
         perf = getattr(self, "perf", None)
         if pstep is not None and perf is not None:
-            perf.note(pstep, time.monotonic())
+            now_perf = time.monotonic()
+            perf.note(pstep, now_perf)
+            if adapter_ids:
+                # per-adapter roofline attribution (metrics/perf.py): one
+                # id per dispatched lane ("base" for adapterless lanes), a
+                # complete partition of the step — per-adapter device-
+                # seconds sum exactly to the step's, the COGS invariant
+                perf.note_adapters(adapter_ids, pstep, now_perf)
             device_s = pstep.device_s
             self.metrics.record_histogram(
                 "app_tpu_step_device_seconds", device_s, kind=kind)
@@ -789,10 +837,11 @@ class _Slot:
 
     __slots__ = ("request", "prompt_len", "pos", "generated", "max_total", "eos",
                  "last_token", "first_token_at", "admit_seq", "prompt_tokens",
-                 "written", "dispatched", "inflight")
+                 "written", "dispatched", "inflight", "adapter_id", "adapter_slot")
 
     def __init__(self, request: Request, prompt_len: int, max_total: int, eos: int | None,
-                 first_token: int | None, admit_seq: int = 0, prompt_tokens: Any = None):
+                 first_token: int | None, admit_seq: int = 0, prompt_tokens: Any = None,
+                 adapter_id: str | None = None, adapter_slot: int = 0):
         self.request = request
         self.prompt_len = prompt_len
         self.pos = prompt_len
@@ -810,6 +859,12 @@ class _Slot:
         # chunks of one prompt can ride the in-flight queue at once
         self.dispatched = self.written
         self.inflight = 0  # decode chunks dispatched but not yet processed
+        # multi-LoRA lane binding (gofr_tpu.adapters): the registry name
+        # and the device pool slot whose factors this lane gathers in
+        # every step; (None, 0) is the base model (pool slot 0 is the
+        # reserved all-zeros adapter — bit-identical to no adapters)
+        self.adapter_id = adapter_id
+        self.adapter_slot = adapter_slot
 
     @property
     def prefilling(self) -> bool:
@@ -881,6 +936,12 @@ class GenerateEngine(_EngineBase):
         handoff_target: str | None = None,
         handoff_listen: str | None = None,
         handoff_timeout_s: float = 5.0,
+        adapter_slots: int = 0,
+        adapter_rank: int = 16,
+        adapter_pool_mb: float = 0.0,
+        adapter_host_mb: float = 256.0,
+        adapter_hotswap_dir: str | None = None,
+        adapter_hotswap_poll_s: float = 5.0,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -1303,6 +1364,66 @@ class GenerateEngine(_EngineBase):
         self._prev_last = None  # device-resident [slots] last-sampled-token carry
         self._spec_carry = None  # device-resident ([slots] token, [slots] hlen)
 
+        # -- multi-LoRA adapter plane (gofr_tpu.adapters; docs/serving.md) ---
+        # Registry = host tier (named specs, per-adapter concurrency caps,
+        # ADAPTER_HOST_MB budget); pool = device tier (fixed-shape HBM
+        # slots, refcounted + LRU like KV pages; slot 0 is the reserved
+        # all-zeros BASE adapter). The pool arrays ride every program call
+        # as DYNAMIC jit args, so uploads/evictions — and the full-model
+        # hot-swap below — never recompile. Disabled (the default), the
+        # packed layouts and program signatures are byte-identical to the
+        # pre-adapter engine.
+        ad_slots = int(adapter_slots)
+        ad_rank = max(1, int(adapter_rank))
+        if adapter_pool_mb and not ad_slots:
+            from gofr_tpu.adapters import AdapterPool
+
+            ad_slots = AdapterPool.slots_for_budget(
+                float(adapter_pool_mb), cfg.hidden_size, cfg.vocab_size, ad_rank)
+        if ad_slots and lockstep_role:
+            # the ENGINE_PREFIX_HOST_MB precedent (above): adapter uploads
+            # are host-initiated device writes the announce stream cannot
+            # reproduce on followers
+            container.logger.warn(
+                "ADAPTER_* ignored under lockstep (pool uploads cannot be "
+                "announced to followers)")
+            ad_slots = 0
+        if ad_slots and not getattr(family, "SUPPORTS_ADAPTERS", False):
+            raise ValueError(
+                f"family {getattr(family, '__name__', family)!r} does not "
+                "support per-lane adapters (no SUPPORTS_ADAPTERS entry "
+                "points); drop ADAPTER_SLOTS/ADAPTER_POOL_MB")
+        self._adapters_enabled = bool(ad_slots)
+        self.adapters = None
+        self._adapter_pool = None
+        if self._adapters_enabled:
+            from gofr_tpu.adapters import AdapterPool, AdapterRegistry
+
+            self._adapter_pool = AdapterPool(
+                max(2, ad_slots), cfg.hidden_size, cfg.vocab_size, ad_rank)
+            self.adapters = AdapterRegistry(
+                host_budget_mb=float(adapter_host_mb))
+        # -- live weight hot-swap (adopt_weights / adopt_checkpoint) ---------
+        # weights_epoch counts full-model adoptions; it feeds fleet.epoch_of
+        # so router gossip sees a strict epoch bump and never routes one
+        # request across mismatched weights (docs/serving.md).
+        self.weights_epoch = 0
+        self._pending_weights = None
+        self._swap_lock = threading.Lock()
+        hotswap_dir = str(adapter_hotswap_dir or "") or None
+        if hotswap_dir and lockstep_role:
+            container.logger.warn(
+                "ADAPTER_HOTSWAP_DIR ignored under lockstep (weight adoption "
+                "cannot be announced to followers)")
+            hotswap_dir = None
+        self._hotswap_dir = hotswap_dir
+        self._hotswap_poll_s = max(0.5, float(adapter_hotswap_poll_s))
+        self._hotswap_last = 0.0
+        # steps already present at build time ARE the serving weights —
+        # only checkpoints that appear later trigger adoption
+        self._hotswap_seen = (self._scan_hotswap_steps()
+                              if self._hotswap_dir else None)
+
         # Compiled packed-program handles (tpu/programs.py documents the
         # packed layouts; lockstep followers call the same handles).
         progs = build_programs(
@@ -1316,6 +1437,7 @@ class GenerateEngine(_EngineBase):
             cache_len=getattr(self, "_cache_len", 0),
             prefill_attn_fn=prefill_attn_fn,
             draft=self._draft,
+            adapters=self._adapters_enabled,
         )
         self._prefill_sample = progs.prefill_sample
         if progs.chunk_prefill is not None:
@@ -1688,6 +1810,232 @@ class GenerateEngine(_EngineBase):
             return
         LockstepFollower(self, deadline_s=deadline).run()
 
+    # -- multi-LoRA adapters (gofr_tpu.adapters; docs/serving.md) --------------
+
+    def register_adapter(self, spec) -> None:
+        """Install (or replace) a named LoRA adapter for serving. Host-tier
+        registration only — the device upload happens lazily at the first
+        admission that names it (AdapterPool.acquire). Replacing an adapter
+        whose pool slot is referenced by a live lane raises: weights must
+        never change under an in-flight request (drain first)."""
+        if not self._adapters_enabled:
+            raise RuntimeError(
+                "engine built without the adapter plane; set ADAPTER_SLOTS "
+                "or ADAPTER_POOL_MB")
+        if spec.rank > self._adapter_pool.rank:
+            raise ValueError(
+                f"adapter {spec.name!r} rank {spec.rank} exceeds the pool "
+                f"rank {self._adapter_pool.rank} (ADAPTER_RANK)")
+        with self._state_lock:
+            self.adapters.register(spec, pool=self._adapter_pool)
+        self.metrics.set_gauge(
+            "app_tpu_adapters_registered", len(self.adapters.names()))
+
+    def unregister_adapter(self, name: str) -> None:
+        """Remove an adapter from both tiers. Raises while lanes still
+        reference its pool slot (same discipline as register-replace)."""
+        if not self._adapters_enabled:
+            return
+        with self._state_lock:
+            self.adapters.unregister(name, pool=self._adapter_pool)
+        self.metrics.set_gauge(
+            "app_tpu_adapters_registered", len(self.adapters.names()))
+
+    def adapter_stats(self) -> dict[str, Any]:
+        """Both tiers' occupancy + the weights epoch, for /debug/engine."""
+        if not self._adapters_enabled:
+            return {"enabled": False, "weights_epoch": self.weights_epoch}
+        with self._state_lock:
+            pool = self._adapter_pool.stats()
+        out = {"enabled": True, "registry": self.adapters.stats(),
+               "pool": pool, "weights_epoch": self.weights_epoch}
+        return out
+
+    def adapters_digest(self) -> str:
+        """Adapter-set fingerprint for the handoff JOIN gate (empty when
+        the plane is disabled — pre-adapter peers send/expect nothing)."""
+        return self.adapters.digest() if self._adapters_enabled else ""
+
+    def _adapter_args(self) -> tuple:
+        """The device pool triple threaded into every adapter-enabled
+        program call as trailing DYNAMIC jit args (tpu/programs.py) —
+        uploads and hot-swaps never recompile."""
+        p = self._adapter_pool
+        return (p.a, p.b, p.scale)
+
+    def _acquire_adapter(self, req: Request):
+        """Resolve ``req``'s adapter to a device pool slot at admission
+        (caller holds the state lock). Returns ``(adapter_id, pool_slot)``
+        when bound — base requests bind ``(None, 0)`` — the string
+        ``"wait"`` when every pool slot is referenced by a live lane (the
+        caller requeues, exactly like KV page exhaustion), or ``None``
+        when the adapter vanished since submission (the request was failed
+        here)."""
+        name = req.kw.get("_adapter")
+        if not name or not self._adapters_enabled:
+            return (None, 0)
+        try:
+            spec = self.adapters.get(name)
+        except KeyError as e:
+            req.complete(error=ValueError(
+                str(e.args[0]) if e.args else str(e)))
+            return None
+        aslot = self._adapter_pool.acquire(spec)
+        if aslot is None:
+            return "wait"
+        return (name, aslot)
+
+    # -- live weight hot-swap (zero-drop; docs/serving.md) ---------------------
+
+    def adopt_weights(self, new_params, *, timeout_s: float | None = 30.0) -> int:
+        """Adopt a full replacement weight tree with no restart and no
+        dropped requests: the device loop drains the in-flight queue,
+        requeues slot-resident work whole (preemption-by-recompute — a
+        request either finished on the old weights or re-enters the queue
+        as a fresh prefill; tokens from the two epochs never mix inside
+        one decode step), resets per-epoch device state (the prefix cache
+        and KV pages carry old-weight K/V), swaps ``params`` and bumps
+        ``weights_epoch`` — which feeds fleet.epoch_of, so router gossip
+        sees a strict epoch bump. Returns the new epoch. Blocks up to
+        ``timeout_s`` for the adoption (None = stage and return)."""
+        if self.lockstep_role:
+            raise RuntimeError(
+                "live weight hot-swap is not supported under lockstep "
+                "(weight adoption cannot be announced to followers)")
+        new_params = self._match_weights(new_params)
+        done = threading.Event()
+        with self._swap_lock:
+            self._pending_weights = (new_params, done)
+        if self._thread is None or not self._thread.is_alive():
+            # not serving yet (tests, pre-start swap): adopt inline
+            self._apply_pending_weights()
+            return self.weights_epoch
+        if timeout_s is not None and not done.wait(timeout_s):
+            raise TimeoutError(
+                f"weight hot-swap not adopted within {timeout_s:.1f}s")
+        return self.weights_epoch
+
+    def adopt_checkpoint(self, directory: str, *,
+                         timeout_s: float | None = 30.0) -> int:
+        """Adopt the latest orbax checkpoint under ``directory``
+        (train/checkpoint.py layout) as the serving weights — the scripted
+        train→serve hot-swap path. The raw tree is resolved through the
+        same post-processing the ctor weights got (mesh sharding, weight
+        quantization when the serving tree is quantized)."""
+        from gofr_tpu.train.checkpoint import load_params
+
+        like = jax.eval_shape(
+            lambda: self.family.init(self.cfg, jax.random.key(0)))
+        raw = load_params(directory, like)
+        return self.adopt_weights(self._prepare_weights(raw),
+                                  timeout_s=timeout_s)
+
+    def _match_weights(self, new_params):
+        """Validate a replacement tree against the serving tree: identical
+        structure, shapes, and dtypes — anything else would recompile
+        every program (or garble decode) mid-serving. A draft-spec engine
+        may pass just the target tree; the live draft is grafted in."""
+        if (self._draft is not None and isinstance(self.params, dict)
+                and not (isinstance(new_params, dict) and "t" in new_params)):
+            new_params = {"t": new_params, "d": self.params["d"]}
+        if jax.tree.structure(new_params) != jax.tree.structure(self.params):
+            raise ValueError(
+                "adopt_weights: replacement tree structure does not match "
+                "the serving tree (same family/config/quantization required)")
+        for new, old in zip(jax.tree.leaves(new_params),
+                            jax.tree.leaves(self.params)):
+            if (tuple(new.shape) != tuple(old.shape)
+                    or jnp.asarray(new).dtype != jnp.asarray(old).dtype):
+                raise ValueError(
+                    f"adopt_weights: leaf {tuple(new.shape)}/{new.dtype} != "
+                    f"serving {tuple(old.shape)}/{old.dtype}")
+        return new_params
+
+    def _prepare_weights(self, raw):
+        """Run a raw (checkpoint) tree through the ctor weights' post-
+        processing: shard over the mesh by the family's logical axes, then
+        weight-only quantization when the serving tree is quantized."""
+        rules = getattr(self.tpu, "rules", None)
+        mesh = getattr(self.tpu, "mesh", None)
+        if rules is not None:
+            raw = shard_pytree(raw, self.family.param_axes(self.cfg),
+                               rules, mesh)
+        target = (self.params["t"] if self._draft is not None
+                  else self.params)
+        if jax.tree.structure(raw) != jax.tree.structure(target):
+            from gofr_tpu.ops.quant import quantize_tree
+
+            raw = jax.jit(quantize_tree)(raw)
+        return raw
+
+    def _apply_pending_weights(self) -> bool:
+        """Device-loop half of the hot-swap (also run inline pre-start):
+        the zero-drop drain. Mirrors ``_fleet_admit``'s epoch bump — fold
+        every in-flight device call, requeue slot-resident work whole via
+        preemption-by-recompute, reset per-epoch device state OUTSIDE the
+        lock, then swap the tree and bump the epoch."""
+        with self._swap_lock:
+            pending, self._pending_weights = self._pending_weights, None
+        if pending is None:
+            return False
+        new_params, done = pending
+        while self._dq:
+            process_decode(self)
+        with self._state_lock:
+            while self._preempt_newest():
+                pass
+        # outside the lock — _reset_device_state blocks on still-executing
+        # device work first (_drain_device_state), and that wait must never
+        # run under _state_lock (the _fleet_admit discipline)
+        self._reset_device_state()
+        self.params = new_params
+        self.weights_epoch += 1
+        self.metrics.set_gauge("app_tpu_weights_epoch", self.weights_epoch)
+        self.metrics.increment_counter("app_tpu_weight_swaps_total", 1)
+        self.logger.warn(
+            f"live weight hot-swap adopted (weights epoch "
+            f"{self.weights_epoch}); slot-resident work requeued")
+        done.set()
+        return True
+
+    def _scan_hotswap_steps(self) -> int | None:
+        """Newest checkpoint step under ADAPTER_HOTSWAP_DIR, by a light
+        directory scan — orbax step dirs are bare integers and appear
+        atomically (saves land in a tmp dir and rename), so this never
+        opens a CheckpointManager on the device thread's poll path."""
+        try:
+            steps = [int(d) for d in os.listdir(self._hotswap_dir)
+                     if d.isdigit()]
+        except OSError:
+            return None
+        return max(steps) if steps else None
+
+    def _poll_hotswap(self) -> None:
+        """Device-loop tick: adopt any checkpoint step newer than the last
+        one seen (throttled to ADAPTER_HOTSWAP_POLL_S)."""
+        now = time.monotonic()
+        if now - self._hotswap_last < self._hotswap_poll_s:
+            return
+        self._hotswap_last = now
+        step = self._scan_hotswap_steps()
+        if step is None or (self._hotswap_seen is not None
+                            and step <= self._hotswap_seen):
+            return
+        self._hotswap_seen = step
+        try:
+            from gofr_tpu.train.checkpoint import load_params
+
+            like = jax.eval_shape(
+                lambda: self.family.init(self.cfg, jax.random.key(0)))
+            raw = load_params(self._hotswap_dir, like)
+            with self._swap_lock:
+                self._pending_weights = (
+                    self._match_weights(self._prepare_weights(raw)),
+                    threading.Event())
+            self._apply_pending_weights()
+        except Exception as e:  # noqa: BLE001 - a bad checkpoint must not kill serving
+            self.logger.log_exception(e, "hot-swap checkpoint adoption")
+
     # -- device loop -----------------------------------------------------------
 
     def _encode_prompt(self, prompt: Any) -> np.ndarray:
@@ -2024,7 +2372,9 @@ class GenerateEngine(_EngineBase):
     def _free_slot(self, idx: int) -> None:
         """Vacate a slot; in the paged layout its share of each page is
         released (pages also held by the prefix cache or other slots stay
-        allocated — refcount zero is what returns a page to the pool)."""
+        allocated — refcount zero is what returns a page to the pool).
+        The slot's adapter pool reference drops with it."""
+        s = self.slots[idx]
         self.slots[idx] = None
         self._decode_lanes.discard(idx)
         self._prefill_lanes.discard(idx)
@@ -2037,6 +2387,8 @@ class GenerateEngine(_EngineBase):
                 for p in pages:
                     self._unref_page(p)
             self.metrics.set_gauge("app_tpu_kv_pages_free", len(self._free_pages))
+        if s is not None and s.adapter_slot and self._adapter_pool is not None:
+            self._adapter_pool.release(s.adapter_slot)
 
     def _set_prefix_gauges(self) -> None:
         """One authoritative write of every prefix-cache occupancy gauge —
@@ -2445,6 +2797,12 @@ class GenerateEngine(_EngineBase):
                 # fleet membership change: admit (re)joining followers at
                 # this step boundary via an epoch bump (requeue + reset)
                 self._fleet_admit()
+            if self._pending_weights is not None:
+                # live hot-swap staged by adopt_weights: drain + requeue +
+                # epoch bump at this step boundary (zero-drop)
+                self._apply_pending_weights()
+            if self._hotswap_dir is not None:
+                self._poll_hotswap()
             processed = False
             admitted = self._admit()
             if depth == 1:
@@ -2533,6 +2891,14 @@ class GenerateEngine(_EngineBase):
             if req.cancelled or req.expired(time.monotonic()):
                 req.complete(error=RequestTimeout())
                 continue
+            ad = self._acquire_adapter(req)
+            if ad is None:
+                continue  # adapter vanished since submit; request failed
+            if ad == "wait":
+                # every adapter pool slot is referenced by a live lane:
+                # requeue at the head, exactly like KV page exhaustion
+                self._pending_long.insert(0, (req, toks))
+                break
             idx = self._free_slots()[0]
             slot = _Slot(
                 req,
@@ -2543,6 +2909,8 @@ class GenerateEngine(_EngineBase):
                 first_token=None,
                 admit_seq=self._admit_seq,
                 prompt_tokens=toks,
+                adapter_id=ad[0],
+                adapter_slot=ad[1],
             )
             self._admit_seq += 1
             self._claim_slot(idx, slot)
@@ -2615,8 +2983,10 @@ class GenerateEngine(_EngineBase):
         idx, s, chunk, offset, last = meta
         lb = sig[1]
         with self._state_lock:
-            dev_s = self._record_step("prefill_chunk", time.monotonic() - t0,
-                                      occupancy, sig, pstep)
+            dev_s = self._record_step(
+                "prefill_chunk", time.monotonic() - t0, occupancy, sig, pstep,
+                adapter_ids=([s.adapter_id or "base"]
+                             if self._adapters_enabled else None))
             if self.slots[idx] is not s:
                 return  # stop()/preemption/cancel took over while in flight
             if s.request.cancelled or s.request.expired(time.monotonic()):
@@ -2750,6 +3120,30 @@ class GenerateEngine(_EngineBase):
             taken = set(plan.chosen) | set(plan.expired)
             self._pending = [p for i, p in enumerate(self._pending) if i not in taken]
 
+            ad_of: dict[int, tuple] | None = None
+            if self._adapters_enabled:
+                # bind each chosen request's adapter to a device pool slot
+                # BEFORE any slot/page claims below — dropping a request
+                # after its pages were ensured would misalign the
+                # row↔pages mapping of the batched dispatch
+                ad_of = {}
+                bound = []
+                ad_wait = False
+                for req, toks in ready:
+                    ad = None if ad_wait else self._acquire_adapter(req)
+                    if ad is None and not ad_wait:
+                        continue  # adapter vanished since submit; failed
+                    if ad_wait or ad == "wait":
+                        # pool fully referenced by live lanes: requeue
+                        # (order preserved — later picks wait behind it,
+                        # exactly like the KV page-exhaustion gate)
+                        ad_wait = True
+                        self._pending.append((req, toks))
+                        continue
+                    ad_of[id(req)] = ad
+                    bound.append((req, toks))
+                ready = bound
+
             chunk_claimed = False
             if self.kv_layout == "paged" and self._prefix is not None:
                 # EDF-chosen prompts whose cached prefix covers ≥ HALF their
@@ -2766,6 +3160,8 @@ class GenerateEngine(_EngineBase):
                     chain = self._usable_hit(toks)
                     if 2 * len(chain) * self.page_size >= int(toks.shape[0]):
                         idx = self._free_slots()[0]
+                        ad = (ad_of.get(id(req), (None, 0))
+                              if ad_of is not None else (None, 0))
                         slot = _Slot(
                             req,
                             prompt_len=int(toks.shape[0]),
@@ -2777,6 +3173,8 @@ class GenerateEngine(_EngineBase):
                             first_token=None,
                             admit_seq=self._admit_seq,
                             prompt_tokens=toks,
+                            adapter_id=ad[0],
+                            adapter_slot=ad[1],
                         )
                         self._admit_seq += 1
                         self._claim_slot(idx, slot)
@@ -2810,6 +3208,13 @@ class GenerateEngine(_EngineBase):
                         admitted.append((req, toks))
                     else:
                         exhausted = True
+                        if ad_of is not None:
+                            # bounced back to pending: drop the adapter
+                            # pool reference taken above (re-acquired at
+                            # the next admission attempt)
+                            a = ad_of.pop(id(req), None)
+                            if a and a[1]:
+                                self._adapter_pool.release(a[1])
                         self._pending.append((req, toks))
                 ready = admitted
             if not ready:
@@ -2849,6 +3254,8 @@ class GenerateEngine(_EngineBase):
                 if rt is not None:
                     rt.begin("engine.prefill",
                              **{"prefill.len_bucket": lb, "prefill.batch": nb})
+                ad = (ad_of.get(id(req), (None, 0))
+                      if ad_of is not None else (None, 0))
                 slot = _Slot(
                     req,
                     prompt_len=int(toks.shape[0]),
@@ -2858,6 +3265,8 @@ class GenerateEngine(_EngineBase):
                     first_token=None,
                     admit_seq=self._admit_seq,
                     prompt_tokens=toks,
+                    adapter_id=ad[0],
+                    adapter_slot=ad[1],
                 )
                 slot.dispatched = slot.prompt_len  # whole prompt in this call
                 self._admit_seq += 1
@@ -2881,8 +3290,10 @@ class GenerateEngine(_EngineBase):
         are discarded by identity — their requests were already completed
         and their pages returned by _free_slot."""
         with self._state_lock:
-            dev_s = self._record_step("prefill", time.monotonic() - t0,
-                                      occupancy, sig, pstep)
+            dev_s = self._record_step(
+                "prefill", time.monotonic() - t0, occupancy, sig, pstep,
+                adapter_ids=([s.adapter_id or "base" for _, s in meta]
+                             if self._adapters_enabled else None))
             now = time.monotonic()
             tokens = 0
             for row, (idx, s) in enumerate(meta):
@@ -3331,6 +3742,23 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             handoff_target=handoff_target,
             handoff_listen=handoff_listen,
             handoff_timeout_s=handoff_timeout,
+            # multi-LoRA adapter plane (gofr_tpu.adapters, docs/serving.md):
+            # off by default — both spellings disabled keeps the engine
+            # byte-identical to the pre-adapter build
+            adapter_slots=int(kw.pop("adapter_slots",
+                                     conf.get_int("ADAPTER_SLOTS", 0))),
+            adapter_rank=int(kw.pop("adapter_rank",
+                                    conf.get_int("ADAPTER_RANK", 16))),
+            adapter_pool_mb=float(kw.pop("adapter_pool_mb",
+                                         conf.get_float("ADAPTER_POOL_MB", 0.0))),
+            adapter_host_mb=float(kw.pop("adapter_host_mb",
+                                         conf.get_float("ADAPTER_HOST_MB", 256.0))),
+            adapter_hotswap_dir=kw.pop(
+                "adapter_hotswap_dir",
+                conf.get_or_default("ADAPTER_HOTSWAP_DIR", "")) or None,
+            adapter_hotswap_poll_s=float(kw.pop(
+                "adapter_hotswap_poll_s",
+                conf.get_float("ADAPTER_HOTSWAP_POLL_S", 5.0))),
             **kw,
         )
 

@@ -100,7 +100,12 @@ def dispatch_prefill(eng, plan: PrefillPlan) -> None:
     nb, lb, w = plan.nb, plan.lb, plan.w
     # block-table columns (w may add a trailing slot-id col on top)
     wp = eng.pages_per_slot if eng.kv_layout == "paged" else 0
-    packed = eng._staging("prefill", (nb, lb + w + 3))
+    # ae: one extra column carrying each row's adapter pool slot, between
+    # the rows block and temps (zero = base — padding rows' zero sel
+    # selects the all-zeros base adapter, whose delta is exactly 0.0).
+    # OFF keeps the pack byte-identical to the pre-adapter layout.
+    ae = 1 if eng._adapters_enabled else 0
+    packed = eng._staging("prefill", (nb, lb + w + 3 + ae))
     packed[:, lb] = 1  # padding rows: length 1
     temps = np.zeros((nb,), np.float32)
     if eng.kv_layout == "paged":
@@ -119,13 +124,16 @@ def dispatch_prefill(eng, plan: PrefillPlan) -> None:
                 packed[i, lb + 1 + wp] = plan.rows[i]
         else:
             packed[i, lb + 1] = plan.rows[i]
+        if ae:
+            packed[i, lb + 1 + w] = plan.meta[i][1].adapter_slot
         temps[i] = float(req.kw.get("temperature", 0.0))
-    packed[:, lb + 1 + w] = temps.view(np.int32)
-    packed[0, lb + 2 + w] = plan.step
+    packed[:, lb + 1 + w + ae] = temps.view(np.int32)
+    packed[0, lb + 2 + w + ae] = plan.step
 
     eng._announce(TAG_PREFILL, lb, nb, packed)
     first_dev, eng.cache = eng._prefill_sample(
-        eng.params, eng._base_key, eng.cache, jnp.asarray(packed)
+        eng.params, eng._base_key, eng.cache, jnp.asarray(packed),
+        *((eng._adapter_args(),) if ae else ())
     )
     # tokens, never logits — and NEVER read back here: the future rides
     # the in-flight queue; _fold_prefill activates the claimed slots at
@@ -144,7 +152,8 @@ def dispatch_chunk(eng, plan: ChunkPlan) -> None:
     s, lb, chunk, offset = plan.slot, plan.lb, plan.chunk, plan.offset
     w = prefill_cols(eng)
     wp = eng.pages_per_slot if eng.kv_layout == "paged" else 0
-    packed = eng._staging("chunk", (1, lb + w + 4))
+    ae = 1 if eng._adapters_enabled else 0  # sel col after the offset
+    packed = eng._staging("chunk", (1, lb + w + 4 + ae))
     packed[0, :chunk] = s.prompt_tokens[offset:offset + chunk]
     packed[0, lb] = chunk
     if eng.kv_layout == "paged":
@@ -154,12 +163,15 @@ def dispatch_chunk(eng, plan: ChunkPlan) -> None:
     else:
         packed[0, lb + 1] = plan.idx
     packed[0, lb + 1 + w] = offset  # chunk offset
-    packed[0, lb + 2 + w] = np.float32(plan.temp).view(np.int32)
-    packed[0, lb + 3 + w] = plan.step
+    if ae:
+        packed[0, lb + 2 + w] = s.adapter_slot
+    packed[0, lb + 2 + w + ae] = np.float32(plan.temp).view(np.int32)
+    packed[0, lb + 3 + w + ae] = plan.step
 
     eng._announce(TAG_CHUNK, lb, 1, packed)
     first_dev, eng.cache = eng._chunk_prefill(
-        eng.params, eng._base_key, eng.cache, jnp.asarray(packed)
+        eng.params, eng._base_key, eng.cache, jnp.asarray(packed),
+        *((eng._adapter_args(),) if ae else ())
     )
     pstep = (eng.perf.step_chunk(chunk, offset, plan.t0)
              if eng.perf is not None else None)
@@ -256,18 +268,24 @@ def warmup_compile(eng, lbs: list[int], bbs: list[int]) -> int:
     warm_decode = eng.role != "prefill"
     w = prefill_cols(eng)
     wp = eng.pages_per_slot if eng.kv_layout == "paged" else 0
+    # adapter-enabled engines compile the sel-bearing signatures (every
+    # pack grows by the sel row/column; zero sel = base adapter, and the
+    # warmup ships the pool args exactly like live dispatch)
+    ae = 1 if eng._adapters_enabled else 0
+    ad = (eng._adapter_args(),) if ae else ()
     oob = eng.total_pages if eng.kv_layout == "paged" else eng.num_slots
     if warm_prefill:
         for lb in lbs:
             for nb in bbs:
-                packed = np.zeros((nb, lb + w + 3), np.int32)
+                packed = np.zeros((nb, lb + w + 3 + ae), np.int32)
                 packed[:, lb] = 1  # lengths
                 packed[:, lb + 1:lb + 1 + w] = oob  # all-OOB rows: writes dropped
                 if eng.kv_layout == "paged" and eng.spec_tokens:
                     packed[:, lb + 1 + wp] = eng.num_slots  # OOB hist lanes
                 eng._announce(TAG_PREFILL, lb, nb, packed)
                 toks, eng.cache = eng._prefill_sample(
-                    eng.params, eng._base_key, eng.cache, jnp.asarray(packed)
+                    eng.params, eng._base_key, eng.cache,
+                    jnp.asarray(packed), *ad
                 )
                 jax.block_until_ready(toks)
                 eng._compiled.add(("prefill", lb, nb))
@@ -279,23 +297,24 @@ def warmup_compile(eng, lbs: list[int], bbs: list[int]) -> int:
         # Both roles need these: prefill serves long prompts through
         # them, decode computes the post-hit prompt remainder.
         for lb in lbs:
-            packed = np.zeros((1, lb + w + 4), np.int32)
+            packed = np.zeros((1, lb + w + 4 + ae), np.int32)
             packed[0, lb] = 1
             packed[0, lb + 1:lb + 1 + w] = oob
             if eng.kv_layout == "paged" and eng.spec_tokens:
                 packed[0, lb + 1 + wp] = eng.num_slots  # OOB hist lane
             eng._announce(TAG_CHUNK, lb, 1, packed)
             toks, eng.cache = eng._chunk_prefill(
-                eng.params, eng._base_key, eng.cache, jnp.asarray(packed)
+                eng.params, eng._base_key, eng.cache, jnp.asarray(packed),
+                *ad
             )
             jax.block_until_ready(toks)
             eng._compiled.add(("prefill_chunk", lb, 1))
             count += 1
     n, k = eng.num_slots, eng.decode_chunk
     wt = eng.pages_per_slot if eng.kv_layout == "paged" else 0
-    packed = np.zeros((5 + wt, n), np.int32)
+    packed = np.zeros((5 + ae + wt, n), np.int32)
     if eng.kv_layout == "paged":
-        packed[5:] = eng.total_pages  # OOB table: writes dropped
+        packed[5 + ae:] = eng.total_pages  # OOB table: writes dropped
     else:
         packed[1, :] = eng._cache_len  # OOB positions: writes dropped
     if warm_decode and not eng.spec_tokens:
@@ -304,7 +323,7 @@ def warmup_compile(eng, lbs: list[int], bbs: list[int]) -> int:
         eng._announce(TAG_DECODE, 0, 0, packed)  # a=0: warmup, no carry
         out, _, eng.cache = eng._decode_chunk(
             eng.params, eng._base_key, eng.cache, k, jnp.asarray(packed),
-            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.int32), *ad
         )
         jax.block_until_ready(out)
         eng._compiled.add(("decode", n, k))
@@ -318,19 +337,19 @@ def warmup_compile(eng, lbs: list[int], bbs: list[int]) -> int:
         # warmup-produced value (ADVICE r5).
         if eng.kv_layout == "paged":
             sw = eng.pages_per_slot
-            spec_packed = np.zeros((5 + sw, n), np.int32)
+            spec_packed = np.zeros((5 + ae + sw, n), np.int32)
             spec_packed[1, :] = sw * eng.page_size + 1  # all lanes OOB
             spec_packed[2, :] = 1
-            spec_packed[5:] = eng.total_pages  # all-OOB tables
+            spec_packed[5 + ae:] = eng.total_pages  # all-OOB tables
         else:
-            spec_packed = np.zeros((5, n), np.int32)
+            spec_packed = np.zeros((5 + ae, n), np.int32)
             spec_packed[1, :] = eng._cache_len + 1
             spec_packed[2, :] = 1
         eng._announce(TAG_SPEC, spec_packed.shape[0], 0, spec_packed)
         carry = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
         toks, _, eng.cache, _warm_carry = eng._spec_chunk_fn(
             eng.params, eng._base_key, eng.cache, k,
-            jnp.asarray(spec_packed), carry)
+            jnp.asarray(spec_packed), carry, *ad)
         del _warm_carry  # never stored: _loop starts from None
         jax.block_until_ready(toks)
         eng._compiled.add(("decode_spec", n, k, eng.spec_tokens))

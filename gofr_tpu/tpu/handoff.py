@@ -64,6 +64,15 @@ _I32 = struct.Struct("<i")
 ACK_OK = 0
 ACK_REJECTED = 1
 ACK_DTYPE_MISMATCH = 2
+# adapter-era JOIN gates: the P/D split must agree on WHICH adapters
+# exist (a decode worker resolving an adapter the prefill side never
+# loaded would serve the wrong weights) and on the base-weight epoch (a
+# hot-swap landing on one side only would mix weights across one
+# request). Both fields are optional in the hello — absent means a
+# pre-adapter peer, which gates on neither (wildcard), preserving
+# rolling-upgrade compatibility.
+ACK_ADAPTER_MISMATCH = 3
+ACK_EPOCH_MISMATCH = 4
 
 # the JOIN hello is a few dozen bytes of JSON; anything bigger is not ours
 _MAX_HELLO_BYTES = 4096
@@ -222,9 +231,15 @@ class HandoffExporter:
             return self._sock
         s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # JOIN: magic + kv-dtype hello; a mismatched pool layout is
-        # rejected HERE, before any multi-MB page frame moves
-        hello = json.dumps({"kv_dtype": engine_kv_dtype(self.engine)}).encode("utf-8")
+        # JOIN: magic + hello (kv dtype, adapter-set digest, base-weight
+        # epoch); a mismatched pool layout / adapter set / weights epoch
+        # is rejected HERE, before any multi-MB page frame moves
+        hello = json.dumps({
+            "kv_dtype": engine_kv_dtype(self.engine),
+            "adapters": str(getattr(self.engine, "adapters_digest",
+                                    lambda: "")()),
+            "weights_epoch": int(getattr(self.engine, "weights_epoch", 0) or 0),
+        }).encode("utf-8")
         s.sendall(_MAGIC + _I32.pack(len(hello)) + hello)
         try:
             (status,) = _I32.unpack(_recv_exact(s, _I32.size))
@@ -233,6 +248,16 @@ class HandoffExporter:
             raise
         if status != ACK_OK:
             s.close()
+            if status == ACK_ADAPTER_MISMATCH:
+                raise HandoffClosed(
+                    "decode worker rejected JOIN (ACK_ADAPTER_MISMATCH): the "
+                    "P/D sides disagree on the loaded adapter set (register "
+                    "the same adapters — names, ranks, scales — on both)")
+            if status == ACK_EPOCH_MISMATCH:
+                raise HandoffClosed(
+                    "decode worker rejected JOIN (ACK_EPOCH_MISMATCH): the "
+                    "P/D sides are at different base-weight epochs (a live "
+                    "hot-swap must land on both before pages move)")
             raise HandoffClosed(
                 f"decode worker rejected JOIN (status {status}): "
                 f"kv dtype {engine_kv_dtype(self.engine)!r} does not match the "
@@ -433,6 +458,37 @@ class HandoffServer:
                         f"import pool {want!r}")
                 conn.sendall(_I32.pack(ACK_DTYPE_MISMATCH))
                 return
+            # adapter-era gates — checked ONLY when the hello carries the
+            # fields (a pre-adapter peer sends neither and gates on
+            # neither; see the ACK code comment)
+            if "adapters" in hello:
+                want_ad = str(getattr(self.engine, "adapters_digest",
+                                      lambda: "")())
+                got_ad = str(hello.get("adapters", ""))
+                if got_ad != want_ad:
+                    with self._lock:
+                        self._stats["rejected"] += 1
+                    if self.logger is not None:
+                        self.logger.warn(
+                            f"kv handoff JOIN rejected: peer adapter set "
+                            f"{got_ad or '<none>'} != local "
+                            f"{want_ad or '<none>'} (register identical "
+                            f"adapters on both P/D sides)")
+                    conn.sendall(_I32.pack(ACK_ADAPTER_MISMATCH))
+                    return
+            if "weights_epoch" in hello:
+                want_we = int(getattr(self.engine, "weights_epoch", 0) or 0)
+                got_we = int(hello.get("weights_epoch", 0) or 0)
+                if got_we != want_we:
+                    with self._lock:
+                        self._stats["rejected"] += 1
+                    if self.logger is not None:
+                        self.logger.warn(
+                            f"kv handoff JOIN rejected: peer base-weight "
+                            f"epoch {got_we} != local {want_we} (hot-swap "
+                            f"must land on both sides before pages move)")
+                    conn.sendall(_I32.pack(ACK_EPOCH_MISMATCH))
+                    return
             conn.sendall(_I32.pack(ACK_OK))
             while not self._stop.is_set():
                 toks, payloads, nbytes_page, frame_dtype = decode_frame(conn)
@@ -502,7 +558,8 @@ class HandoffServer:
 
 
 __all__ = [
-    "ACK_DTYPE_MISMATCH", "ACK_OK", "ACK_REJECTED", "HandoffClosed",
+    "ACK_ADAPTER_MISMATCH", "ACK_DTYPE_MISMATCH", "ACK_EPOCH_MISMATCH",
+    "ACK_OK", "ACK_REJECTED", "HandoffClosed",
     "HandoffExporter", "HandoffJob", "HandoffServer", "decode_frame",
     "encode_frame", "engine_kv_dtype",
 ]

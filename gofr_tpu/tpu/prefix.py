@@ -27,6 +27,12 @@ Correctness invariants:
   beyond the hit length, which live in pages the slot allocated itself.
 - Pages carry pool refcounts (engine ``_page_refs``): a page returns to the
   free pool only when no slot uses it AND the cache no longer holds it.
+- Cached K/V is ADAPTER-INDEPENDENT: multi-LoRA multiplexing
+  (gofr_tpu.adapters) applies its delta at the lm_head only, so a prefix
+  cached by one adapter's request is a valid hit for any other adapter
+  (and for the base model). No adapter id belongs in the chain key. A
+  full-model hot-swap (engine.adopt_weights) is the opposite case — the
+  cache is cleared wholesale via ``_reset_device_state``.
   Pool pressure spills (or, with the host tier off, evicts) least-recently-
   used cache leaves before the engine resorts to preemption. Host-resident
   nodes hold NO pool reference — a page is counted in exactly one tier.

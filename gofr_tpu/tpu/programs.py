@@ -44,6 +44,15 @@ seed the device-resident history rows):
   every cache/history write drops. History never rides the wire in
   either layout.
 
+With multi-LoRA adapters on (``build_programs(adapters=True)``), every
+layout grows the per-lane adapter-pool slot id ``sel``: prefill packs one
+extra column between the offsets (if chunked) and temps columns, and the
+decode/spec packs insert a ``sel`` row at ``[5]`` (the block table moves
+to ``[6:]``). Each program then takes a trailing ``ad = (a, b, scale)``
+pool-array argument (dynamic, like ``params`` — uploads and live
+hot-swap never recompile). With adapters off, layouts and traces are
+byte-identical to the above.
+
 Backend resolution is a TRACE-time property of these programs: the decode
 attention ops inside them resolve ``backend="auto"`` when a program first
 traces (warmup), consulting the engine's pinned autotune decisions
@@ -144,17 +153,21 @@ def speculative_sample(key, p_logits, drafts, temps, q_logits=None,
     return out, acc
 
 
-def unpack_prefill(packed, w, chunked=False):
-    extra = 1 if chunked else 0
+def unpack_prefill(packed, w, chunked=False, adapters=False):
+    extra = (1 if chunked else 0) + (1 if adapters else 0)
     lb = packed.shape[1] - (w + 3 + extra)
     tokens = packed[:, :lb]
     lengths = packed[:, lb]
     rows = packed[:, lb + 1:lb + 1 + w]
     offsets = packed[:, lb + 1 + w] if chunked else None
+    # adapter-pool slot id per row, between offsets and temps (0 = base;
+    # padding rows pack 0, whose delta is exactly zero — ops/lora.py)
+    sel = (packed[:, lb + 1 + w + (1 if chunked else 0)]
+           if adapters else None)
     temps = jax.lax.bitcast_convert_type(
         packed[:, lb + 1 + w + extra], jnp.float32)
     step = packed[0, lb + 2 + w + extra]
-    return tokens, lengths, rows, offsets, temps, step
+    return tokens, lengths, rows, offsets, temps, step, sel
 
 
 @dataclass
@@ -184,6 +197,7 @@ def build_programs(
     cache_len: int = 0,
     prefill_attn_fn: Any = None,
     draft: Any = None,
+    adapters: bool = False,
 ) -> Programs:
     """``draft`` (slot layout + spec only) is a ``(family, cfg)`` pair for a
     DRAFT MODEL: instead of prompt-lookup, each spec round runs
@@ -195,6 +209,23 @@ def build_programs(
     history writes too). Verification is unchanged, so outputs stay
     bit-identical to plain greedy decode regardless of draft quality — the
     draft only moves the acceptance rate."""
+    if adapters and not getattr(family, "SUPPORTS_ADAPTERS", False):
+        raise ValueError(
+            f"model family {family.__name__!r} has no adapter support "
+            "(SUPPORTS_ADAPTERS); disable ADAPTER_* or use a family whose "
+            "serving entry points accept the adapters kwarg")
+
+    # With ``adapters`` on, every program takes a trailing ``ad = (a, b,
+    # scale)`` — the device adapter-pool arrays (adapters.AdapterPool),
+    # DYNAMIC jit args like ``params`` so uploads/evictions/hot-swap never
+    # recompile — and the packed layouts grow the per-lane pool slot id
+    # ``sel``: one prefill column before temps, and row [5] of the
+    # decode/spec packs (the block table moves to [6:]). With it off
+    # (default), layouts, signatures, and traces are EXACTLY the
+    # pre-adapter ones — the adapter_id=None bit-exactness contract.
+    def _akw(sel, ad):
+        return {"adapters": (sel, ad[0], ad[1], ad[2])} if adapters else {}
+
     ts = (top_k, top_p)
     Wp = pages_per_slot
     # paged + spec adds one trailing slot-id column after the block-table
@@ -235,25 +266,28 @@ def build_programs(
             return hist.at[srows, base + lengths].set(toks, mode="drop")
 
         @partial(jax.jit, donate_argnums=(2,))
-        def _prefill_sample(params, base_key, cache, packed):
+        def _prefill_sample(params, base_key, cache, packed, ad=None):
             kv, hist = _split(cache)
-            tokens, lengths, rows, _, temps, step = unpack_prefill(packed, W)
+            tokens, lengths, rows, _, temps, step, sel = unpack_prefill(
+                packed, W, adapters=adapters)
             key = jax.random.fold_in(base_key, step)
             logits, kv = family.prefill_paged(
-                cfg, params, tokens, lengths, kv, rows[:, :Wp], **pf)
+                cfg, params, tokens, lengths, kv, rows[:, :Wp], **pf,
+                **_akw(sel, ad))
             toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
             if tuple_cache:
                 hist = _seed_hist(hist, rows[:, Wp], tokens, lengths, toks)
             return toks, _join(kv, hist)
 
         @partial(jax.jit, donate_argnums=(2,))
-        def _chunk_prefill(params, base_key, cache, packed):
+        def _chunk_prefill(params, base_key, cache, packed, ad=None):
             kv, hist = _split(cache)
-            tokens, lengths, rows, offsets, temps, step = unpack_prefill(
-                packed, W, chunked=True)
+            tokens, lengths, rows, offsets, temps, step, sel = unpack_prefill(
+                packed, W, chunked=True, adapters=adapters)
             key = jax.random.fold_in(base_key, step)
             logits, kv = family.prefill_paged(
-                cfg, params, tokens, lengths, kv, rows[:, :Wp], offsets
+                cfg, params, tokens, lengths, kv, rows[:, :Wp], offsets,
+                **_akw(sel, ad)
             )
             toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
             if tuple_cache:
@@ -264,17 +298,20 @@ def build_programs(
         chunk_prefill = _chunk_prefill
 
         @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
-        def _decode_chunk(params, base_key, cache, steps, packed, prev_last):
+        def _decode_chunk(params, base_key, cache, steps, packed, prev_last,
+                          ad=None):
             kv, hist = _split(cache)
             tokens = jnp.where(packed[4] != 0, packed[0], prev_last)
             positions = packed[1]
             temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
             key = jax.random.fold_in(base_key, packed[3, 0])
-            table = packed[5:].T
+            sel = packed[5] if adapters else None
+            table = packed[6:].T if adapters else packed[5:].T
 
             def body(carry, _):
                 toks, pos, kv, key = carry
-                logits, kv = family.decode_step_paged(cfg, params, toks, pos, kv, table)
+                logits, kv = family.decode_step_paged(
+                    cfg, params, toks, pos, kv, table, **_akw(sel, ad))
                 key, sub = jax.random.split(key)
                 nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
                 return (nxt, pos + 1, kv, key), nxt
@@ -289,7 +326,8 @@ def build_programs(
             Hcap = Wp * page_size  # logical per-slot capacity
 
             @partial(jax.jit, static_argnums=(3,), donate_argnums=(2, 5))
-            def _spec_chunk(params, base_key, cache, steps, packed, carry):
+            def _spec_chunk(params, base_key, cache, steps, packed, carry,
+                            ad=None):
                 kv, hist0 = cache
                 n_l = packed.shape[1]
                 use_host = packed[2] != 0
@@ -297,7 +335,8 @@ def build_programs(
                 hlen0 = jnp.where(use_host, packed[1], carry[1])
                 temps = jax.lax.bitcast_convert_type(packed[3], jnp.float32)
                 key0 = jax.random.fold_in(base_key, packed[4, 0])
-                table = packed[5:].T            # [n, Wp]
+                sel = packed[5] if adapters else None
+                table = (packed[6:] if adapters else packed[5:]).T  # [n, Wp]
                 idx = jnp.arange(Hcap)
 
                 def outer(loop, _):
@@ -313,7 +352,7 @@ def build_programs(
                     drafts = jnp.take_along_axis(hist, take, axis=1)
                     seq = jnp.concatenate([tok[:, None], drafts], axis=1)
                     logits, kv = family.verify_step_paged(
-                        cfg, params, seq, pos, kv, table)
+                        cfg, params, seq, pos, kv, table, **_akw(sel, ad))
                     out, acc = speculative_sample(ks, logits, drafts, temps,
                                                   None, ts[0], ts[1])
                     nxt = jnp.take_along_axis(out, acc[:, None], axis=1)[:, 0]
@@ -373,12 +412,14 @@ def build_programs(
             return aux
 
         @partial(jax.jit, donate_argnums=(2,))
-        def _prefill_sample(params, base_key, cache, packed):
+        def _prefill_sample(params, base_key, cache, packed, ad=None):
             kv, aux = _split(cache)
-            tokens, lengths, rows, _, temps, step = unpack_prefill(packed, W)
+            tokens, lengths, rows, _, temps, step, sel = unpack_prefill(
+                packed, W, adapters=adapters)
             key = jax.random.fold_in(base_key, step)
             logits, kv = family.prefill(
-                cfg, _tparams(params), tokens, lengths, kv, rows[:, 0], **pf)
+                cfg, _tparams(params), tokens, lengths, kv, rows[:, 0], **pf,
+                **_akw(sel, ad))
             toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
             if tuple_cache:
                 aux = _seed_aux(params, aux, rows[:, 0], tokens, lengths, toks)
@@ -386,13 +427,14 @@ def build_programs(
 
         if getattr(family, "SLOT_CHUNKED_PREFILL", False):
             @partial(jax.jit, donate_argnums=(2,))
-            def _chunk_prefill(params, base_key, cache, packed):
+            def _chunk_prefill(params, base_key, cache, packed, ad=None):
                 kv, aux = _split(cache)
-                tokens, lengths, rows, offsets, temps, step = unpack_prefill(
-                    packed, W, chunked=True)
+                tokens, lengths, rows, offsets, temps, step, sel = unpack_prefill(
+                    packed, W, chunked=True, adapters=adapters)
                 key = jax.random.fold_in(base_key, step)
                 logits, kv = family.prefill(
-                    cfg, _tparams(params), tokens, lengths, kv, rows[:, 0], offsets
+                    cfg, _tparams(params), tokens, lengths, kv, rows[:, 0],
+                    offsets, **_akw(sel, ad)
                 )
                 toks = sample_token(logits, key, temperature=temps, top_k=ts[0], top_p=ts[1])
                 if tuple_cache:
@@ -403,16 +445,19 @@ def build_programs(
             chunk_prefill = _chunk_prefill
 
         @partial(jax.jit, static_argnums=(3,), donate_argnums=(2,))
-        def _decode_chunk(params, base_key, cache, steps, packed, prev_last):
+        def _decode_chunk(params, base_key, cache, steps, packed, prev_last,
+                          ad=None):
             kv, aux = _split(cache)
             tokens = jnp.where(packed[4] != 0, packed[0], prev_last)
             positions = packed[1]
             temps = jax.lax.bitcast_convert_type(packed[2], jnp.float32)
             key = jax.random.fold_in(base_key, packed[3, 0])
+            sel = packed[5] if adapters else None
 
             def body(carry, _):
                 toks, pos, kv, key = carry
-                logits, kv = family.decode_step(cfg, _tparams(params), toks, pos, kv)
+                logits, kv = family.decode_step(
+                    cfg, _tparams(params), toks, pos, kv, **_akw(sel, ad))
                 key, sub = jax.random.split(key)
                 nxt = sample_token(logits, sub, temperature=temps, top_k=ts[0], top_p=ts[1])
                 return (nxt, pos + 1, kv, key), nxt
@@ -427,7 +472,8 @@ def build_programs(
             H = cache_len
 
             @partial(jax.jit, static_argnums=(3,), donate_argnums=(2, 5))
-            def _spec_chunk(params, base_key, cache, steps, packed, carry):
+            def _spec_chunk(params, base_key, cache, steps, packed, carry,
+                            ad=None):
                 kv, aux0 = cache
                 n_l = packed.shape[1]
                 use_host = packed[2] != 0
@@ -435,6 +481,7 @@ def build_programs(
                 hlen0 = jnp.where(use_host, packed[1], carry[1])
                 temps = jax.lax.bitcast_convert_type(packed[3], jnp.float32)
                 key0 = jax.random.fold_in(base_key, packed[4, 0])
+                sel = packed[5] if adapters else None
                 idx = jnp.arange(H)
 
                 def outer(loop, _):
@@ -476,7 +523,8 @@ def build_programs(
                         drafts = drafts_t[:g].T            # [n, g]
                         q_logits = dlogits_t[:g].swapaxes(0, 1)  # [n, g, V]
                     seq = jnp.concatenate([tok[:, None], drafts], axis=1)
-                    logits, kv = family.verify_step(cfg, _tparams(params), seq, pos, kv)
+                    logits, kv = family.verify_step(
+                        cfg, _tparams(params), seq, pos, kv, **_akw(sel, ad))
                     out, acc = speculative_sample(ks, logits, drafts, temps,
                                                   q_logits, ts[0], ts[1])
                     nxt = jnp.take_along_axis(out, acc[:, None], axis=1)[:, 0]

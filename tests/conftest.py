@@ -16,6 +16,22 @@ from jaxpin import pin_cpu  # noqa: E402
 
 pin_cpu(8)
 
+import jax  # noqa: E402
+
+# Persistent XLA compile cache: the suite builds dozens of engines whose
+# tiny-config programs compile identically across test modules (and the
+# fleet/lockstep drills recompile them again in subprocesses). Caching the
+# compiled executables on disk dedups those repeats — including within a
+# single cold run, since each GenerateEngine re-jits its own function
+# objects — which is what keeps tier-1 inside its wall-clock budget on
+# 1–2 vCPU CI hosts. Semantically neutral: a cache miss just compiles.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".cache", "jax"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 import pytest  # noqa: E402
 
 

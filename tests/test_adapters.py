@@ -1,0 +1,521 @@
+"""Multi-LoRA adapter multiplexing + live weight hot-swap (tentpole).
+
+The load-bearing contracts, in order of how expensive they'd be to get
+wrong in production:
+
+1. ``adapter_id=None`` is TOKEN-EXACT against a pre-adapter engine — on
+   both KV layouts and with spec decode on and off. Slot 0 of the device
+   pool is the reserved all-zeros base adapter, so the base lane's logits
+   delta is exactly 0.0 (ops/lora.py), not merely small.
+2. A mixed-adapter batch is token-exact per request against each adapter
+   served in isolation: the lm_head LoRA gather is lane-independent, so
+   co-batching ≥3 adapters changes scheduling, never tokens.
+3. The live hot-swap drill: adopt a full replacement weight tree under
+   in-flight traffic with ZERO dropped or mis-answered requests, a
+   strictly bumped weights epoch, and a strictly bumped router-gossip
+   epoch (fleet.epoch_of).
+4. Adapter-pool eviction under load never corrupts the KV page pool
+   (assert_page_refs_consistent) — the two refcounted pools are disjoint.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.adapters import (
+    AdapterPool,
+    AdapterRegistry,
+    AdapterSpec,
+    random_adapter,
+)
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.http.errors import TooManyRequests
+from gofr_tpu.models import LlamaConfig, llama
+from gofr_tpu.testutil import (
+    assert_page_refs_consistent,
+    assert_paged_pool_consistent,
+)
+from gofr_tpu.tpu.engine import GenerateEngine
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(7))
+    return cfg, params
+
+
+def make_engine(cfg, params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("max_prefill_batch", 2)
+    return GenerateEngine(llama, cfg, params, new_mock_container(), **kw)
+
+
+def adapters_for(cfg, n=3):
+    return [random_adapter(f"ad{i}", cfg.hidden_size, cfg.vocab_size,
+                           rank=2 + 2 * i, seed=10 + i)
+            for i in range(n)]
+
+
+PROMPTS = [[1, 2, 3, 4], [9, 8, 7], [5, 5, 5, 5, 5], [2, 4, 6, 8, 10, 12]]
+
+
+# -- host/device tier units ----------------------------------------------------
+
+
+class TestRegistryAndPool:
+    def test_register_get_unregister_digest(self, setup):
+        cfg, _ = setup
+        reg = AdapterRegistry(host_budget_mb=64)
+        a, b, c = adapters_for(cfg, 3)
+        for s in (a, b, c):
+            reg.register(s)
+        assert reg.names() == ["ad0", "ad1", "ad2"]
+        assert reg.get("ad1").rank == b.rank
+        # order-independent digest: same set registered in another order
+        reg2 = AdapterRegistry(host_budget_mb=64)
+        for s in (c, a, b):
+            reg2.register(s)
+        assert reg.digest() == reg2.digest()
+        reg.unregister("ad1")
+        assert reg.digest() != reg2.digest()
+        with pytest.raises(KeyError):
+            reg.get("ad1")
+
+    def test_host_budget_never_evicts(self, setup):
+        cfg, _ = setup
+        reg = AdapterRegistry(host_budget_mb=0.001)  # ~1 KiB
+        with pytest.raises(ValueError, match="ADAPTER_HOST_MB"):
+            reg.register(adapters_for(cfg, 1)[0])
+        assert reg.names() == []
+
+    def test_per_adapter_concurrency_cap(self, setup):
+        cfg, _ = setup
+        reg = AdapterRegistry()
+        spec = random_adapter("capped", cfg.hidden_size, cfg.vocab_size,
+                              max_concurrency=2)
+        reg.register(spec)
+        reg.admit("capped")
+        reg.admit("capped")
+        with pytest.raises(TooManyRequests):
+            reg.admit("capped")
+        reg.release("capped")
+        reg.admit("capped")  # a release frees a share
+
+    def test_pool_refcounted_lru(self, setup):
+        cfg, _ = setup
+        specs = adapters_for(cfg, 3)
+        pool = AdapterPool(3, cfg.hidden_size, cfg.vocab_size, rank=8)
+        s0 = pool.acquire(specs[0])
+        s1 = pool.acquire(specs[1])
+        assert s0 != s1 and 0 not in (s0, s1)  # slot 0 = reserved base
+        assert pool.acquire(specs[0]) == s0    # resident hit, refcount 2
+        # both referenced, 3 slots = base + 2 -> third adapter must wait
+        assert pool.acquire(specs[2]) is None
+        pool.release(s1)
+        s2 = pool.acquire(specs[2])            # evicts the unreferenced LRU
+        assert s2 == s1
+        assert pool.evictions == 1
+        pool.release(s0)
+        pool.release(s0)
+        pool.release(s2)
+
+    def test_slots_for_budget(self, setup):
+        cfg, _ = setup
+        per = 4 * (cfg.hidden_size * 8 + 8 * cfg.vocab_size)
+        n = AdapterPool.slots_for_budget(per * 5 / (1 << 20),
+                                         cfg.hidden_size, cfg.vocab_size, 8)
+        assert n == 5
+        # floor of 2: slot 0 (base) + at least one real adapter
+        assert AdapterPool.slots_for_budget(0.0000001, cfg.hidden_size,
+                                            cfg.vocab_size, 8) == 2
+
+    def test_zero_padded_rank_upload_exact(self, setup):
+        """A rank-r adapter in a rank-R pool (r < R) computes the exact
+        rank-r delta: the padded tail rows/cols are zero."""
+        cfg, _ = setup
+        from gofr_tpu.ops.lora import lora_logits_delta
+        import jax.numpy as jnp
+
+        spec = adapters_for(cfg, 1)[0]  # rank 2
+        pool = AdapterPool(2, cfg.hidden_size, cfg.vocab_size, rank=8)
+        slot = pool.acquire(spec)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (3, cfg.hidden_size)), jnp.float32)
+        sel = jnp.asarray([slot] * 3, jnp.int32)
+        got = np.asarray(lora_logits_delta(
+            x, (sel, pool.a, pool.b, pool.scale)))
+        want = (np.asarray(x) @ spec.a @ spec.b) * spec.scale
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+        # and the base slot's delta is EXACTLY zero, not epsilon
+        base = np.asarray(lora_logits_delta(
+            x, (jnp.zeros((3,), jnp.int32), pool.a, pool.b, pool.scale)))
+        assert not base.any()
+
+
+# -- bit-exactness of the adapter_id=None path ---------------------------------
+
+
+@pytest.fixture(scope="module")
+def base_tokens(setup):
+    """Reference tokens from PRE-adapter engines, one per KV layout.
+    Spec decode is token-exact vs non-spec by its own contract
+    (tests/test_spec_decode.py), so the non-spec reference also judges
+    the spec-enabled adapter engines below."""
+    cfg, params = setup
+    out = {}
+    for layout, kw in (("slot", dict(kv_layout="slot")),
+                       ("paged", dict(kv_layout="paged", page_size=8))):
+        ref_eng = make_engine(cfg, params, **kw)
+        ref_eng.start()
+        try:
+            out[layout] = [ref_eng.generate(p, max_new_tokens=8)["tokens"]
+                           for p in PROMPTS]
+        finally:
+            ref_eng.stop()
+    return out
+
+
+class TestBaseExactness:
+    @pytest.mark.parametrize("kw", [
+        dict(kv_layout="slot"),
+        dict(kv_layout="paged", page_size=8),
+        dict(kv_layout="slot", spec_tokens=2, decode_chunk=2),
+        dict(kv_layout="paged", page_size=8, spec_tokens=2, decode_chunk=2),
+    ], ids=["slot", "paged", "slot-spec", "paged-spec"])
+    def test_none_lane_token_exact(self, setup, base_tokens, kw):
+        """adapter_id=None through an adapter-enabled engine produces the
+        exact tokens of a pre-adapter engine — both layouts, spec on/off."""
+        cfg, params = setup
+        want = base_tokens[kw["kv_layout"]]
+
+        eng = make_engine(cfg, params, adapter_slots=3, adapter_rank=8, **kw)
+        eng.start()
+        try:
+            # a registered (and exercised) adapter must not disturb base lanes
+            eng.register_adapter(adapters_for(cfg, 1)[0])
+            eng.generate(PROMPTS[0], max_new_tokens=4, adapter_id="ad0")
+            got = [eng.generate(p, max_new_tokens=8)["tokens"]
+                   for p in PROMPTS]
+        finally:
+            eng.stop()
+        assert got == want
+
+    def test_unknown_adapter_rejected_at_submit(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params, adapter_slots=2)
+        eng.start()
+        try:
+            with pytest.raises(ValueError, match="unknown adapter"):
+                eng.generate(PROMPTS[0], max_new_tokens=4, adapter_id="nope")
+        finally:
+            eng.stop()
+
+    def test_adapter_without_plane_rejected(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params)
+        eng.start()
+        try:
+            with pytest.raises(ValueError, match="adapter plane"):
+                eng.generate(PROMPTS[0], max_new_tokens=4, adapter_id="x")
+        finally:
+            eng.stop()
+
+
+# -- batched mixed-adapter decode ----------------------------------------------
+
+
+class TestMixedBatch:
+    @pytest.mark.parametrize("kw", [
+        dict(kv_layout="slot"),
+        dict(kv_layout="paged", page_size=8),
+    ], ids=["slot", "paged"])
+    def test_mixed_batch_matches_isolation(self, setup, kw):
+        """≥3 adapters co-batched in one engine: every request's tokens
+        equal the same request served on an engine holding only its
+        adapter. One device call serves many adapters, token-exactly."""
+        cfg, params = setup
+        specs = adapters_for(cfg, 3)
+        jobs = [(p, specs[i % 3].name) for i, p in enumerate(PROMPTS * 2)]
+
+        # isolation arm: ONE engine, one adapter registered at a time —
+        # each request is served with no other adapter in the batch
+        isolated = {}
+        eng = make_engine(cfg, params, adapter_slots=2, adapter_rank=8, **kw)
+        eng.start()
+        try:
+            for spec in specs:
+                eng.register_adapter(spec)
+                for p, name in jobs:
+                    if name == spec.name:
+                        isolated[(tuple(p), name)] = eng.generate(
+                            p, max_new_tokens=8, adapter_id=name)["tokens"]
+                eng.unregister_adapter(spec.name)
+        finally:
+            eng.stop()
+
+        eng = make_engine(cfg, params, adapter_slots=4, adapter_rank=8, **kw)
+        eng.start()
+        try:
+            for spec in specs:
+                eng.register_adapter(spec)
+            reqs = [eng.submit(p, max_new_tokens=8, adapter_id=name)
+                    for p, name in jobs]
+            got = [r.result(60.0)["tokens"] for r in reqs]
+        finally:
+            eng.stop()
+        for (p, name), tokens in zip(jobs, got):
+            assert tokens == isolated[(tuple(p), name)], (p, name)
+        # distinct adapters actually produce distinct streams on the
+        # shared prompt (the multiplexing isn't vacuously the base model)
+        by_adapter = {name: tokens for (p, name), tokens
+                      in zip(jobs, got) if p == PROMPTS[0]}
+        assert len(set(map(tuple, by_adapter.values()))) > 1 or len(by_adapter) <= 1
+
+    def test_pool_exhaustion_requeues_not_fails(self, setup):
+        """More simultaneous adapters than device pool slots: the surplus
+        request WAITS for a slot (like KV page exhaustion) and completes
+        once one frees — never an error, never the wrong adapter."""
+        cfg, params = setup
+        specs = adapters_for(cfg, 3)
+        # pool of 3 = base + 2 real: the third adapter must wait its turn
+        eng = make_engine(cfg, params, adapter_slots=3, adapter_rank=8)
+        eng.start()
+        try:
+            for spec in specs:
+                eng.register_adapter(spec)
+            reqs = [eng.submit(PROMPTS[i % len(PROMPTS)], max_new_tokens=6,
+                               adapter_id=specs[i % 3].name)
+                    for i in range(9)]
+            outs = [r.result(60.0) for r in reqs]
+            assert all(o["finish_reason"] == "length" for o in outs)
+            stats = eng.adapter_stats()
+            assert stats["pool"]["evictions"] >= 1  # slots actually cycled
+        finally:
+            eng.stop()
+
+
+# -- per-adapter attribution ---------------------------------------------------
+
+
+class TestAttribution:
+    def test_flight_recorder_carries_adapter_and_epoch(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params, adapter_slots=2, adapter_rank=8)
+        eng.start()
+        try:
+            eng.register_adapter(adapters_for(cfg, 1)[0])
+            eng.generate(PROMPTS[0], max_new_tokens=4, adapter_id="ad0")
+            eng.generate(PROMPTS[1], max_new_tokens=4)
+            entries = eng.flight.requests(limit=2)
+            by_adapter = {e.get("adapter"): e for e in entries}
+            assert "ad0" in by_adapter
+            assert by_adapter["ad0"]["weights_epoch"] == 0
+            assert by_adapter.get(None, {}).get("adapter") is None
+        finally:
+            eng.stop()
+
+    def test_perf_plane_partitions_by_adapter(self, setup):
+        """Adapter rows are an exact partition of the step totals, and
+        device-seconds accrue to the adapters that were actually served
+        (the per-tenant COGS meter)."""
+        cfg, params = setup
+        eng = make_engine(cfg, params, adapter_slots=3, adapter_rank=8)
+        if eng.perf is None:
+            pytest.skip("no perf plane on this container")
+        eng.start()
+        try:
+            eng.register_adapter(adapters_for(cfg, 1)[0])
+            eng.generate(PROMPTS[0], max_new_tokens=6, adapter_id="ad0")
+            eng.generate(PROMPTS[1], max_new_tokens=6)
+            totals = eng.perf.window_totals(time.monotonic())
+            ads = totals["adapters"]
+            assert "ad0" in ads and "base" in ads
+            assert ads["ad0"]["device_s"] > 0
+            # exact partition: adapter rows sum to the kind rows
+            for field in ("flops", "bytes", "device_s"):
+                part = sum(rec[field] for rec in ads.values())
+                whole = sum(rec[field] for rec in totals["kinds"].values())
+                assert part == pytest.approx(whole, rel=1e-9)
+        finally:
+            eng.stop()
+
+
+# -- live weight hot-swap ------------------------------------------------------
+
+
+class TestHotSwap:
+    def test_swap_is_tokenwise_real_and_reversible(self, setup):
+        cfg, params = setup
+        params2 = llama.init(cfg, jax.random.key(99))
+        eng = make_engine(cfg, params, adapter_slots=2, adapter_rank=8)
+        eng.start()
+        try:
+            base = eng.generate(PROMPTS[0], max_new_tokens=8)["tokens"]
+            assert eng.adopt_weights(params2) == 1
+            swapped = eng.generate(PROMPTS[0], max_new_tokens=8)["tokens"]
+            assert swapped != base  # genuinely new weights
+            assert eng.adopt_weights(params) == 2
+            back = eng.generate(PROMPTS[0], max_new_tokens=8)["tokens"]
+            assert back == base     # and exactly restorable
+        finally:
+            eng.stop()
+
+    def test_swap_rejects_mismatched_tree(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params)
+        bad = llama.init(LlamaConfig.tiny(num_layers=1), jax.random.key(0))
+        with pytest.raises(ValueError, match="adopt_weights"):
+            eng.adopt_weights(bad)
+        eng.stop()
+
+    def test_hot_swap_drill_zero_drop(self, setup):
+        """The acceptance drill: swap under live traffic. Every in-flight
+        and queued request completes (no drops, no errors); requests are
+        answered by exactly one weight tree or requeued whole onto the new
+        one (never mixed — asserted as: every answer is a full-length
+        generation and the engine epoch/gossip epoch strictly bumped)."""
+        from gofr_tpu.fleet import epoch_of
+
+        cfg, params = setup
+        params2 = llama.init(cfg, jax.random.key(99))
+        eng = make_engine(cfg, params, adapter_slots=3, adapter_rank=8,
+                          kv_layout="paged", page_size=8, slots=4)
+        eng.start()
+        results, errors = [], []
+        stop_feed = threading.Event()
+
+        def feeder():
+            i = 0
+            while not stop_feed.is_set():
+                p = PROMPTS[i % len(PROMPTS)]
+                try:
+                    out = eng.generate(p, max_new_tokens=6, timeout=30.0)
+                    results.append(out)
+                except Exception as e:  # noqa: BLE001 - the drill counts every failure
+                    errors.append(e)
+                i += 1
+
+        threads = [threading.Thread(target=feeder) for _ in range(3)]
+        try:
+            epoch0 = epoch_of(eng)
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # traffic in flight
+            new_epoch = eng.adopt_weights(params2, timeout_s=30.0)
+            time.sleep(0.3)  # traffic continues on the new weights
+            stop_feed.set()
+            for t in threads:
+                t.join(timeout=30.0)
+            assert not errors, errors
+            assert results
+            # zero-drop: every answer is a complete 6-token generation
+            assert all(len(r["tokens"]) == 6 for r in results)
+            assert all(r["finish_reason"] == "length" for r in results)
+            assert new_epoch == 1 and eng.weights_epoch == 1
+            # the router's gossip epoch strictly bumped with the adoption
+            assert epoch_of(eng) > epoch0
+            assert_paged_pool_consistent(eng)
+        finally:
+            stop_feed.set()
+            eng.stop()
+
+    def test_checkpoint_adoption(self, setup, tmp_path):
+        from gofr_tpu.train.checkpoint import save_params
+
+        cfg, params = setup
+        params2 = llama.init(cfg, jax.random.key(42))
+        save_params(str(tmp_path / "ckpt"), params2)
+        eng = make_engine(cfg, params)
+        eng.start()
+        try:
+            direct = None
+            eng.adopt_weights(params2)
+            direct = eng.generate(PROMPTS[0], max_new_tokens=8)["tokens"]
+            eng.adopt_weights(params)
+            eng.adopt_checkpoint(str(tmp_path / "ckpt"))
+            via_ckpt = eng.generate(PROMPTS[0], max_new_tokens=8)["tokens"]
+            assert via_ckpt == direct
+        finally:
+            eng.stop()
+
+    def test_lockstep_rejects_hot_swap(self, setup):
+        cfg, params = setup
+        eng = GenerateEngine(llama, cfg, params, new_mock_container(),
+                             slots=2, max_len=64, lockstep_role="leader")
+        with pytest.raises(RuntimeError, match="lockstep"):
+            eng.adopt_weights(params)
+
+
+# -- adapter cache eviction vs KV page pool ------------------------------------
+
+
+class TestEvictionDrill:
+    def test_page_refs_consistent_after_adapter_churn(self, setup):
+        """Adapter-pool eviction under paged load: cycling many adapters
+        through a tiny device pool churns uploads/evictions while KV pages
+        allocate and free — the two refcounted pools must stay disjoint
+        and the page pool exactly consistent afterwards."""
+        cfg, params = setup
+        specs = adapters_for(cfg, 5)
+        eng = make_engine(cfg, params, adapter_slots=3, adapter_rank=12,
+                          kv_layout="paged", page_size=8, slots=4)
+        eng.start()
+        try:
+            for spec in specs:
+                eng.register_adapter(spec)
+            reqs = [eng.submit(PROMPTS[i % len(PROMPTS)], max_new_tokens=5,
+                               adapter_id=specs[i % 5].name)
+                    for i in range(15)]
+            for r in reqs:
+                assert r.result(60.0)["finish_reason"] == "length"
+            stats = eng.adapter_stats()
+            assert stats["pool"]["evictions"] >= 1
+            assert_page_refs_consistent(eng)
+            assert_paged_pool_consistent(eng)
+            # all pool references drained with the traffic
+            assert stats["pool"]["referenced"] == 0
+        finally:
+            eng.stop()
+
+
+# -- config / build_engine wiring ----------------------------------------------
+
+
+class TestBuildWiring:
+    def test_adapter_pool_mb_derives_slots(self, setup):
+        cfg, params = setup
+        per = 4 * (cfg.hidden_size * 16 + 16 * cfg.vocab_size)
+        eng = make_engine(cfg, params,
+                          adapter_pool_mb=per * 4 / (1 << 20))
+        try:
+            assert eng._adapters_enabled
+            assert eng._adapter_pool.slots == 4
+        finally:
+            eng.stop()
+
+    def test_lockstep_disables_adapter_plane(self, setup):
+        cfg, params = setup
+        container = new_mock_container()
+        eng = GenerateEngine(llama, cfg, params, container, slots=2,
+                             max_len=64, adapter_slots=4,
+                             lockstep_role="leader")
+        assert not eng._adapters_enabled
+        assert any("ADAPTER_* ignored under lockstep" in line
+                   for line in container.logger.lines)
+
+    def test_rank_above_pool_rejected(self, setup):
+        cfg, params = setup
+        eng = make_engine(cfg, params, adapter_slots=2, adapter_rank=4)
+        try:
+            with pytest.raises(ValueError, match="rank"):
+                eng.register_adapter(random_adapter(
+                    "big", cfg.hidden_size, cfg.vocab_size, rank=8))
+        finally:
+            eng.stop()

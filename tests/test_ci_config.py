@@ -478,3 +478,28 @@ def test_every_pytest_job_installs_what_collection_imports():
             f"{missing} (map import->dist in tests/test_ci_config.py DIST)"
         )
     assert checked >= 3, f"expected >=3 pytest jobs in ci.yml, found {checked}"
+
+
+def test_adapter_suite_is_in_quick_tier():
+    """PR 16 satellite: the multi-LoRA multiplexing suite — registry/pool
+    units, adapter_id=None token-exactness on both KV layouts with spec
+    on and off, the mixed-adapter-batch-vs-isolation drill, per-adapter
+    perf attribution, the zero-drop live hot-swap drill, and the
+    adapter-cache eviction consistency check — runs on the CPU mesh and
+    must ride the `-m quick` CI job on every push."""
+    path = REPO / "tests" / "test_adapters.py"
+    assert path.exists(), "tests/test_adapters.py missing"
+    text = path.read_text()
+    assert "pytestmark = pytest.mark.quick" in text, (
+        "test_adapters.py must be quick-marked module-wide"
+    )
+    assert "test_adapters.py" not in QUICK_EXEMPT, (
+        "test_adapters.py must not be exempted from the quick tier"
+    )
+    # the tentpole's acceptance pieces are all covered: base-lane
+    # exactness, mixed-batch isolation equivalence, the hot-swap drill,
+    # and the eviction-vs-page-pool consistency check
+    assert "token_exact" in text and "isolation" in text
+    assert "adopt_weights" in text and "zero_drop" in text
+    assert "assert_page_refs_consistent" in text
+    assert "epoch_of" in text  # the router-gossip epoch bump is asserted

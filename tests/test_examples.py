@@ -524,3 +524,46 @@ def test_redis_example():
             assert health["services"]["redis"]["status"] == "UP"
     finally:
         srv.close()
+
+
+def test_using_adapters_example():
+    """Adapter multiplexing example: base and adapter requests co-serve
+    on one engine — the base answer is unchanged by adapter traffic, the
+    X-Adapter-ID header spells the same routing input as the body field,
+    and the per-adapter perf meter shows up on /metrics."""
+    app = load_example("using-adapters").build_app()
+    eng = app.container.engine("lm")
+    assert eng._adapters_enabled  # ADAPTER_SLOTS=4 from configs/.env
+    with AppHarness(app) as h, httpx.Client(base_url=h.base, timeout=300) as c:
+        base = c.post("/generate", json={"prompt": [1, 2, 3],
+                                         "max_new_tokens": 6})
+        assert base.status_code == 201, base.text
+        fr = c.post("/generate", json={"prompt": [1, 2, 3],
+                                       "max_new_tokens": 6,
+                                       "adapter_id": "fr"})
+        assert fr.status_code == 201, fr.text
+        # header spelling reaches the same adapter as the body field
+        fr_hdr = c.post("/generate", json={"prompt": [1, 2, 3],
+                                           "max_new_tokens": 6},
+                        headers={"X-Adapter-ID": "fr"})
+        assert fr_hdr.status_code == 201, fr_hdr.text
+        assert fr_hdr.json()["data"]["tokens"] == fr.json()["data"]["tokens"]
+        # base lanes are unperturbed by the adapter traffic around them
+        base2 = c.post("/generate", json={"prompt": [1, 2, 3],
+                                          "max_new_tokens": 6})
+        assert base2.json()["data"]["tokens"] == base.json()["data"]["tokens"]
+        # an unknown adapter is a 400 client error, not an engine wedge
+        bad = c.post("/generate", json={"prompt": [1, 2, 3],
+                                        "max_new_tokens": 4,
+                                        "adapter_id": "nope"})
+        assert bad.status_code == 400, bad.text
+        # ...and the engine still serves afterwards
+        again = c.post("/generate", json={"prompt": [1, 2, 3],
+                                          "max_new_tokens": 6})
+        assert again.status_code == 201
+        stats = c.get("/adapters").json()["data"]
+        assert stats["enabled"] and stats["registry"]["registered"] == 2
+        assert stats["pool"]["resident"] >= 1  # "fr" was uploaded on use
+        m = httpx.get(f"http://127.0.0.1:{app.metrics_port}/metrics").text
+        assert "app_tpu_adapters_registered" in m
+        assert "app_tpu_adapter_device_seconds" in m

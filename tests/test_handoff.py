@@ -358,3 +358,105 @@ class TestRouterRoleAwareness:
         assert 'app_fleet_replica_up{replica="r0"} 1' in text
         assert ('app_fleet_replica_up{replica="d0",role="decode"} 1' in text
                 or 'app_fleet_replica_up{role="decode",replica="d0"} 1' in text)
+
+
+class TestAdapterEraJoinGates:
+    """PR 16 satellite: the JOIN hello now carries the adapter-set digest
+    and the base-weight epoch. Mismatches are rejected BEFORE any page
+    frame moves, with a distinct ACK code and a precise error both sides;
+    a pre-adapter peer (hello without the fields) still joins."""
+
+    def test_join_rejects_mismatched_adapter_set(self, setup):
+        from gofr_tpu.adapters import random_adapter
+
+        cfg, params = setup
+        dec = make_engine(cfg, params, role="decode",
+                          adapter_slots=2, adapter_rank=8)
+        dec.register_adapter(random_adapter(
+            "fr", cfg.hidden_size, cfg.vocab_size, rank=4, seed=1))
+        # prefill side has the plane but NOT the adapter: digests differ
+        pre = make_engine(cfg, params, role="prefill",
+                          adapter_slots=2, adapter_rank=8,
+                          handoff_target=dec.handoff_addr,
+                          handoff_timeout_s=1.0)
+        try:
+            assert pre.adapters_digest() != dec.adapters_digest()
+            with pytest.raises(DeadlineExceeded, match="handoff"):
+                pre.generate(PROMPT, max_new_tokens=4, timeout=300)
+            assert pre._handoff_exporter.stats()["failed"] == 1
+            assert dec._handoff_server.stats()["imported"] == 0
+            assert dec._handoff_server.stats()["rejected"] >= 1
+            assert any("adapter set" in line
+                       for line in dec.container.logger.lines)
+            assert_page_refs_consistent(pre)
+            assert_page_refs_consistent(dec)
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_join_rejects_mismatched_weights_epoch(self, setup):
+        cfg, params = setup
+        dec = make_engine(cfg, params, role="decode")
+        # a live hot-swap landed on the decode side only: same weights,
+        # bumped epoch — pages from the stale prefill worker must bounce
+        assert dec.adopt_weights(params) == 1
+        pre = make_engine(cfg, params, role="prefill",
+                          handoff_target=dec.handoff_addr,
+                          handoff_timeout_s=1.0)
+        try:
+            with pytest.raises(DeadlineExceeded, match="handoff"):
+                pre.generate(PROMPT, max_new_tokens=4, timeout=300)
+            assert pre._handoff_exporter.stats()["failed"] == 1
+            assert dec._handoff_server.stats()["imported"] == 0
+            assert dec._handoff_server.stats()["rejected"] >= 1
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_epoch_realignment_restores_the_path(self, setup):
+        """After the SAME hot-swap lands on the prefill side too, the
+        disagg path works again — the gate is about agreement, not age."""
+        cfg, params = setup
+        dec = make_engine(cfg, params, role="decode")
+        dec.adopt_weights(params)
+        pre = make_engine(cfg, params, role="prefill",
+                          handoff_target=dec.handoff_addr)
+        try:
+            pre.adopt_weights(params)  # both at epoch 1 now
+            res = pre.generate(PROMPT, max_new_tokens=4, timeout=300)
+            assert res["finish_reason"] == "handoff"
+            assert dec._handoff_server.stats()["imported"] == 1
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_pre_adapter_hello_is_wildcard(self, setup):
+        """A rolling upgrade straggler that sends neither field gates on
+        neither: the decode worker ACKs OK even with adapters loaded."""
+        import json as _json
+
+        from gofr_tpu.adapters import random_adapter
+
+        cfg, params = setup
+        dec = make_engine(cfg, params, role="decode",
+                          adapter_slots=2, adapter_rank=8)
+        dec.register_adapter(random_adapter(
+            "fr", cfg.hidden_size, cfg.vocab_size, rank=4, seed=1))
+        try:
+            host, port = dec.handoff_addr.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=5.0)
+            try:
+                hello = _json.dumps(
+                    {"kv_dtype": handoff.engine_kv_dtype(dec)}).encode()
+                s.sendall(handoff._MAGIC
+                          + handoff._I32.pack(len(hello)) + hello)
+                buf = b""
+                while len(buf) < 4:
+                    buf += s.recv(4 - len(buf))
+                (status,) = handoff._I32.unpack(buf)
+                assert status == handoff.ACK_OK
+            finally:
+                s.close()
+            assert dec._handoff_server.stats().get("rejected", 0) == 0
+        finally:
+            dec.stop()

@@ -443,3 +443,97 @@ class TestLiveEngine:
         assert c.metrics.get("app_tpu_spec_pages_trimmed_total") is not None
         assert c.metrics.get("app_tpu_spec_tokens_rejected_total") is not None
         assert c.metrics.get("app_tpu_step_device_seconds") is not None
+
+
+# -- per-adapter attribution (multi-LoRA multiplexing) -------------------------
+
+
+def _part_ad(adapters, key="decode|bf16", flops=0.0, bytes_=0.0, device_s=0.0):
+    """A replica totals payload whose adapter rows are given directly."""
+    return {"v": 1, "window_s": 60.0,
+            "kinds": {key: {"flops": flops, "bytes": bytes_,
+                            "device_s": device_s, "steps": 1.0,
+                            "flops_cap": 0.0, "bytes_cap": 0.0}},
+            "adapters": {aid: dict(rec) for aid, rec in adapters.items()},
+            "bubble": {"bubble_s": 0.0, "busy_s": 1.0}}
+
+
+class TestAdapterAttribution:
+    def test_note_adapters_is_an_exact_partition(self):
+        """Adapter rows partition each step: summed over adapters they
+        equal the step's own flops/bytes/device_s — the invariant that
+        keeps per-tenant COGS (device_s per adapter) sum-of-parts."""
+        p = _plane()
+        s = p.step_decode(4, 8, 16, t0=100.0)
+        s.t_ready = 100.5
+        p.note(s, 100.5)
+        p.note_adapters(["fr", "fr", None, "de"], s, 100.5)
+        tot = p.window_totals(100.5)
+        ads = tot["adapters"]
+        assert set(ads) == {"fr", "de", "base"}
+        for field in ("flops", "bytes", "device_s"):
+            whole = sum(rec[field] for rec in tot["kinds"].values())
+            part = sum(rec[field] for rec in ads.values())
+            assert part == pytest.approx(whole, rel=1e-12)
+        # proportional by lane count: fr had 2 of 4 lanes
+        assert ads["fr"]["device_s"] == pytest.approx(s.device_s * 0.5)
+        assert ads["base"]["device_s"] == pytest.approx(s.device_s * 0.25)
+
+    def test_adapter_rows_never_leak_into_kinds(self):
+        p = _plane()
+        s = p.step_decode(1, 1, 4, t0=100.0)
+        s.t_ready = 100.2
+        p.note(s, 100.2)
+        p.note_adapters(["solo"], s, 100.2)
+        tot = p.window_totals(100.2)
+        assert all(not k.startswith("ad.") for k in tot["kinds"])
+        assert "solo" in tot["adapters"]
+
+    def test_merge_totals_sums_adapter_rows_exactly(self):
+        """Fleet rollup: adapter rows merge as exact sums across replicas
+        — never averaged — and replicas without the section still merge."""
+        a = _part_ad({"fr": {"flops": 10.0, "bytes": 100.0, "device_s": 1.0,
+                             "steps": 1.0, "flops_cap": 40.0,
+                             "bytes_cap": 400.0}})
+        b = _part_ad({"fr": {"flops": 30.0, "bytes": 300.0, "device_s": 3.0,
+                             "steps": 1.0, "flops_cap": 160.0,
+                             "bytes_cap": 1600.0},
+                      "de": {"flops": 5.0, "bytes": 50.0, "device_s": 0.5,
+                             "steps": 1.0, "flops_cap": 20.0,
+                             "bytes_cap": 200.0}})
+        legacy = _part(1.0, 2.0, 1.0, 10.0, 10.0)  # pre-adapter replica
+        merged = perf.merge_totals([a, b, legacy])
+        fr = merged["adapters"]["fr"]
+        assert fr["flops"] == 40.0 and fr["device_s"] == 4.0
+        assert fr["flops_cap"] == 200.0
+        assert merged["adapters"]["de"]["bytes"] == 50.0
+        d = perf.derive(merged)
+        # fleet MFU per adapter is ratio-of-sums (0.2), not mean-of-ratios
+        assert d["adapters"]["fr"]["mfu"] == pytest.approx(40.0 / 200.0)
+        assert d["adapters"]["fr"]["mfu"] != pytest.approx(
+            (10.0 / 40.0 + 30.0 / 160.0) / 2)
+        assert d["adapters"]["de"]["device_s"] == pytest.approx(0.5)
+
+    def test_fleet_text_exposes_adapter_rollup_and_replica_rows(self):
+        c = new_mock_container()
+        a = _part_ad({"fr": {"flops": 10.0, "bytes": 100.0, "device_s": 1.0,
+                             "steps": 1.0, "flops_cap": 40.0,
+                             "bytes_cap": 400.0}})
+        b = _part_ad({"fr": {"flops": 30.0, "bytes": 300.0, "device_s": 3.0,
+                             "steps": 1.0, "flops_cap": 160.0,
+                             "bytes_cap": 1600.0}})
+        text = federation.fleet_text({
+            "r0": federation.digest(c.metrics, perf=a),
+            "r1": federation.digest(c.metrics, perf=b)})
+        dev = [ln for ln in text.splitlines()
+               if ln.startswith("app_tpu_adapter_device_seconds{")]
+        fleet = [ln for ln in dev if "replica" not in ln]
+        per = [ln for ln in dev if "replica" in ln]
+        assert len(fleet) == 1 and 'adapter="fr"' in fleet[0]
+        assert float(fleet[0].rsplit(" ", 1)[1]) == pytest.approx(4.0)
+        # fleet device-seconds is EXACTLY the sum of the replica rows
+        assert sum(float(ln.rsplit(" ", 1)[1]) for ln in per) == \
+            pytest.approx(float(fleet[0].rsplit(" ", 1)[1]), rel=1e-12)
+        mfu = [ln for ln in text.splitlines()
+               if ln.startswith("app_tpu_adapter_mfu{") and "replica" not in ln]
+        assert mfu and float(mfu[0].rsplit(" ", 1)[1]) == pytest.approx(0.2)

@@ -596,3 +596,67 @@ def test_router_metrics_and_debug_view_shapes():
     assert view["replicas"][0]["name"] == "a"
     g = container.metrics.get("app_router_ring_size")
     assert g is not None
+
+
+@pytest.mark.quick
+class TestAdapterAffinity:
+    """PR 16 satellite: requests naming an adapter mix it into the ring
+    key, so affinity is effectively on (prefix, adapter) — one adapter's
+    traffic converges on replicas whose device pool already holds it."""
+
+    def _router(self):
+        return Router(new_mock_container(), policy=RouterPolicy(page_size=4))
+
+    def _req(self, body, headers=None):
+        from gofr_tpu.http.request import HTTPRequest
+
+        return HTTPRequest(method="POST", path="/generate", query_string="",
+                           headers=headers or {}, body=body,
+                           path_params={}, remote="10.0.0.1")
+
+    def test_body_adapter_id_changes_the_key(self):
+        r = self._router()
+        base = r.request_key(self._req(b'{"prompt": [1, 2, 3, 4]}'))
+        fr = r.request_key(self._req(b'{"prompt": [1, 2, 3, 4], "adapter_id": "fr"}'))
+        de = r.request_key(self._req(b'{"prompt": [1, 2, 3, 4], "adapter_id": "de"}'))
+        assert len({base, fr, de}) == 3
+        # deterministic: the same (prefix, adapter) pair keys identically
+        assert fr == r.request_key(
+            self._req(b'{"prompt": [1, 2, 3, 4], "adapter_id": "fr"}'))
+
+    def test_header_adapter_is_case_insensitive_and_matches_body(self):
+        r = self._router()
+        via_body = r.request_key(
+            self._req(b'{"prompt": [1, 2, 3, 4], "adapter_id": "fr"}'))
+        via_hdr = r.request_key(self._req(b'{"prompt": [1, 2, 3, 4]}',
+                                          headers={"x-adapter-id": "fr"}))
+        via_HDR = r.request_key(self._req(b'{"prompt": [1, 2, 3, 4]}',
+                                          headers={"X-Adapter-ID": "fr"}))
+        assert via_hdr == via_HDR
+        # body and header spell the same routing input... but the body
+        # bytes differ, so only the ids-keyed portion is shared; what
+        # matters is that the ADAPTER component is identical: stripping
+        # it must land both on their no-adapter keys
+        no_ad_body = r.request_key(self._req(b'{"prompt": [1, 2, 3, 4]}'))
+        from gofr_tpu.router.ring import hash_point
+        mix = hash_point(b"adapter:fr")
+        assert via_hdr == no_ad_body ^ mix
+        assert via_body == no_ad_body ^ mix
+
+    def test_body_field_wins_over_header(self):
+        r = self._router()
+        both = self._req(b'{"prompt": [1, 2], "adapter_id": "fr"}',
+                         headers={"X-Adapter-ID": "de"})
+        only_fr = self._req(b'{"prompt": [1, 2], "adapter_id": "fr"}')
+        assert r.request_key(both) == r.request_key(only_fr)
+
+    def test_same_adapter_same_prefix_is_sticky_on_the_ring(self):
+        """The actual affinity property: identical (prefix, adapter)
+        requests route to the same replica through the plan."""
+        r = self._router()
+        for name in ("r0", "r1", "r2"):
+            r.registry.observe({"replica": name, "status": "UP",
+                                "url": f"http://{name}", "epoch": 0})
+        req = self._req(b'{"prompt": [1, 2, 3, 4], "adapter_id": "fr"}')
+        picks = {r.plan(r.request_key(req)).targets[0].name for _ in range(5)}
+        assert len(picks) == 1
