@@ -99,6 +99,17 @@ Env knobs:
     GOFR_BENCH_DISAGG_RESIDENTS  resident decode streams per phase (default 4)
     GOFR_BENCH_DISAGG_WAVE    concurrent prefill-wave size (default
                               max(4, requests/2))
+    GOFR_BENCH_QUALITY        1 = also run the numerics quality-plane drill
+                              (ISSUE 17): clean arms at bf16/int8/int4 paged
+                              KV with the divergence shadow at rate 1.0 must
+                              score every request against the dense-bf16
+                              reference with zero quality-SLO breaches, and
+                              a chaos-corrupted int8 arm (quality.corrupt
+                              scale perturbation) must drop top1 agreement,
+                              fire the quality burn, write an enriched
+                              capture bundle, and reproduce offline via
+                              scripts/replay_bundle.py; per-arm agreement
+                              stats + the chaos verdict land in extra.quality
     GOFR_BENCH_ADAPTERS       1 = also run the multi-LoRA consolidation A/B:
                               N adapters multiplexed on ONE engine vs N
                               dedicated single-adapter engines, same seeded
@@ -1681,6 +1692,111 @@ def main() -> None:
                 kvd[arm]["parity"] = None
                 kvd[arm]["token_exact"] = None
         extra["kvdtype"] = kvd
+
+    # Quality-plane drill (ISSUE 17). Clean arms: each KV dtype runs the
+    # divergence shadow at rate 1.0 and must close with zero quality-SLO
+    # breaches (bf16's serving arm IS the reference arm, so its top1
+    # agreement is exactly 1.0 by construction — asserted by the CI
+    # verdict). Chaos arm: the int8 engine is BUILT under
+    # quality.corrupt (dequant-scale perturbation baked into the compiled
+    # gather at trace time), which must drop top1 agreement, flip the
+    # quality burn, write a capture bundle carrying the quality section,
+    # and reproduce token-for-token through scripts/replay_bundle.py.
+    if os.environ.get("GOFR_BENCH_QUALITY") == "1":
+        import contextlib
+        import glob
+        import shutil
+
+        from gofr_tpu.fleet import chaos as _chaos
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        qshort = prompts[: max(3, n_requests // 8)]
+        q_new = min(max_new, 8)
+        cap_dir = os.environ.get("GOFR_BENCH_QUALITY_DIR",
+                                 "/tmp/gofr_bench_quality")
+        shutil.rmtree(cap_dir, ignore_errors=True)
+        # CHECK_INTERVAL 0: breach listeners fire synchronously on EVERY
+        # observation — shadow samples finalize ms apart on the idle loop,
+        # and a nonzero interval can swallow exactly the sample that
+        # crosses min_samples, leaving a burn with no capture
+        q_conf = {
+            "SLO_DEFAULT_QUALITY": "0.99", "SLO_MIN_SAMPLES": "2",
+            "SLO_BURN_THRESHOLD": "2", "SLO_CHECK_INTERVAL_S": "0",
+            "SLO_CAPTURE": "true", "SLO_CAPTURE_DIR": cap_dir,
+            "SLO_CAPTURE_MIN_INTERVAL_S": "0.01", "SLO_CAPTURE_BURST": "8",
+        }
+
+        def _quality_arm(kvq: str, corrupt: bool) -> dict:
+            akw = dict(engine_kw(*best))
+            akw.update(kv_layout="paged", page_size=akw.get("page_size", 128))
+            akw.pop("kv_quantize", None)
+            if kvq != "bf16":
+                akw["kv_quantize"] = kvq
+            akw.update(quality_shadow_rate=1.0,
+                       quality_max_pending=len(qshort) + 4)
+            if kvq == "int4" and not corrupt:
+                # 4-bit KV error flips greedy ties on the tiny random-init
+                # model (same caveat the kvdtype A/B documents for parity);
+                # that is honest numerics, not an anomaly — don't let the
+                # clean arm burn on it. The corrupt arm keeps the default
+                # gate: chaos must push agreement well below any tie noise.
+                akw["quality_top1_min"] = 0.75
+            cont_q = new_mock_container(dict(q_conf))
+            scope = (_chaos.override("quality.corrupt:drop,factor=8")
+                     if corrupt else contextlib.nullcontext())
+            with scope:
+                eng = GenerateEngine(llama, cfg, params, cont_q, **akw)
+                cont_q.register_engine("lm", eng)
+                try:
+                    eng.warmup()
+                    eng.start()
+                    reqs = [eng.submit(p, max_new_tokens=q_new, timeout=timeout)
+                            for p in qshort]
+                    for r in reqs:
+                        r.result(timeout)
+                    eng._quality.drain(timeout)
+                    snap = eng.quality_snapshot()
+                finally:
+                    eng.stop()
+            qbr = [b for b in cont_q.slo.breaches()
+                   if b.get("objective") == "quality"]
+            top1 = [e["report"]["top1_agree"] for e in snap.get("recent", [])]
+            return {
+                "samples": snap["samples"], "good": snap["good"],
+                "errors": snap["errors"],
+                "top1_agree_mean":
+                    round(sum(top1) / len(top1), 4) if top1 else None,
+                "top1_agree_min": round(min(top1), 4) if top1 else None,
+                "quality_breaches": len(qbr),
+                "burned": bool(qbr),
+            }
+
+        qual: dict = {}
+        for arm in ("bf16", "int8", "int4"):
+            try:
+                qual[arm] = _quality_arm(arm, corrupt=False)
+            except Exception as e:  # noqa: BLE001
+                qual[arm] = f"error: {e}"[:200]
+        try:
+            corrupt = _quality_arm("int8", corrupt=True)
+            bundles = sorted(glob.glob(os.path.join(cap_dir, "slo-capture-*")))
+            corrupt["bundle"] = bundles[-1] if bundles else None
+            if bundles:
+                sys.path.insert(0, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "scripts"))
+                import replay_bundle as _rb
+                # params= hands the replay the exact served tree; the CLI
+                # default (llama.init at the recorded sampler seed) matches
+                # it here anyway since the bench inits at key(0) with seed 0
+                rep = _rb.replay(bundles[-1], run_engine=True, params=params,
+                                 max_samples=2)
+                corrupt["replay_reproduced"] = bool(rep["reproduced"])
+            else:
+                corrupt["replay_reproduced"] = False
+            qual["corrupt_int8"] = corrupt
+        except Exception as e:  # noqa: BLE001
+            qual["corrupt_int8"] = f"error: {e}"[:200]
+        extra["quality"] = qual
 
     # kernel A/B on the chip: engine throughput with the Pallas kernels
     # forced on vs off (fresh engines retrace under the env toggle)

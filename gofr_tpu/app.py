@@ -300,6 +300,7 @@ class App:
             http_app.router.add_get("/debug/requests", self._debug_requests_handler)
             http_app.router.add_get("/debug/engine", self._debug_engine_handler)
             http_app.router.add_get("/debug/perf", self._debug_perf_handler)
+            http_app.router.add_get("/debug/quality", self._debug_quality_handler)
 
         for method, path, handler in self._routes:
             http_app.router.add_route(method, path, self._wrap(handler))
@@ -808,6 +809,46 @@ class App:
 
             fleet = {"totals": totals, **perf_mod.derive(totals)}
         return web.json_response({"data": {"engines": engines, "rollup": fleet}})
+
+    async def _debug_quality_handler(self, request: web.Request) -> web.Response:
+        """GET /debug/quality → the numerics/quality plane joined with the
+        serving state that produced it (metrics/quality.py; docs/
+        observability.md): per engine the shadow-scorer totals and recent
+        per-sample divergence reports keyed by autotune pins, weights epoch
+        and kv dtype, the per-adapter speculative-decode acceptance ratios
+        (the always-on quality proxy), and each class's quality SLO windows
+        — "are the tokens still right, and if not, since when and under
+        which configuration" answered from one endpoint."""
+        engines = {}
+        for name, engine in self.container.engines.items():
+            entry: dict = {}
+            snap_fn = getattr(engine, "quality_snapshot", None)
+            snap = snap_fn() if callable(snap_fn) else None
+            if snap is not None:
+                # trim replay payloads off the live view; bundles carry them
+                snap = dict(snap)
+                snap["recent"] = [
+                    {k: v for k, v in e.items() if k not in ("prompt", "emitted")}
+                    for e in snap.get("recent", [])]
+                entry["shadow"] = snap
+            totals_fn = getattr(engine, "spec_accept_totals", None)
+            totals = totals_fn() if callable(totals_fn) else None
+            if totals:
+                entry["spec_accept"] = {
+                    adapter: {
+                        "accepted": acc, "proposed": prop,
+                        "ratio": round(acc / prop, 4) if prop else None,
+                    } for adapter, (acc, prop) in totals.items()}
+            if entry:
+                engines[name] = entry
+        slo = getattr(self.container, "slo", None)
+        objectives = None
+        if slo is not None:
+            objectives = {
+                cls: {"quality": objs["quality"]}
+                for cls, objs in slo.snapshot().items() if "quality" in objs}
+        return web.json_response(
+            {"data": {"engines": engines, "slo": objectives}})
 
     def _add_openapi_routes(self, http_app: web.Application) -> None:
         from gofr_tpu.swagger import openapi_handler, swagger_ui_handler

@@ -272,6 +272,38 @@ class Container:
                     "allocated fraction of the paged KV pool (engine)")
         m.new_gauge("app_tpu_kv_pool_fragmentation",
                     "claimed-but-unwritten fraction of slot-held pages (engine)")
+        # quality plane (metrics/quality.py; docs/observability.md): shadow
+        # re-score divergence vs the reference configuration, keyed by what
+        # the serving path actually used (kv_dtype, backend, adapter)
+        m.new_histogram("app_tpu_quality_logprob_delta",
+                        "mean |serving - reference| log-prob of the emitted "
+                        "tokens, per shadow sample (kv_dtype, backend, adapter)",
+                        buckets=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                                 1.0, 2.0, 5.0])
+        m.new_histogram("app_tpu_quality_kl",
+                        "mean per-token KL(serving || reference), per shadow "
+                        "sample (kv_dtype, backend, adapter)",
+                        buckets=[0.0001, 0.001, 0.01, 0.05, 0.1, 0.5,
+                                 1.0, 5.0])
+        m.new_gauge("app_tpu_quality_top1_agree",
+                    "fraction of emitted tokens matching the reference "
+                    "argmax, last shadow sample (kv_dtype, backend, adapter)")
+        m.new_histogram("app_tpu_quality_first_divergence_token",
+                        "token index of the first reference-argmax "
+                        "disagreement (diverged samples only)",
+                        buckets=[0, 1, 2, 4, 8, 16, 32, 64, 128])
+        m.new_counter("app_tpu_quality_samples_total",
+                      "shadow-scored requests (kv_dtype, backend, adapter) — "
+                      "rides the gossip digest for exact fleet rollups")
+        m.new_counter("app_tpu_quality_good_total",
+                      "shadow samples within divergence thresholds "
+                      "(kv_dtype, backend, adapter)")
+        m.new_counter("app_tpu_quality_shadow_dropped_total",
+                      "sampled requests evicted from the bounded shadow "
+                      "queue before scoring (back-pressure, never blocking)")
+        m.new_gauge("app_tpu_spec_accept_ratio",
+                    "lifetime speculative-decode acceptance ratio (adapter) "
+                    "— the cheapest always-on quality proxy")
 
     def _sample_tpu_metrics(self, _registry=None) -> None:
         """Collect hook: live HBM gauges on every /metrics scrape (the
@@ -289,6 +321,22 @@ class Container:
         self.metrics.set_gauge(
             "app_tpu_inflight_requests",
             sum(getattr(e, "_inflight_requests", 0) for e in self._engines.values()))
+        # spec-decode acceptance, divided at scrape time from raw
+        # per-adapter (accepted, proposed) numerators summed across engines
+        # — never an average of per-engine ratios
+        spec: dict[str, list[float]] = {}
+        for e in self._engines.values():
+            totals_fn = getattr(e, "spec_accept_totals", None)
+            if not callable(totals_fn):
+                continue
+            for adapter, (acc, prop) in totals_fn().items():
+                tot = spec.setdefault(adapter, [0.0, 0.0])
+                tot[0] += acc
+                tot[1] += prop
+        for adapter, (acc, prop) in spec.items():
+            if prop > 0:
+                self.metrics.set_gauge("app_tpu_spec_accept_ratio",
+                                       acc / prop, adapter=adapter)
         self._sample_perf_metrics()
 
     def perf_totals(self) -> dict | None:

@@ -164,6 +164,18 @@ def active() -> bool:
     return bool(_table())
 
 
+def active_spec() -> str:
+    """The armed spec re-serialized from the LIVE table — env-parsed or
+    :class:`override`-installed alike. Diagnostics record this (capture
+    bundles, engine replay configs) so an offline replay can re-arm the
+    exact fault schedule; reading GOFR_CHAOS alone would miss overrides."""
+    parts = []
+    for name, pt in sorted(_table().items()):
+        bits = [pt.action] + [f"{k}={v}" for k, v in sorted(pt.params.items())]
+        parts.append(f"{name}:{','.join(bits)}")
+    return ";".join(parts)
+
+
 def hook(point: str) -> ChaosPoint | None:
     """The armed ChaosPoint for ``point``, or None (the common case) —
     bind at construction time and guard with one truthiness branch."""
@@ -181,6 +193,27 @@ def fire(point: str, **ctx: Any) -> bool:
     return p(**ctx) if p is not None else False
 
 
+# Fault points consulted at TRACE time (the fault bakes into the compiled
+# program rather than firing per call). Arming or disarming one of these
+# must invalidate the in-process jit cache: the persistent cache is safe
+# (the corruption changes the HLO), but the in-memory cache keys on python
+# callables + static args + shapes only, so an identically-shaped program
+# compiled clean would be silently reused by the "corrupted" engine — and,
+# worse, a corrupted program would outlive the override into clean code.
+_TRACE_TIME_POINTS = ("quality.corrupt",)
+
+
+def _flush_traces(*tables: dict[str, ChaosPoint] | None) -> None:
+    if not any(t and any(n in t for n in _TRACE_TIME_POINTS) for t in tables):
+        return
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:  # noqa: BLE001 — jax absent or too old: nothing cached
+        pass
+
+
 class override:
     """Context manager installing a chaos spec for in-process tests::
 
@@ -188,7 +221,9 @@ class override:
             ...
 
     Counters start fresh on entry; the previous table (usually empty) is
-    restored on exit."""
+    restored on exit. Trace-time points (see ``_TRACE_TIME_POINTS``) flush
+    the jit cache on both edges so the fault schedule actually recompiles
+    in and back out."""
 
     def __init__(self, spec: str, seed: int = 0):
         self.spec = spec
@@ -200,11 +235,13 @@ class override:
         with _TABLE_LOCK:
             self._prev = _TABLE
             _TABLE = _parse(self.spec, self.seed)
+            _flush_traces(self._prev, _TABLE)
         return self
 
     def __exit__(self, *exc) -> None:
         global _TABLE
         with _TABLE_LOCK:
+            _flush_traces(self._prev, _TABLE)
             _TABLE = self._prev
 
 

@@ -58,6 +58,11 @@ DIGEST_COUNTERS: tuple[str, ...] = (
     "app_qos_shed_total",
     "app_qos_rejected_total",
     "app_tpu_engine_restarts",
+    # quality plane (metrics/quality.py): raw per-(kv_dtype,backend,adapter)
+    # sample counts — counters so the fleet rollup is sum(good)/sum(total)
+    # exactly, never an average of per-replica agreement ratios
+    "app_tpu_quality_samples_total",
+    "app_tpu_quality_good_total",
 )
 DIGEST_HISTOGRAMS: tuple[str, ...] = (
     "app_tpu_ttft_seconds",

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
 import time
 from dataclasses import dataclass
@@ -222,6 +223,14 @@ class SLOEngine:
                 _DEFAULT_AVAILABILITY.get(name, _DEFAULT_AVAILABILITY["default"]))
             if 0.0 < avail < 1.0:
                 objectives.append(Objective(name, "availability", avail))
+            # quality objective (metrics/quality.py shadow scorer): target =
+            # fraction of shadow-scored samples that must sit within the
+            # divergence thresholds. Default 0 = off — it only costs budget
+            # when the operator both samples traffic (QUALITY_SHADOW_RATE)
+            # and declares a target here.
+            quality = config.get_float(f"SLO_{up}_QUALITY", 0.0)
+            if 0.0 < quality < 1.0:
+                objectives.append(Objective(name, "quality", quality))
         return cls(
             objectives, metrics=metrics, logger=logger,
             fast_window_s=config.get_float("SLO_FAST_WINDOW_S", 60.0),
@@ -255,6 +264,20 @@ class SLOEngine:
         """One availability sample: did the request complete without error
         (timeouts, sheds, and engine faults all count against budget)."""
         tr = self._trackers.get((self._canon(cls_name), "availability"))
+        if tr is None:
+            return
+        now = self._now()
+        with self._lock:
+            tr.observe(bool(ok), now)
+        self._maybe_check(now)
+
+    def observe_quality(self, cls_name: str | None, ok: bool) -> None:
+        """One shadow-scored quality sample (metrics/quality.py): did the
+        request's re-score stay within the divergence thresholds. Rides the
+        same window/burn/breach machinery as every other objective, so a
+        numerics regression degrades health and fires captures exactly like
+        a latency regression would."""
+        tr = self._trackers.get((self._canon(cls_name), "quality"))
         if tr is None:
             return
         now = self._now()
@@ -421,7 +444,7 @@ class CaptureWatcher:
     def __init__(self, container, slo: SLOEngine, *, out_dir: str,
                  min_interval_s: float = 600.0, burst: int = 1,
                  trace_s: float = 0.0, flight_requests: int = 64,
-                 flight_steps: int = 128,
+                 flight_steps: int = 128, max_bundles: int = 32,
                  now: Callable[[], float] = time.monotonic,
                  clock: Callable[[], float] = time.time):
         self.container = container
@@ -432,6 +455,10 @@ class CaptureWatcher:
         self.trace_s = float(trace_s)
         self.flight_requests = int(flight_requests)
         self.flight_steps = int(flight_steps)
+        # disk retention: the token bucket bounds bundles per interval, this
+        # bounds them across days — oldest slo-capture-* dirs are swept
+        # after each write (0 = unbounded, the pre-retention behavior)
+        self.max_bundles = int(max_bundles)
         self._now = now
         self._clock = clock
         self._tokens = float(self.burst)
@@ -450,7 +477,8 @@ class CaptureWatcher:
             container, slo, out_dir=out_dir,
             min_interval_s=config.get_float("SLO_CAPTURE_MIN_INTERVAL_S", 600.0),
             burst=config.get_int("SLO_CAPTURE_BURST", 1),
-            trace_s=config.get_float("SLO_CAPTURE_TRACE_S", 0.0), **kw)
+            trace_s=config.get_float("SLO_CAPTURE_TRACE_S", 0.0),
+            max_bundles=config.get_int("SLO_CAPTURE_MAX_BUNDLES", 32), **kw)
 
     # -- token bucket ----------------------------------------------------------
 
@@ -523,6 +551,21 @@ class CaptureWatcher:
                 perf = {"engines": planes, "totals": totals}
         except Exception:  # noqa: BLE001 - capture is best-effort diagnostics
             perf = None
+        quality = {}
+        for name, e in getattr(self.container, "engines", {}).items():
+            # quality-plane enrichment (metrics/quality.py): per-sample
+            # replay payloads (prompt ids, emitted tokens, divergence
+            # report) joined with the sampler seed, adapter digest, weights
+            # epoch, kv dtype, autotune pins, and config fingerprint — the
+            # complete deterministic input set scripts/replay_bundle.py
+            # needs to re-execute the divergence offline
+            try:
+                snap_fn = getattr(e, "quality_snapshot", None)
+                snap = snap_fn() if callable(snap_fn) else None
+            except Exception:  # noqa: BLE001 - capture is best-effort diagnostics
+                snap = None
+            if snap is not None:
+                quality[name] = snap
         bundle = {
             "ts": self._clock(),
             "reason": breaches,
@@ -536,9 +579,26 @@ class CaptureWatcher:
             "engines": engines,
             "perf": perf,
         }
+        if quality:
+            bundle["quality"] = quality
         with open(os.path.join(path, "bundle.json"), "w") as f:
             json.dump(bundle, f, indent=1, default=str)
+        self._sweep()
         return path
+
+    def _sweep(self) -> None:
+        """Retention: drop the oldest ``slo-capture-*`` dirs beyond
+        ``max_bundles``. The stamp-seq naming sorts chronologically, so a
+        plain lexical sort is the age order."""
+        if self.max_bundles <= 0:
+            return
+        try:
+            names = sorted(d for d in os.listdir(self.out_dir)
+                           if d.startswith("slo-capture-"))
+        except OSError:
+            return
+        for name in names[:-self.max_bundles]:
+            shutil.rmtree(os.path.join(self.out_dir, name), ignore_errors=True)
 
     def _start_trace(self, path: str) -> None:
         """Bounded ``jax.profiler.trace`` around the next few device steps,

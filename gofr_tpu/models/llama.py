@@ -202,16 +202,20 @@ def _mlp(cfg: LlamaConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
 # -- entry points --------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnums=(0, 4))
+@partial(jax.jit, static_argnums=(0, 4, 5))
 def forward(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
             lengths: jnp.ndarray | None = None,
-            attn_fn: Any = None) -> jnp.ndarray:
+            attn_fn: Any = None, head_fn: Any = None) -> jnp.ndarray:
     """Full causal forward, no cache: tokens [B,S] → logits [B,S,V] (f32).
     ``lengths`` masks padded positions out of attention.
 
     ``attn_fn`` swaps the attention implementation (static; same contract
     as ops.mha_attention) — e.g. a mesh-bound ring/Ulysses sequence-parallel
-    attention from gofr_tpu.parallel.ring.make_seq_parallel_attn."""
+    attention from gofr_tpu.parallel.ring.make_seq_parallel_attn.
+
+    ``head_fn`` swaps the lm_head projection (static; ``(x, head) ->
+    logits``) — e.g. the quality plane's LoRA-delta head, which must score
+    teacher-forced sequences with the exact adapter math serving used."""
     attn = attn_fn or mha_attention
     cos, sin = _rope(cfg)
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -230,7 +234,8 @@ def forward(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
     x, _ = lax.scan(body, x, params["blocks"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return qdot(x, head).astype(jnp.float32)
+    logits = head_fn(x, head) if head_fn is not None else qdot(x, head)
+    return logits.astype(jnp.float32)
 
 
 @partial(jax.jit, static_argnums=(0, 4, 5))

@@ -278,6 +278,23 @@ def append_tokens_paged_q(
     return cache_q, cache_s
 
 
+def _corrupt_scales(gs: jnp.ndarray) -> jnp.ndarray:
+    """Chaos point ``quality.corrupt``: multiply the gathered dequant scales
+    by ``factor`` (default 1.5). Evaluated at TRACE time, so an engine built
+    under ``chaos.override("quality.corrupt:drop,factor=8")`` bakes the
+    corruption into its compiled decode program — deterministic plausible
+    wrong tokens, exactly the silent-numerics failure the quality plane
+    exists to catch (and a different HLO hash, so the persistent compile
+    cache can't serve a clean program). Unarmed: returns ``gs`` untouched."""
+    from gofr_tpu.fleet import chaos
+
+    pt = chaos.hook("quality.corrupt")
+    if pt is not None and pt():
+        factor = float(pt.params.get("factor", "1.5"))
+        gs = gs * jnp.asarray(factor, gs.dtype)
+    return gs
+
+
 def gather_kv_q(
     cache_q: jnp.ndarray,  # int8 [P, Hkv, page, D]
     cache_s: jnp.ndarray,  # [P, Hkv, page]
@@ -291,7 +308,7 @@ def gather_kv_q(
 
     gq = cache_q[safe].transpose(0, 2, 1, 3, 4).reshape(n, hkv, maxp * page, d)
     gs = cache_s[safe].transpose(0, 2, 1, 3).reshape(n, hkv, maxp * page)
-    return gq, gs
+    return gq, _corrupt_scales(gs)
 
 
 def write_prompts_paged_q4(
@@ -371,7 +388,7 @@ def gather_kv_q4(
 
     gq = cache_q[safe].transpose(0, 2, 1, 3, 4).reshape(n, hkv, maxp * page, d2)
     gs = cache_s[safe].transpose(0, 2, 1, 3).reshape(n, hkv, maxp * page)
-    return unpack_int4(gq), gs
+    return unpack_int4(gq), _corrupt_scales(gs)
 
 
 def write_prompts_paged(

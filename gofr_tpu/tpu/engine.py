@@ -526,6 +526,16 @@ class _EngineBase:
                 "app_tpu_e2e_seconds", e2e, qos_class=kw.get("_qos_class") or "none")
             if self.slo is not None:
                 self.slo.observe(kw.get("_qos_class"), "e2e", e2e)
+        spec_proposed = kw.get("_spec_proposed")
+        if spec_proposed:
+            # lifetime per-adapter acceptance numerators for the
+            # app_tpu_spec_accept_ratio gauge (container scrape divides;
+            # keeping raw counts is what lets federation sum, not average)
+            with self._obs_lock:
+                tot = self._spec_totals.setdefault(
+                    kw.get("_adapter") or "base", [0.0, 0.0])
+                tot[0] += float(kw.get("_spec_accepted", 0))
+                tot[1] += float(spec_proposed)
         if self.flight is None:
             return
         admitted = kw.get("_admitted_at")
@@ -942,6 +952,13 @@ class GenerateEngine(_EngineBase):
         adapter_host_mb: float = 256.0,
         adapter_hotswap_dir: str | None = None,
         adapter_hotswap_poll_s: float = 5.0,
+        quality_shadow_rate: float = 0.0,
+        quality_seed: int | None = None,
+        quality_max_pending: int = 16,
+        quality_max_tokens: int = 64,
+        quality_top1_min: float = 0.9,
+        quality_kl_max: float = 1.0,
+        quality_recent: int = 32,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -1424,6 +1441,58 @@ class GenerateEngine(_EngineBase):
         self._hotswap_seen = (self._scan_hotswap_steps()
                               if self._hotswap_dir else None)
 
+        # -- quality plane (metrics/quality.py; docs/observability.md) -------
+        # Shadow-score a sampled fraction of completed requests against the
+        # reference configuration (dense bf16 KV, base weights), on idle
+        # device-loop iterations only. Rate 0 (the default) never constructs
+        # the plane: the serving path pays exactly one `is None` branch and
+        # stays bit-identical to the pre-quality engine.
+        self._quality = None
+        rate = max(0.0, min(1.0, float(quality_shadow_rate)))
+        if rate > 0.0 and not hasattr(family, "forward"):
+            container.logger.warn(
+                "QUALITY_SHADOW_RATE ignored: family "
+                f"{getattr(family, '__name__', family)!r} has no teacher-"
+                "forcing `forward` entry point")
+            rate = 0.0
+        if rate > 0.0:
+            from gofr_tpu.metrics.quality import QualityPlane
+
+            def _adapter_factors(name: str):
+                if self.adapters is None:
+                    return None
+                try:
+                    spec = self.adapters.get(name)
+                except KeyError:
+                    return None
+                return (spec.a, spec.b, spec.scale)
+
+            self._quality = QualityPlane(
+                family, cfg,
+                # late-bound: hot-swap replaces self.params; the reference
+                # arm must always score with the CURRENTLY served weights
+                lambda: self.params,
+                metrics=self.metrics,
+                slo=self.slo,
+                rate=rate,
+                # QUALITY_SEED unset (None / negative) → the engine's own
+                # sampler seed, so one knob replays the shadow schedule too
+                seed=(self._seed if quality_seed is None
+                      or int(quality_seed) < 0 else int(quality_seed)),
+                kv_dtype=self.kv_quantize or "bf16",
+                backend_fn=self._quality_backend,
+                adapter_fn=_adapter_factors,
+                max_pending=quality_max_pending,
+                max_tokens=quality_max_tokens,
+                top1_min=quality_top1_min,
+                kl_max=quality_kl_max,
+                recent=quality_recent,
+            )
+        # per-adapter lifetime (accepted, proposed) speculative-decode
+        # totals — the always-on quality proxy the container samples into
+        # the app_tpu_spec_accept_ratio gauge (sum-of-parts, never averaged)
+        self._spec_totals: dict[str, list[float]] = {}
+
         # Compiled packed-program handles (tpu/programs.py documents the
         # packed layouts; lockstep followers call the same handles).
         progs = build_programs(
@@ -1678,6 +1747,72 @@ class GenerateEngine(_EngineBase):
         when autotune is disabled) — surfaced at /debug/engine and recorded
         in the bench JSON."""
         return self._autotune
+
+    def _quality_backend(self) -> str:
+        """Backend label for quality telemetry: the distinct autotune-pinned
+        kernel backends serving this engine ("xla" before warmup pins)."""
+        pins = self._autotune_pins
+        return "+".join(sorted(set(pins.values()))) if pins else "xla"
+
+    def spec_accept_totals(self) -> dict[str, tuple[float, float]]:
+        """Lifetime per-adapter (accepted, proposed) speculative-decode
+        token totals ("base" = no adapter). Raw summable numerators — the
+        container divides at scrape time, federation sums across engines."""
+        with self._obs_lock:
+            return {k: (v[0], v[1]) for k, v in self._spec_totals.items()}
+
+    def quality_snapshot(self) -> dict | None:
+        """The /debug/quality + capture-bundle join: plane totals and recent
+        divergence reports, keyed by the serving state that produced them —
+        autotune pins, weights epoch, kv dtype — plus the replay config
+        scripts/replay_bundle.py needs to re-execute samples offline."""
+        if self._quality is None:
+            return None
+        snap = self._quality.snapshot()
+        snap["autotune_pins"] = dict(self._autotune_pins)
+        snap["weights_epoch"] = self.weights_epoch
+        snap["backend"] = self._quality_backend()
+        snap["replay"] = self.replay_config()
+        return snap
+
+    def replay_config(self) -> dict:
+        """Everything scripts/replay_bundle.py needs to rebuild THIS engine
+        offline: model family/config, sampler seed, the engine knobs that
+        shape compiled programs, adapter digest, weights epoch, fingerprint,
+        and the chaos spec that was armed (corruption is part of the repro)."""
+        import dataclasses
+
+        cfg = self.cfg
+        cfg_d = None
+        if dataclasses.is_dataclass(cfg):
+            cfg_d = dataclasses.asdict(cfg)
+            dt = cfg_d.get("dtype")
+            if dt is not None:
+                cfg_d["dtype"] = jnp.dtype(dt).name
+        return {
+            "family": getattr(self.family, "__name__",
+                              type(self.family).__name__).rsplit(".", 1)[-1],
+            "config": cfg_d,
+            "seed": self._seed,
+            "engine": {
+                "slots": self.num_slots,
+                "max_len": self.max_len,
+                "decode_chunk": self.decode_chunk,
+                "kv_layout": self.kv_layout,
+                "page_size": self.page_size if self.kv_layout == "paged" else 0,
+                "total_pages": getattr(self, "total_pages", 0),
+                "spec_tokens": self.spec_tokens,
+                "kv_quantize": self.kv_quantize,
+                "top_k": self.top_k,
+                "top_p": self.top_p,
+            },
+            "weights_epoch": self.weights_epoch,
+            "adapter_digest": self.adapters_digest(),
+            "fingerprint": self.fleet_fingerprint(),
+            # the LIVE armed spec (env or test override), not the env var:
+            # an armed corruption is part of the deterministic repro
+            "chaos": chaos.active_spec(),
+        }
 
     def page_pool_stats(self) -> dict | None:
         """Paged-pool waste view for the perf plane: occupancy (allocated
@@ -2836,6 +2971,14 @@ class GenerateEngine(_EngineBase):
                     # idle leader: heartbeat so follower watchdogs see
                     # liveness between announcements (LOCKSTEP_DEADLINE_S)
                     self._ls.maybe_heartbeat(self._hb_interval)
+                if self._quality is not None and self._quality.step():
+                    # quality plane: ONE shadow-scoring arm per idle
+                    # iteration, then straight back to the top of the loop —
+                    # interactive work that arrived during the forward is
+                    # picked up before the next arm runs, and shadow work
+                    # claims no slots or pages (it is a standalone
+                    # teacher-forced forward), so preemption is free
+                    continue
                 # idle: block briefly for work without consuming (a get/put
                 # round trip would skew QoS wait metrics and fair credits,
                 # and could reorder same-class FIFO arrivals)
@@ -3408,6 +3551,23 @@ class GenerateEngine(_EngineBase):
             "finish_reason": finish,
             "ttft_s": ft - s.request.enqueued_at,
         }
+        if self._quality is not None:
+            # shadow-sampling dice roll (host-cheap; scoring happens later
+            # on idle loop iterations). Captured BEFORE the slot is freed so
+            # prompt/emitted are read from live state, keyed by exactly what
+            # served the request: adapter, qos class, weights epoch. Uses
+            # THIS life's prompt/emitted split (after a preemption the slot
+            # prompt already contains the prior tokens — `tokens` above
+            # would double-count them).
+            self._quality.maybe_capture(
+                [int(t) for t in np.asarray(s.prompt_tokens).reshape(-1)],
+                s.generated[:-1] if finish == "stop" else list(s.generated),
+                adapter=s.adapter_id,
+                qos_class=s.request.kw.get("_qos_class"),
+                weights_epoch=s.request.kw.get("_weights_epoch",
+                                               self.weights_epoch) or 0,
+                request_id=s.request.id,
+            )
         self._free_slot(slot_idx)
         s.request.complete(result=result)
 
@@ -3759,6 +3919,29 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             adapter_hotswap_poll_s=float(kw.pop(
                 "adapter_hotswap_poll_s",
                 conf.get_float("ADAPTER_HOTSWAP_POLL_S", 5.0))),
+            # quality plane (metrics/quality.py): rate 0 (the default)
+            # never constructs the plane — bit-identical off path
+            quality_shadow_rate=float(kw.pop(
+                "quality_shadow_rate",
+                conf.get_float("QUALITY_SHADOW_RATE", 0.0))),
+            quality_seed=kw.pop(
+                "quality_seed",
+                conf.get_int("QUALITY_SEED", -1)),
+            quality_max_pending=int(kw.pop(
+                "quality_max_pending",
+                conf.get_int("QUALITY_MAX_PENDING", 16))),
+            quality_max_tokens=int(kw.pop(
+                "quality_max_tokens",
+                conf.get_int("QUALITY_MAX_TOKENS", 64))),
+            quality_top1_min=float(kw.pop(
+                "quality_top1_min",
+                conf.get_float("QUALITY_TOP1_MIN", 0.9))),
+            quality_kl_max=float(kw.pop(
+                "quality_kl_max",
+                conf.get_float("QUALITY_KL_MAX", 1.0))),
+            quality_recent=int(kw.pop(
+                "quality_recent",
+                conf.get_int("QUALITY_RECENT", 32))),
             **kw,
         )
 

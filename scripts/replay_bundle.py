@@ -1,0 +1,237 @@
+"""Deterministic offline replay of an SLO anomaly capture bundle's quality
+section (docs/observability.md "Quality plane" runbook).
+
+A quality burn at 3am leaves behind a ``slo-capture-*/bundle.json`` whose
+``quality`` section carries, per engine, the complete deterministic input
+set for every recently shadow-scored request: prompt token ids, emitted
+tokens, the divergence report, plus a ``replay`` config (model family +
+config, sampler seed, the engine knobs that shape compiled programs, the
+armed chaos spec, adapter digest, weights epoch, fingerprint). This script
+re-executes those samples on a cold process and diffs token-by-token:
+
+1. **Serving re-execution** (default): rebuild the EXACT engine — same
+   knobs, same sampler seed, same ``GOFR_CHAOS`` spec re-armed via
+   ``chaos.override`` (trace-time corruption bakes back into the compiled
+   program) — and greedily re-generate each sample's prompt. The emitted
+   tokens must match the recorded ones position-by-position; a mismatch
+   means the recorded state is incomplete, not that the bug is gone.
+2. **Reference re-score**: teacher-force ``prompt + emitted`` through the
+   golden configuration (dense KV, base weights) and the serving-numerics
+   arm, and recompute the divergence report. The per-token agreement mask
+   must reproduce the recorded one exactly — same first-divergence index,
+   same disagreeing positions.
+
+A sample "reproduces" when both hold; the exit code is 0 only when every
+replayed sample reproduces. Weights come from ``llama.init`` at the
+recorded seed (the engines' own convention); a checkpoint-serving fleet
+must restore the recorded ``weights_epoch``'s checkpoint into the hot-swap
+dir before replaying.
+
+Usage:
+    python scripts/replay_bundle.py /path/to/slo-capture-20260807-031502-001
+    python scripts/replay_bundle.py bundle.json --no-engine --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+from typing import Any
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_bundle(path: str) -> dict:
+    if os.path.isdir(path):
+        path = os.path.join(path, "bundle.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def _build_model(replay: dict, *, params=None, init_seed: int | None = None):
+    """(family module, cfg, params) from a recorded replay config.
+    ``params`` short-circuits weight reconstruction (the importable-API
+    path: a test or operator hands over the exact served tree); otherwise
+    weights are ``llama.init`` at ``init_seed`` (default: the recorded
+    sampler seed, the convention bench/test engines follow)."""
+    import jax
+    import jax.numpy as jnp
+
+    name = str(replay.get("family", "llama"))
+    if name != "llama":
+        raise SystemExit(f"replay supports the llama family only (got {name!r})")
+    from gofr_tpu.models import llama
+
+    cfg_d = dict(replay["config"])
+    dt = cfg_d.get("dtype")
+    if isinstance(dt, str):
+        cfg_d["dtype"] = jnp.dtype(dt).type
+    cfg = llama.LlamaConfig(**cfg_d)
+    if params is None:
+        seed = int(replay.get("seed", 0)) if init_seed is None else int(init_seed)
+        params = llama.init(cfg, jax.random.key(seed))
+    return llama, cfg, params
+
+
+def _chaos_scope(replay: dict):
+    """Re-arm the chaos spec recorded at capture time — the corruption under
+    test is part of the deterministic repro, not something to replay around."""
+    spec = str(replay.get("chaos", "") or "")
+    if not spec:
+        return contextlib.nullcontext()
+    from gofr_tpu.fleet import chaos
+
+    return chaos.override(spec, seed=int(os.environ.get("GOFR_CHAOS_SEED", "0")))
+
+
+def _replay_engine(family, cfg, params, replay: dict,
+                   samples: list[dict]) -> list[dict]:
+    """Serving re-execution: same engine knobs + seed + chaos spec, greedy
+    re-generation of each sample's prompt, token-by-token diff."""
+    from gofr_tpu.container import new_mock_container
+    from gofr_tpu.tpu.engine import GenerateEngine
+
+    ek = dict(replay.get("engine", {}))
+    out: list[dict] = []
+    with _chaos_scope(replay):
+        container = new_mock_container({})
+        engine = GenerateEngine(
+            family, cfg, params, container,
+            slots=int(ek.get("slots", 8)),
+            max_len=int(ek.get("max_len", cfg.max_seq_len)),
+            decode_chunk=int(ek.get("decode_chunk", 8)),
+            kv_layout=str(ek.get("kv_layout", "slot")),
+            page_size=int(ek.get("page_size", 128) or 128),
+            total_pages=int(ek.get("total_pages", 0)) or None,
+            spec_tokens=int(ek.get("spec_tokens", 0)),
+            kv_quantize=str(ek.get("kv_quantize", "")),
+            top_k=int(ek.get("top_k", 0)),
+            top_p=float(ek.get("top_p", 1.0)),
+            seed=int(replay.get("seed", 0)),
+        )
+        engine.start()
+        try:
+            for s in samples:
+                want = [int(t) for t in s["emitted"]]
+                res = engine.generate(s["prompt"],
+                                      max_new_tokens=max(len(want), 1),
+                                      temperature=0.0, timeout=120.0)
+                got = [int(t) for t in res["tokens"]][: len(want)]
+                first = next((i for i, (a, b) in enumerate(zip(got, want))
+                              if a != b), -1)
+                out.append({
+                    "tokens_match": got == want,
+                    "first_token_mismatch": first,
+                    "replayed_tokens": got,
+                })
+        finally:
+            engine.stop()
+    return out
+
+
+def _rescore(family, cfg, params, kv_dtype: str, sample: dict) -> dict:
+    """Reference + serving-numerics teacher-forced re-score; the recomputed
+    divergence report must reproduce the recorded per-token agreement."""
+    from gofr_tpu.metrics.quality import (
+        divergence_report, make_serving_attn_fn, teacher_forced_rows)
+
+    serving_rows = teacher_forced_rows(
+        family, cfg, params, sample["prompt"], sample["emitted"],
+        attn_fn=make_serving_attn_fn(kv_dtype))
+    ref_rows = teacher_forced_rows(
+        family, cfg, params, sample["prompt"], sample["emitted"])
+    return divergence_report(serving_rows, ref_rows, sample["emitted"])
+
+
+def replay(bundle_path: str, *, run_engine: bool = True,
+           max_samples: int = 0, params=None,
+           init_seed: int | None = None) -> dict:
+    """Replay every quality sample in a bundle; importable for tests.
+    Returns {engine: {samples: [...], reproduced: bool}, "reproduced": bool}."""
+    bundle = _load_bundle(bundle_path)
+    quality = bundle.get("quality") or {}
+    if not quality:
+        raise SystemExit(f"{bundle_path}: bundle has no quality section "
+                         "(was QUALITY_SHADOW_RATE > 0 when it was written?)")
+    result: dict[str, Any] = {"engines": {}, "reproduced": True}
+    for engine_name, snap in quality.items():
+        replay_cfg = snap.get("replay") or {}
+        samples = [s for s in snap.get("recent", []) if s.get("report")]
+        if max_samples > 0:
+            samples = samples[:max_samples]
+        if not samples:
+            continue
+        family, cfg, eng_params = _build_model(
+            replay_cfg, params=params, init_seed=init_seed)
+        kv_dtype = str(snap.get("kv_dtype", "bf16"))
+        engine_runs = (_replay_engine(family, cfg, eng_params, replay_cfg, samples)
+                       if run_engine else [None] * len(samples))
+        rows = []
+        for sample, run in zip(samples, engine_runs):
+            recorded = sample["report"]
+            rescored = _rescore(family, cfg, eng_params, kv_dtype, sample)
+            divergence_match = (
+                rescored["agree"] == recorded.get("agree")
+                and rescored["first_divergence"] == recorded.get("first_divergence"))
+            row = {
+                "request_id": sample.get("request_id"),
+                "adapter": sample.get("adapter"),
+                "tokens": len(sample["emitted"]),
+                "recorded": recorded,
+                "rescored": rescored,
+                "divergence_match": divergence_match,
+                "reproduced": divergence_match,
+            }
+            if run is not None:
+                row.update(run)
+                row["reproduced"] = divergence_match and run["tokens_match"]
+            rows.append(row)
+            result["reproduced"] = result["reproduced"] and row["reproduced"]
+        result["engines"][engine_name] = {
+            "kv_dtype": kv_dtype,
+            "chaos": replay_cfg.get("chaos", ""),
+            "weights_epoch": replay_cfg.get("weights_epoch", 0),
+            "samples": rows,
+            "reproduced": all(r["reproduced"] for r in rows),
+        }
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("bundle", help="slo-capture-* dir or bundle.json path")
+    ap.add_argument("--no-engine", action="store_true",
+                    help="skip serving re-execution; re-score arms only")
+    ap.add_argument("--max-samples", type=int, default=0,
+                    help="replay at most N samples per engine (0 = all)")
+    ap.add_argument("--init-seed", type=int, default=None,
+                    help="llama.init weight seed (default: the bundle's "
+                         "recorded sampler seed)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full result as JSON instead of a summary")
+    args = ap.parse_args()
+
+    result = replay(args.bundle, run_engine=not args.no_engine,
+                    max_samples=args.max_samples, init_seed=args.init_seed)
+    if args.json:
+        print(json.dumps(result, indent=1, default=str))
+    else:
+        for name, entry in result["engines"].items():
+            print(f"engine {name} (kv={entry['kv_dtype']}, "
+                  f"chaos={entry['chaos'] or 'none'}):")
+            for row in entry["samples"]:
+                verdict = "REPRODUCED" if row["reproduced"] else "MISMATCH"
+                rec = row["recorded"]
+                print(f"  {row.get('request_id') or '<request>'}: {verdict} "
+                      f"(top1_agree={rec.get('top1_agree')}, "
+                      f"first_divergence={rec.get('first_divergence')}, "
+                      f"tokens={row['tokens']})")
+        print("reproduced" if result["reproduced"] else "MISMATCH: see above")
+    return 0 if result["reproduced"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -400,6 +400,52 @@ def test_ci_runs_the_perf_smoke():
         assert kind in runs, f"verdict step never checks the {kind} kind"
 
 
+def test_quality_suite_is_in_quick_tier():
+    """ISSUE 17 satellite: the quality-plane suite — divergence-report
+    math, teacher-forced determinism, the shadow-off/on token-identity
+    contract on both KV layouts with spec on/off, metric label routing,
+    sum-never-average federation, the chaos → burn → bundle → replay
+    round trip, and the preemption/page-refs drill — is CPU-fast and must
+    ride the `-m quick` CI job on every push."""
+    path = REPO / "tests" / "test_quality.py"
+    assert path.exists(), "tests/test_quality.py missing"
+    text = path.read_text()
+    assert "pytestmark = pytest.mark.quick" in text, (
+        "test_quality.py must be quick-marked module-wide"
+    )
+    assert "test_quality.py" not in QUICK_EXEMPT, (
+        "test_quality.py must not be exempted from the quick tier"
+    )
+    # the tentpole's acceptance pieces: deterministic scoring, the
+    # off-is-free contract, the full anomaly loop, and pool hygiene
+    assert "teacher_forced_rows" in text and "divergence_report" in text
+    assert "quality_shadow_rate" in text and "_quality is None" in text
+    assert "quality.corrupt" in text and "replay_bundle" in text
+    assert "observe_quality" in text and "DIGEST_COUNTERS" in text
+    assert "assert_page_refs_consistent" in text
+    assert "app_tpu_spec_accept_ratio" in text
+
+
+def test_ci_runs_the_quality_smoke():
+    """ISSUE 17 satellite: CI must run the quality drill as an EXPLICIT
+    CPU run and assert BOTH verdicts — clean arms at every KV dtype close
+    breach-free, and the chaos-corrupted arm burns, bundles, and replays
+    offline — otherwise the divergence harness can rot between TPU
+    rounds."""
+    ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+    job = ci["jobs"].get("bench-quality-smoke")
+    assert job, "ci.yml has no bench-quality-smoke job"
+    runs = " ".join(step.get("run", "") for step in job.get("steps", []))
+    assert "GOFR_BENCH_PLATFORM=cpu" in runs
+    assert "GOFR_BENCH_QUALITY=1" in runs
+    assert "bench.py" in runs
+    # the verdict step must check both halves of the drill
+    assert "top1_agree_mean" in runs and "quality_breaches" in runs
+    assert "replay_reproduced" in runs and "bundle" in runs
+    for arm in ("bf16", "int8", "int4", "corrupt_int8"):
+        assert arm in runs, f"verdict step never mentions the {arm} arm"
+
+
 def test_ci_has_py310_compat_gate():
     """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
     syntax (same-quote nested f-strings) passes every 3.12 job silently and

@@ -1,0 +1,503 @@
+"""Numerics & quality plane (ISSUE 17): online divergence shadowing against
+a reference path, and deterministic anomaly replay bundles.
+
+Unit tier: the pure scoring helpers — teacher-forced determinism, the
+divergence report math, the serving-arm attention/head hooks — and the
+QualityPlane state machine on a stub model (sampling, drop-oldest
+backpressure, error isolation, metric label routing, SLO wiring).
+
+Engine tier (CPU, tiny config): the OFF-is-free contract (rate 0 never
+constructs the plane and rate 1 never changes emitted tokens — asserted on
+both KV layouts with spec rounds on and off), the spec-acceptance gauge,
+and the full anomaly loop: a chaos-corrupted int8 engine must diverge from
+the reference, burn the quality SLO, write an enriched capture bundle, and
+``scripts/replay_bundle.py`` must reproduce the exact per-token divergence
+offline. A tight-pool preemption drill proves shadow scoring captures
+per-life emitted tokens and leaks no pages (assert_page_refs_consistent).
+
+Federation: the quality counters ride the gossip digest and merge as SUMS
+(fleet agreement = sum(good)/sum(total), never an average of ratios).
+"""
+
+import glob
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.fleet import chaos
+from gofr_tpu.metrics import Registry
+from gofr_tpu.metrics import federation
+from gofr_tpu.metrics.quality import (
+    QualityPlane,
+    divergence_report,
+    make_adapter_head_fn,
+    make_serving_attn_fn,
+    teacher_forced_rows,
+)
+from gofr_tpu.metrics.slo import CaptureWatcher
+from gofr_tpu.models import LlamaConfig, llama
+from gofr_tpu.testutil import assert_page_refs_consistent
+from gofr_tpu.tpu.engine import GenerateEngine
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    params = llama.init(cfg, jax.random.key(7))
+    return cfg, params
+
+
+# -- divergence report math ----------------------------------------------------
+
+
+def _rows(seed: int, t: int = 4, vocab: int = 11) -> np.ndarray:
+    return np.random.RandomState(seed).randn(t, vocab).astype(np.float32)
+
+
+class TestDivergenceReport:
+    def test_identical_rows_full_agreement(self):
+        rows = _rows(0)
+        emitted = rows.argmax(axis=-1)  # engine emitted the ref argmax
+        r = divergence_report(rows, rows.copy(), emitted)
+        assert r["tokens"] == 4
+        assert r["logprob_delta_mean_abs"] == 0.0
+        assert r["logprob_delta_max_abs"] == 0.0
+        assert r["kl_mean"] == 0.0 and r["kl_max"] == 0.0
+        assert r["top1_agree"] == 1.0
+        assert r["first_divergence"] == -1
+        assert r["agree"] == [1, 1, 1, 1]
+
+    def test_disagreement_indexes_first_divergent_token(self):
+        ref = _rows(1)
+        emitted = ref.argmax(axis=-1).copy()
+        # live engine emitted something else from position 2 on
+        emitted[2] = (emitted[2] + 1) % ref.shape[1]
+        emitted[3] = (emitted[3] + 3) % ref.shape[1]
+        r = divergence_report(_rows(2), ref, emitted)
+        assert r["top1_agree"] == 0.5
+        assert r["first_divergence"] == 2
+        assert r["agree"] == [1, 1, 0, 0]
+        # different distributions: KL strictly positive, never negative
+        assert r["kl_max"] >= r["kl_mean"] > 0.0
+
+    def test_top1_compares_reference_argmax_to_emitted(self):
+        # the serving re-score arm agreeing with itself must NOT mask a
+        # live-path corruption: agreement is ref-argmax vs EMITTED token
+        ref = _rows(3)
+        serving = ref.copy()  # arms identical (corruption lives off-path)
+        emitted = (ref.argmax(axis=-1) + 1) % ref.shape[1]
+        r = divergence_report(serving, ref, emitted)
+        assert r["kl_mean"] == 0.0  # arms agree with each other...
+        assert r["top1_agree"] == 0.0  # ...but the live output diverged
+        assert r["first_divergence"] == 0
+
+
+# -- teacher-forced scoring ----------------------------------------------------
+
+
+class TestTeacherForced:
+    def test_deterministic_and_shaped(self, setup):
+        cfg, params = setup
+        prompt, emitted = [2, 5, 7, 11], [3, 4, 9]
+        r1 = teacher_forced_rows(llama, cfg, params, prompt, emitted)
+        r2 = teacher_forced_rows(llama, cfg, params, prompt, emitted)
+        assert r1.shape == (3, cfg.vocab_size)
+        assert r1.dtype == np.float32
+        assert (r1 == r2).all(), "teacher-forced re-score must be bitwise stable"
+
+    def test_rejects_empty_sides(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError):
+            teacher_forced_rows(llama, cfg, params, [], [1])
+        with pytest.raises(ValueError):
+            teacher_forced_rows(llama, cfg, params, [1], [])
+
+    def test_serving_attn_fn_cached_and_dense_is_none(self):
+        assert make_serving_attn_fn("bf16") is None
+        assert make_serving_attn_fn("") is None
+        f1 = make_serving_attn_fn("int8")
+        assert f1 is make_serving_attn_fn("int8"), (
+            "attn_fn must be one cached object per dtype or jit retraces "
+            "the shadow forward on every sample"
+        )
+        with pytest.raises(ValueError):
+            make_serving_attn_fn("fp8")
+
+    def test_int8_arm_differs_from_reference(self, setup):
+        cfg, params = setup
+        prompt, emitted = [2, 5, 7, 11], [3, 4, 9]
+        ref = teacher_forced_rows(llama, cfg, params, prompt, emitted)
+        srv = teacher_forced_rows(llama, cfg, params, prompt, emitted,
+                                  attn_fn=make_serving_attn_fn("int8"))
+        assert srv.shape == ref.shape
+        assert not (srv == ref).all(), "fake-quant KV must perturb the rows"
+        # ...but only slightly: the report over the real arms stays sane
+        r = divergence_report(srv, ref, emitted)
+        assert r["kl_mean"] < 1.0
+
+    def test_zero_lora_delta_is_identity(self, setup):
+        cfg, params = setup
+        prompt, emitted = [2, 5], [3, 4]
+        rank = 2
+        a = np.zeros((cfg.hidden_size, rank), np.float32)
+        b = np.zeros((rank, cfg.vocab_size), np.float32)
+        base = teacher_forced_rows(llama, cfg, params, prompt, emitted)
+        hooked = teacher_forced_rows(llama, cfg, params, prompt, emitted,
+                                     head_fn=make_adapter_head_fn(a, b, 2.0))
+        assert (base == hooked).all(), (
+            "zero LoRA factors through the head hook must be bit-identical"
+        )
+
+
+# -- QualityPlane state machine (stub model, no jit) ---------------------------
+
+
+def _stub_family(vocab: int = 8, fail: bool = False):
+    """family.forward stand-in: logits favour token (position % vocab)."""
+
+    def forward(cfg, params, tokens, lengths, attn_fn=None, head_fn=None):
+        if fail:
+            raise RuntimeError("injected scorer fault")
+        b, s = np.asarray(tokens).shape
+        out = np.zeros((b, s, vocab), np.float32)
+        out[:, np.arange(s), np.arange(s) % vocab] = 5.0
+        if attn_fn is not None:  # the "serving" arm: nudge, don't flip
+            out = out + 0.01
+        return out
+
+    return SimpleNamespace(forward=forward)
+
+
+def _mk_plane(**kw):
+    defaults = dict(
+        family=_stub_family(), cfg=SimpleNamespace(max_seq_len=64),
+        params_fn=lambda: None, rate=1.0, seed=3, kv_dtype="bf16")
+    defaults.update(kw)
+    return QualityPlane(**defaults)
+
+
+class TestQualityPlane:
+    def test_rate_zero_never_samples(self):
+        p = _mk_plane(rate=0.0)
+        assert p.maybe_capture([1, 2], [3, 4]) is False
+        assert p.pending == 0 and p.step() is False
+
+    def test_drop_oldest_bounded_backpressure(self):
+        p = _mk_plane(max_pending=2)
+        for i in range(5):
+            assert p.maybe_capture([1, 2], [3, 4], request_id=f"r{i}")
+        assert p.pending == 2 and p.dropped == 3
+        # the two newest survive the eviction
+        while p.step():
+            pass
+        ids = [e["request_id"] for e in p.snapshot()["recent"]]
+        assert ids == ["r3", "r4"]
+
+    def test_step_scores_one_arm_per_call(self):
+        p = _mk_plane()
+        p.maybe_capture([1, 2], [3, 4], qos_class="batch")
+        assert p.step() is True  # serving arm
+        assert p.samples == 0 and p.pending == 1  # still inflight
+        assert p.step() is True  # reference arm + finalize
+        assert p.samples == 1 and p.pending == 0
+        assert p.step() is False  # idle again
+
+    def test_scorer_faults_counted_never_raised(self):
+        p = _mk_plane(family=_stub_family(fail=True))
+        p.maybe_capture([1, 2], [3, 4])
+        assert p.step() is True
+        assert p.errors == 1 and p.samples == 0 and p.pending == 0
+
+    def test_metric_label_routing_and_slo_wiring(self):
+        reg = Registry()
+        reg.new_histogram("app_tpu_quality_logprob_delta")
+        reg.new_histogram("app_tpu_quality_kl")
+        reg.new_gauge("app_tpu_quality_top1_agree")
+        reg.new_histogram("app_tpu_quality_first_divergence_token")
+        reg.new_counter("app_tpu_quality_samples_total")
+        reg.new_counter("app_tpu_quality_good_total")
+        seen = []
+        slo = SimpleNamespace(
+            observe_quality=lambda cls_name, ok: seen.append((cls_name, ok)))
+        p = _mk_plane(metrics=reg, slo=slo, kv_dtype="int8",
+                      backend_fn=lambda: "pallas")
+        # the stub scores position j as token j%vocab; emitted rows cover
+        # absolute positions 1..2, so [1, 2] agrees with the "reference"
+        p.maybe_capture([1, 2], [1, 2], qos_class="interactive")
+        while p.step():
+            pass
+        assert p.samples == 1
+        (ls, v), = reg.get("app_tpu_quality_samples_total").series()
+        assert v == 1.0
+        labels = dict(ls)
+        assert labels == {"kv_dtype": "int8", "backend": "pallas",
+                          "adapter": "base"}
+        assert seen == [("interactive", True)]
+        # good rides the same label set so the fleet ratio divides cleanly
+        (ls_g, v_g), = reg.get("app_tpu_quality_good_total").series()
+        assert ls_g == ls and v_g == 1.0
+
+    def test_snapshot_replay_payload_trimmable(self):
+        p = _mk_plane()
+        p.maybe_capture([1, 2, 3], [4, 5], request_id="r0")
+        while p.step():
+            pass
+        full = p.snapshot()["recent"][0]
+        assert full["prompt"] == [1, 2, 3] and full["emitted"] == [4, 5]
+        assert full["report"]["tokens"] == 2
+        slim = p.snapshot(replay=False)["recent"][0]
+        assert "prompt" not in slim and "emitted" not in slim
+        assert slim["report"]["tokens"] == 2  # the stats stay
+
+
+# -- federation: sums, never averages ------------------------------------------
+
+
+def test_quality_counters_federate_as_sums():
+    for name in ("app_tpu_quality_samples_total", "app_tpu_quality_good_total"):
+        assert name in federation.DIGEST_COUNTERS, (
+            f"{name} must ride the gossip digest")
+    # unevenly loaded replicas: r1 scored 100 samples at 90% agreement,
+    # r2 scored 10 at 10% — the fleet number must be 91/110, not the
+    # traffic-blind average of ratios (0.5)
+    digests = {}
+    for replica, (good, total) in (("r1", (90, 100)), ("r2", (1, 10))):
+        reg = Registry()
+        reg.new_counter("app_tpu_quality_samples_total")
+        reg.new_counter("app_tpu_quality_good_total")
+        reg.increment_counter("app_tpu_quality_samples_total", total,
+                              kv_dtype="int8", backend="xla", adapter="base")
+        reg.increment_counter("app_tpu_quality_good_total", good,
+                              kv_dtype="int8", backend="xla", adapter="base")
+        digests[replica] = federation.digest(reg)
+    agg_total, _ = federation._merge_counters(
+        "app_tpu_quality_samples_total", digests)
+    agg_good, _ = federation._merge_counters(
+        "app_tpu_quality_good_total", digests)
+    (ls, total), = agg_total.items()
+    assert total == 110.0 and agg_good[ls] == 91.0
+    assert dict(ls)["kv_dtype"] == "int8"
+    fleet = agg_good[ls] / total
+    assert fleet == pytest.approx(91 / 110)
+    assert abs(fleet - (0.9 + 0.1) / 2) > 0.3
+
+
+# -- chaos spec round trip -----------------------------------------------------
+
+
+def test_chaos_active_spec_reserializes_overrides():
+    assert chaos.active_spec() == ""
+    with chaos.override("quality.corrupt:drop,factor=8"):
+        assert chaos.active_spec() == "quality.corrupt:drop,factor=8"
+    assert chaos.active_spec() == ""
+
+
+# -- engine tier ---------------------------------------------------------------
+
+
+PROMPTS = [[2, 5, 7, 11], [3, 4, 9], [1, 8, 6, 2, 9]]
+
+
+def _serve(engine, n_new=6):
+    out = []
+    for p in PROMPTS:
+        out.append(engine.generate(p, max_new_tokens=n_new, temperature=0.0,
+                                   timeout=120)["tokens"])
+    return out
+
+
+@pytest.mark.parametrize("layout_kw,spec", [
+    (dict(), 0),
+    (dict(), 2),
+    (dict(kv_layout="paged", page_size=8, total_pages=64, kv_quantize="int8"), 0),
+    (dict(kv_layout="paged", page_size=8, total_pages=64, kv_quantize="int8"), 2),
+], ids=["slot-bf16", "slot-bf16-spec", "paged-int8", "paged-int8-spec"])
+def test_shadow_off_is_free_and_on_is_invisible(setup, layout_kw, spec):
+    """rate=0: the plane is never constructed (one branch on the idle loop,
+    bit-identical engine). rate=1: shadow scoring must not perturb a single
+    emitted token — it is teacher-forced on idle capacity, never sampling."""
+    cfg, params = setup
+    kw = dict(slots=2, max_len=64, spec_tokens=spec, **layout_kw)
+    off = GenerateEngine(llama, cfg, params, new_mock_container(), **kw)
+    try:
+        want = _serve(off)
+        assert off._quality is None, "rate 0 must not construct the plane"
+    finally:
+        off.stop()
+    on = GenerateEngine(llama, cfg, params, new_mock_container(),
+                        quality_shadow_rate=1.0, **kw)
+    try:
+        got = _serve(on)
+        assert got == want, "shadow-on run emitted different tokens"
+        assert on._quality.drain(120), "idle loop never scored the backlog"
+        snap = on.quality_snapshot()
+        assert snap["samples"] == len(PROMPTS) and snap["errors"] == 0
+        assert snap["kv_dtype"] == layout_kw.get("kv_quantize", "bf16")
+        for e in snap["recent"]:
+            assert e["report"]["tokens"] >= 1
+    finally:
+        on.stop()
+
+
+def test_spec_accept_ratio_gauge_samples_at_scrape(setup):
+    cfg, params = setup
+    cont = new_mock_container()
+    eng = GenerateEngine(llama, cfg, params, cont, slots=2, max_len=64,
+                         kv_layout="paged", page_size=8, total_pages=64,
+                         kv_quantize="int8", spec_tokens=2)
+    cont.register_engine("lm", eng)
+    try:
+        _serve(eng)
+        totals = eng.spec_accept_totals()
+        (adapter, (acc, prop)), = totals.items()
+        assert adapter == "base" and prop > 0 and 0 <= acc <= prop
+        text = cont.metrics.expose_text()  # scrape: collect hooks run here
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("app_tpu_spec_accept_ratio{")]
+        assert line and 'adapter="base"' in line[0]
+        ratio = float(line[0].rsplit(" ", 1)[1])
+        assert ratio == pytest.approx(acc / prop)
+    finally:
+        eng.stop()
+
+
+def test_chaos_corruption_burns_bundles_and_replays(setup, tmp_path):
+    """The whole anomaly loop on one engine: quality.corrupt perturbs the
+    int8 dequant scales inside the compiled gather, the shadow scorer sees
+    reference/emitted disagreement, the quality SLO burns, the capture
+    bundle carries the replay payload, and the offline replayer reproduces
+    the exact per-token divergence (and the exact tokens) from the bundle
+    alone."""
+    cfg, params = setup
+    cap = str(tmp_path / "cap")
+    conf = {
+        "SLO_DEFAULT_QUALITY": "0.99", "SLO_MIN_SAMPLES": "2",
+        "SLO_BURN_THRESHOLD": "2", "SLO_CHECK_INTERVAL_S": "0",
+        "SLO_CAPTURE": "true", "SLO_CAPTURE_DIR": cap,
+        "SLO_CAPTURE_MIN_INTERVAL_S": "0.01", "SLO_CAPTURE_BURST": "4",
+    }
+    with chaos.override("quality.corrupt:drop,factor=8"):
+        cont = new_mock_container(dict(conf))
+        eng = GenerateEngine(llama, cfg, params, cont, slots=2, max_len=64,
+                             kv_layout="paged", page_size=8, total_pages=64,
+                             kv_quantize="int8", quality_shadow_rate=1.0)
+        cont.register_engine("lm", eng)
+        try:
+            _serve(eng)
+            assert eng._quality.drain(120)
+            snap = eng.quality_snapshot()
+        finally:
+            eng.stop()
+    assert snap["samples"] == len(PROMPTS)
+    assert snap["good"] < snap["samples"], "corruption must fail thresholds"
+    assert any(e["report"]["top1_agree"] < 0.9 for e in snap["recent"])
+    assert any(e["report"]["first_divergence"] >= 0 for e in snap["recent"])
+    # the snapshot records everything replay needs, including the armed spec
+    assert snap["replay"]["chaos"] == "quality.corrupt:drop,factor=8"
+    assert snap["replay"]["seed"] == eng._seed
+    assert "adapter_digest" in snap["replay"] and "fingerprint" in snap["replay"]
+    qb = [b for b in cont.slo.breaches() if b.get("objective") == "quality"]
+    assert qb, "quality burn never fired"
+    bundles = sorted(glob.glob(os.path.join(cap, "slo-capture-*")))
+    assert bundles, "burn fired but no capture bundle was written"
+    with open(os.path.join(bundles[-1], "bundle.json")) as f:
+        bundle = json.load(f)
+    assert "quality" in bundle and "lm" in bundle["quality"]
+    assert bundle["quality"]["lm"]["recent"], "bundle lost the replay payload"
+
+    import importlib
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    try:
+        replay_bundle = importlib.import_module("replay_bundle")
+    finally:
+        _sys.path.pop(0)
+    res = replay_bundle.replay(bundles[-1], run_engine=True, params=params,
+                               max_samples=2)
+    assert res["reproduced"] is True, res
+    rows = res["engines"]["lm"]["samples"]
+    assert rows and all(r["tokens_match"] for r in rows)
+    assert all(r["divergence_match"] for r in rows)
+    # replay must diff against the RECORDED report, not trivially agree
+    assert any(r["recorded"]["first_divergence"] >= 0 for r in rows)
+
+
+def test_preemption_keeps_shadow_consistent(setup):
+    """Minimum-legal paged pool so preemption-by-recompute fires mid-run:
+    shadow capture must record each request's per-life emitted tokens (a
+    contiguous run of the final output — the requeued prompt already
+    carries prior generations), score them all without errors, and leave
+    the page refcounts consistent (the plane claims no pool state)."""
+    cfg, params = setup
+    rngs = np.random.RandomState(11)
+    prompts = []
+    for i in range(10):  # every 3rd arrival long enough to contend the pool
+        n = 17 + (i % 2) * 4 if i % 3 == 2 else 2 + i % 4
+        prompts.append([int(x) for x in rngs.randint(1, 200, size=n)])
+    eng = GenerateEngine(llama, cfg, params, new_mock_container(),
+                         slots=3, max_len=64, max_prefill_batch=2,
+                         prefill_buckets=[8], kv_layout="paged",
+                         page_size=8, total_pages=9,
+                         quality_shadow_rate=1.0, quality_max_pending=16)
+    try:
+        reqs = []
+        for p in prompts:  # paced arrivals, not one up-front burst
+            time.sleep(0.01)
+            reqs.append(eng.submit(p, max_new_tokens=16, timeout=300))
+        results = [r.result(300) for r in reqs]
+        pre = eng.metrics.get("app_tpu_preemptions")
+        assert pre is not None and sum(pre._values.values()) >= 1, (
+            "pool was not small enough to exercise preemption")
+        assert eng._quality.drain(300)
+        snap = eng.quality_snapshot()
+        assert snap["samples"] == len(prompts) and snap["errors"] == 0
+        by_id = {r.id: res["tokens"] for r, res in zip(reqs, results)}
+        matched = 0
+        for e in snap["recent"]:
+            toks = by_id.get(e["request_id"])
+            assert toks is not None, "sample keyed by unknown request id"
+            matched += 1
+            em, n = e["emitted"], len(e["emitted"])
+            assert any(toks[i:i + n] == em
+                       for i in range(len(toks) - n + 1)), (
+                "captured emitted tokens are not a contiguous run of the "
+                f"request output: {em} vs {toks}")
+        assert matched == len(prompts)
+        assert_page_refs_consistent(eng)
+    finally:
+        eng.stop()
+
+
+# -- capture retention ---------------------------------------------------------
+
+
+def test_capture_retention_sweeps_oldest(tmp_path):
+    cont = new_mock_container({"SLO_CAPTURE": "true",
+                               "SLO_CAPTURE_DIR": str(tmp_path),
+                               "SLO_CAPTURE_MAX_BUNDLES": "2"})
+    w = cont.slo_capture
+    assert isinstance(w, CaptureWatcher) and w.max_bundles == 2
+    for i in range(5):
+        d = tmp_path / f"slo-capture-20260807-00000{i}-000"
+        d.mkdir()
+        (d / "bundle.json").write_text("{}")
+    keeper = tmp_path / "not-a-capture"
+    keeper.mkdir()
+    w._sweep()
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == ["not-a-capture",
+                    "slo-capture-20260807-000003-000",
+                    "slo-capture-20260807-000004-000"], left
+    # 0 disables retention entirely (the pre-retention behavior)
+    w.max_bundles = 0
+    w._sweep()
+    assert sorted(p.name for p in tmp_path.iterdir()) == left
