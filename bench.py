@@ -1423,6 +1423,135 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             extra["disagg"] = f"error: {e}"[:160]
 
+    # streaming KV handoff A/B (ISSUE 18): blob (GOFR-HANDOFF1, streams=0)
+    # vs streaming (GOFR-HANDOFF2) across prompt-length buckets. The wire
+    # is emulated via HANDOFF_PACE_MBPS, calibrated to 0.75x the measured
+    # per-chunk prefill compute so transfer CAN hide behind compute: the
+    # blob arm's decode-side TTFT then grows linearly in pages (the whole
+    # frame ships after activation) while the streaming arm's stays flat
+    # (only the in-flight tail remains at activation) — the flattening IS
+    # the perf claim, asserted by the bench-handoff-smoke CI job.
+    if os.environ.get("GOFR_BENCH_HANDOFF_STREAM") == "1":
+        from gofr_tpu.container import new_mock_container as _fresh_container
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        h_page = 8 if on_cpu else 128
+        # prompt length in pages; the top bucket must clear the model's
+        # max_seq_len (tiny CPU config caps at 120 positions)
+        h_buckets = [2, 4, 8, 12]
+        h_reps = int(os.environ.get("GOFR_BENCH_HANDOFF_REPS", "3"))
+        h_new = 4
+
+        def _handoff_kw(**over) -> dict:
+            kw = dict(engine_kw(*best))
+            # chunked prefill at one page per chunk, one page per wire
+            # chunk: maximum overlap granularity for the streaming arm
+            kw.update(kv_layout="paged", page_size=h_page,
+                      total_pages=max(64, 4 * h_buckets[-1]),
+                      max_len=h_buckets[-1] * h_page + h_new + 8,
+                      prefill_buckets=[h_page], handoff_chunk_pages=1)
+            kw.update(over)
+            return kw
+
+        h_prompts = {b: [rng.randint(1, cfg.vocab_size,
+                                     size=b * h_page).tolist()
+                         for _ in range(h_reps)] for b in h_buckets}
+
+        def _ls_slope(xs: list, ys: list) -> float:
+            xm = sum(xs) / len(xs)
+            ym = sum(ys) / len(ys)
+            den = sum((x - xm) ** 2 for x in xs) or 1e-12
+            return sum((x - xm) * (y - ym) for x, y in zip(xs, ys)) / den
+
+        def _run_handoff_arm(streams: int, pace: float, colo_toks: dict):
+            dec = GenerateEngine(llama, cfg, params, _fresh_container(),
+                                 role="decode", **_handoff_kw())
+            pre = GenerateEngine(
+                llama, cfg, params, _fresh_container(), role="prefill",
+                handoff_target=dec.handoff_addr,
+                **_handoff_kw(handoff_streams=streams,
+                              handoff_pace_mbps=pace))
+            exact = True
+            by_bucket: dict = {}
+            try:
+                for e in (pre, dec):
+                    e.warmup()
+                    e.start()
+                for b in h_buckets:
+                    ttfts = []
+                    for i, p in enumerate(h_prompts[b]):
+                        t_sub = time.monotonic()
+                        res = pre.generate(p, max_new_tokens=h_new,
+                                           timeout=timeout)
+                        t_done = time.monotonic()
+                        if res.get("finish_reason") != "handoff":
+                            raise RuntimeError(
+                                "prefill worker decoded locally: "
+                                f"{res.get('finish_reason')}")
+                        # decode-side TTFT: the tail between activation and
+                        # transfer-complete (what the blob protocol pays in
+                        # full, the stream only for in-flight chunks) plus
+                        # the decode worker's own prefix-hit first step
+                        tail = max(0.0, (t_done - t_sub) - res["ttft_s"])
+                        out = dec.generate(p, max_new_tokens=h_new,
+                                           timeout=timeout)
+                        ttfts.append(tail + out["ttft_s"])
+                        want = colo_toks[b][i]
+                        if out["tokens"] != want or res["tokens"] != [want[0]]:
+                            exact = False
+                    by_bucket[str(b)] = {
+                        "p50_s": round(_percentile(ttfts, 50), 4),
+                        "p99_s": round(_percentile(ttfts, 99), 4)}
+                p50s = [by_bucket[str(b)]["p50_s"] for b in h_buckets]
+                st = pre.handoff_stats().get("export") or {}
+                return {
+                    "ttft_decode_by_bucket_pages": by_bucket,
+                    "flatness_p50": round(p50s[-1] / max(p50s[0], 1e-9), 3),
+                    "slope_s_per_page": round(
+                        _ls_slope([float(b) for b in h_buckets], p50s), 6),
+                    "mode": st.get("mode"), "streams": st.get("streams"),
+                    "overlap_ratio": st.get("overlap_ratio"),
+                    "overlap_bytes": st.get("overlap_bytes"),
+                }, exact
+            finally:
+                pre.stop()
+                dec.stop()
+
+        try:
+            # colocated reference: token-exact oracle + per-chunk compute
+            # calibration for the emulated wire
+            colo = GenerateEngine(llama, cfg, params, _fresh_container(),
+                                  **_handoff_kw())
+            colo_toks: dict = {}
+            try:
+                colo.warmup()
+                colo.start()
+                rcal = colo.generate(h_prompts[h_buckets[-1]][0],
+                                     max_new_tokens=1, timeout=timeout)
+                per_chunk = max(1e-4, rcal["ttft_s"] / h_buckets[-1])
+                for b in h_buckets:
+                    colo_toks[b] = [
+                        colo.generate(p, max_new_tokens=h_new,
+                                      timeout=timeout)["tokens"]
+                        for p in h_prompts[b]]
+                page_bytes = int(colo._page_bytes)
+            finally:
+                colo.stop()
+            wire_per_page = 0.75 * per_chunk
+            pace = page_bytes / (wire_per_page * 1e6)
+            blob_arm, blob_exact = _run_handoff_arm(0, pace, colo_toks)
+            stream_arm, stream_exact = _run_handoff_arm(2, pace, colo_toks)
+            extra["handoff_stream"] = {
+                "page_size": h_page, "reps": h_reps,
+                "buckets_pages": h_buckets,
+                "per_chunk_s": round(per_chunk, 5),
+                "pace_mbps": round(pace, 3),
+                "blob": blob_arm, "stream": stream_arm,
+                "token_exact": bool(blob_exact and stream_exact),
+            }
+        except Exception as e:  # noqa: BLE001
+            extra["handoff_stream"] = f"error: {e}"[:160]
+
     # multi-LoRA consolidation A/B (ISSUE 16): the COGS question — what
     # does serving N tenants' adapters cost on ONE multiplexed engine vs
     # N dedicated engines? Both arms serve the identical seeded workload

@@ -801,6 +801,15 @@ class App:
                             if k in kinds}
                     joined[op] = {"pin": rec, "roofline": roof or None}
                 snap["autotune"] = joined
+            ho_fn = getattr(engine, "handoff_stats", None)
+            ho = ho_fn() if callable(ho_fn) else None
+            if ho and ("export" in ho or "import" in ho):
+                # disaggregation transfer plane (tpu/handoff.py): mode,
+                # negotiated stream count, per-stream bytes/seconds and
+                # the overlap ratio join the roofline view — "is the
+                # handoff hiding behind prefill compute?" from the same
+                # endpoint as "is the device starved?"
+                snap["handoff"] = ho
             engines[name] = snap
         totals = self.container.perf_totals()
         fleet = None

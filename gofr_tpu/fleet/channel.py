@@ -83,6 +83,43 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+# sendmsg takes at most IOV_MAX iovecs per call (1024 on Linux); batching
+# below it keeps one syscall per chunk without ever tripping EMSGSIZE
+_IOV_BATCH = 64
+
+
+def sendmsg_all(sock: socket.socket, parts) -> int:
+    """Vectored (scatter-gather) send: write every buffer in ``parts`` in
+    order WITHOUT joining them into one bytes object — the ``sendmsg``
+    analog of ``sendall``, handling partial writes by slicing memoryviews
+    rather than re-packing. This is the zero-repack framing path shared by
+    the fleet announce channel and the KV handoff streams (tpu/handoff.py):
+    a multi-MB page frame goes out as [header, meta, plane, plane, ...]
+    views over the original arrays, never as one concatenated copy.
+    Returns the total bytes written."""
+    bufs = []
+    for p in parts:
+        mv = p if isinstance(p, memoryview) else memoryview(p)
+        if mv.nbytes:
+            bufs.append(mv.cast("B") if mv.format != "B" or mv.ndim != 1 else mv)
+    total = sum(b.nbytes for b in bufs)
+    while bufs:
+        try:
+            sent = sock.sendmsg(bufs[:_IOV_BATCH])
+        except AttributeError:  # platform without sendmsg: degrade loudly-simple
+            for b in bufs:
+                sock.sendall(b)
+            return total
+        while sent:
+            if sent >= bufs[0].nbytes:
+                sent -= bufs[0].nbytes
+                bufs.pop(0)
+            else:
+                bufs[0] = bufs[0][sent:]
+                sent = 0
+    return total
+
+
 class FleetLeaderChannel:
     """Leader end: listens for follower dials, fans every announce out to
     the active follower set. ``send`` runs on the engine's device thread
@@ -240,9 +277,13 @@ class FleetLeaderChannel:
         lost = []
         for conn in conns:
             try:
-                conn.sendall(head)
+                # one vectored write per follower: header + payload go out
+                # in a single syscall instead of two sendalls (the small
+                # head would otherwise ride its own TCP segment)
                 if body is not None:
-                    conn.sendall(body)
+                    sendmsg_all(conn, (head, body))
+                else:
+                    conn.sendall(head)
             except OSError as e:
                 lost.append(conn)
                 if self.logger is not None:

@@ -63,6 +63,11 @@ DIGEST_COUNTERS: tuple[str, ...] = (
     # exactly, never an average of per-replica agreement ratios
     "app_tpu_quality_samples_total",
     "app_tpu_quality_good_total",
+    # KV handoff transfer plane (tpu/handoff.py): raw byte counters so the
+    # fleet overlap ratio is sum(overlap)/sum(bytes) exactly — same
+    # sum-of-parts discipline as the quality rollup above
+    "app_tpu_kv_handoff_bytes_total",
+    "app_tpu_kv_handoff_overlap_bytes_total",
 )
 DIGEST_HISTOGRAMS: tuple[str, ...] = (
     "app_tpu_ttft_seconds",
@@ -250,8 +255,30 @@ def fleet_text(digests: Mapping[str, Mapping[str, Any]],
 
     _slo_lines(digests, lines)
     _perf_lines(digests, lines)
+    _handoff_lines(digests, lines)
     _state_lines(digests, states or {}, lines)
     return "\n".join(lines) + "\n"
+
+
+def _handoff_lines(digests: Mapping[str, Mapping[str, Any]],
+                   lines: list[str]) -> None:
+    """Fleet KV-handoff overlap ratio, derived from the digests' byte
+    counters (export side): sum(overlap bytes)/sum(total bytes) across
+    every prefill replica — the streaming pipeline's fleet-wide "how much
+    transfer hid behind prefill compute", never an average of per-replica
+    ratios."""
+    bytes_agg, _ = _merge_counters("app_tpu_kv_handoff_bytes_total", digests)
+    total = sum(v for ls, v in bytes_agg.items()
+                if ("side", "export") in ls)
+    if total <= 0:
+        return
+    over_agg, _ = _merge_counters(
+        "app_tpu_kv_handoff_overlap_bytes_total", digests)
+    overlap = sum(v for ls, v in over_agg.items()
+                  if ("side", "export") in ls)
+    lines.append("# TYPE app_tpu_kv_handoff_overlap_ratio gauge")
+    lines.append(
+        f"app_tpu_kv_handoff_overlap_ratio {_fmt_value(overlap / total)}")
 
 
 def _slo_lines(digests: Mapping[str, Mapping[str, Any]],

@@ -847,7 +847,8 @@ class _Slot:
 
     __slots__ = ("request", "prompt_len", "pos", "generated", "max_total", "eos",
                  "last_token", "first_token_at", "admit_seq", "prompt_tokens",
-                 "written", "dispatched", "inflight", "adapter_id", "adapter_slot")
+                 "written", "dispatched", "inflight", "adapter_id", "adapter_slot",
+                 "handoff")
 
     def __init__(self, request: Request, prompt_len: int, max_total: int, eos: int | None,
                  first_token: int | None, admit_seq: int = 0, prompt_tokens: Any = None,
@@ -875,6 +876,10 @@ class _Slot:
         # reserved all-zeros adapter — bit-identical to no adapters)
         self.adapter_id = adapter_id
         self.adapter_slot = adapter_slot
+        # streaming KV handoff transfer (prefill role, tpu/handoff.py
+        # StreamTransfer): pages of a still-prefilling slot ship per
+        # chunk fold instead of all-at-once at activation
+        self.handoff = None
 
     @property
     def prefilling(self) -> bool:
@@ -946,6 +951,9 @@ class GenerateEngine(_EngineBase):
         handoff_target: str | None = None,
         handoff_listen: str | None = None,
         handoff_timeout_s: float = 5.0,
+        handoff_streams: int = 2,
+        handoff_chunk_pages: int = 4,
+        handoff_pace_mbps: float = 0.0,
         adapter_slots: int = 0,
         adapter_rank: int = 16,
         adapter_pool_mb: float = 0.0,
@@ -1548,6 +1556,13 @@ class GenerateEngine(_EngineBase):
         # the decode pool is still coming up). handoff_addr rides the
         # gossip snapshot so the router's fleet view can show the wiring.
         self.handoff_timeout_s = float(handoff_timeout_s)
+        # GOFR-HANDOFF2 streaming knobs (docs/serving.md "Streaming
+        # handoff"): streams=0 forces the HANDOFF1 blob path outright;
+        # chunk_pages batches staged pages per wire chunk; pace_mbps is
+        # the emulated/egress bandwidth cap (0 = off)
+        self.handoff_streams = max(0, int(handoff_streams))
+        self.handoff_chunk_pages = max(1, int(handoff_chunk_pages))
+        self.handoff_pace_mbps = max(0.0, float(handoff_pace_mbps))
         self._handoff_exporter = None
         self._handoff_server = None
         self.handoff_addr = ""
@@ -1567,6 +1582,9 @@ class GenerateEngine(_EngineBase):
                 self._handoff_exporter = HandoffExporter(
                     handoff_target, engine=self,
                     timeout_s=self.handoff_timeout_s,
+                    streams=self.handoff_streams,
+                    chunk_pages=self.handoff_chunk_pages,
+                    pace_mbps=self.handoff_pace_mbps,
                     logger=self.logger, metrics=self.metrics)
             else:
                 self.logger.warn(
@@ -2524,6 +2542,14 @@ class GenerateEngine(_EngineBase):
             self.metrics.set_gauge("app_tpu_kv_pages_free", len(self._free_pages))
         if s is not None and s.adapter_slot and self._adapter_pool is not None:
             self._adapter_pool.release(s.adapter_slot)
+        if s is not None and s.handoff is not None:
+            # a mid-prefill streaming transfer whose slot died (preemption,
+            # cancel, deadline): tear down the WIRE state only — the
+            # request itself is settled by whoever freed the slot, and a
+            # preempted prompt re-prefills and re-streams from page 0
+            # (the importer touch-skips pages it already holds)
+            t, s.handoff = s.handoff, None
+            self._handoff_exporter.abort(t)
 
     def _set_prefix_gauges(self) -> None:
         """One authoritative write of every prefix-cache occupancy gauge —
@@ -2831,6 +2857,33 @@ class GenerateEngine(_EngineBase):
         self._emit(s, tok)
         self._maybe_finish(idx)
 
+    def _stream_handoff_chunk(self, idx: int, s: _Slot) -> None:
+        """Streaming handoff, mid-prefill half (caller holds the state
+        lock, the slot just folded a NON-final chunk): stage every newly
+        full page's gather on the slot's StreamTransfer and kick the
+        exporter thread. The gathers are dispatched HERE, under the lock,
+        so they capture the page contents before preemption or eviction
+        could recycle a page (the `_evict_prefix_page` discipline); the
+        exporter blocks on them — device→host readback — outside every
+        engine lock, overlapped with the prompt's next chunk's compute."""
+        exp = self._handoff_exporter
+        if (exp is None or self.handoff_streams <= 0 or self._prefix is None
+                or self.kv_layout != "paged" or exp.known_blob()):
+            return  # blob peer or blob config: pages ship at activation
+        n_full = min(s.written, s.prompt_len) // self.page_size
+        t = s.handoff
+        if t is None:
+            if n_full == 0:
+                return  # no full page yet; nothing to ship
+            t = s.handoff = exp.begin_stream(
+                s.request, np.asarray(s.prompt_tokens), self._page_bytes,
+                time.monotonic())
+        ready = min(n_full, len(self._slot_pages[idx]))
+        if ready > t.staged_pages:
+            t.add(executor.gather_pages(
+                self, self._slot_pages[idx][t.staged_pages:ready]))
+            exp.kick(t)
+
     def _export_handoff(self, idx: int, s: _Slot, tok: int, now: float) -> bool:
         """Prefill-role terminal: ship the slot's full KV pages to the decode
         pool and complete the request with just its first token
@@ -2843,19 +2896,43 @@ class GenerateEngine(_EngineBase):
         dispatched HERE, under the state lock, so they capture the cache
         value before any later step can recycle a page (the
         `_evict_prefix_page` discipline — JAX's functional updates make the
-        gathered payload immune to subsequent pool writes)."""
+        gathered payload immune to subsequent pool writes).
+
+        With streaming negotiated (GOFR-HANDOFF2) most pages already left
+        during the chunk folds (`_stream_handoff_chunk`); this terminal
+        stages only the tail, detaches the transfer from the slot (so
+        `_free_slot` doesn't abort it) and hands the exporter the first
+        token to close the stream with."""
         exp = self._handoff_exporter
         if exp is None or self._prefix is None:
             return False
         n_full = s.prompt_len // self.page_size
         if n_full == 0 or len(self._slot_pages[idx]) < n_full:
+            if s.handoff is not None:
+                t, s.handoff = s.handoff, None
+                exp.abort(t)  # partial stream of a slot that fell back
             return False
         pages = self._slot_pages[idx][:n_full]
-        payloads = executor.gather_pages(self, pages)
         rt = s.request.kw.get("_rt")
         if rt is not None:
             rt.end("engine.decode")
             rt.begin("engine.handoff", **{"pages": n_full})
+        if self.handoff_streams > 0 and not exp.known_blob():
+            # streaming path (also carries the negotiated-down blob case:
+            # the exporter accumulates and ships one frame at finish)
+            t = s.handoff
+            if t is None:
+                t = exp.begin_stream(
+                    s.request, np.asarray(s.prompt_tokens),
+                    self._page_bytes, now)
+            else:
+                s.handoff = None  # detach BEFORE _free_slot's abort hook
+            if n_full > t.staged_pages:
+                t.add(executor.gather_pages(self, pages[t.staged_pages:]))
+            self._free_slot(idx)
+            exp.finish(t, tok, now)
+            return True
+        payloads = executor.gather_pages(self, pages)
         self._free_slot(idx)
         from gofr_tpu.tpu.handoff import HandoffJob
 
@@ -3150,6 +3227,11 @@ class GenerateEngine(_EngineBase):
                     rt.end("engine.prefill")
                     rt.begin("engine.decode", **{"slot": idx})
                 self._activate_lane(idx, s, int(first[0]), time.monotonic())
+            elif self.role == "prefill":
+                # streaming handoff (GOFR-HANDOFF2): pages this fold just
+                # made durable start shipping NOW, overlapped with the
+                # prompt's remaining prefill chunks still on the device
+                self._stream_handoff_chunk(idx, s)
 
     def _admit(self) -> bool:
         """Admission round: plan/claim/dispatch prefills, then dispatch any
@@ -3868,6 +3950,14 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             "handoff_listen", conf.get_or_default("HANDOFF_LISTEN", "")) or None
         handoff_timeout = float(kw.pop(
             "handoff_timeout_s", conf.get_float("HANDOFF_TIMEOUT_S", 5.0)))
+        # GOFR-HANDOFF2 streaming pipeline knobs (docs/serving.md):
+        # HANDOFF_STREAMS=0 pins the exporter to HANDOFF1 blob framing
+        handoff_streams = int(kw.pop(
+            "handoff_streams", conf.get_int("HANDOFF_STREAMS", 2)))
+        handoff_chunk_pages = int(kw.pop(
+            "handoff_chunk_pages", conf.get_int("HANDOFF_CHUNK_PAGES", 4)))
+        handoff_pace = float(kw.pop(
+            "handoff_pace_mbps", conf.get_float("HANDOFF_PACE_MBPS", 0.0)))
         return GenerateEngine(
             family, cfg, params, container,
             slots=int(kw.pop("slots", conf.get_int("ENGINE_SLOTS", 8))),
@@ -3902,6 +3992,9 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             handoff_target=handoff_target,
             handoff_listen=handoff_listen,
             handoff_timeout_s=handoff_timeout,
+            handoff_streams=handoff_streams,
+            handoff_chunk_pages=handoff_chunk_pages,
+            handoff_pace_mbps=handoff_pace,
             # multi-LoRA adapter plane (gofr_tpu.adapters, docs/serving.md):
             # off by default — both spellings disabled keeps the engine
             # byte-identical to the pre-adapter build

@@ -1,4 +1,5 @@
-"""Prefill→decode paged-KV handoff for disaggregated serving (ISSUE 12).
+"""Prefill→decode paged-KV handoff for disaggregated serving (ISSUE 12;
+streaming pipeline ISSUE 18).
 
 Role-split engines (``ENGINE_ROLE`` — tpu/engine.py) separate the two
 phases continuous batching otherwise interleaves on one device: a
@@ -9,43 +10,59 @@ prompt gets a prefix hit and the page upload rides the existing
 ``swapin`` kind on the unified in-flight queue ``_dq`` — the transfer
 overlaps live decode steps instead of stalling them.
 
-Wire format (own magic; the framing discipline — length prefix, exact
-reads, loud size cap — is fleet/channel.py's): the one-time JOIN is
-``_MAGIC`` followed by a hello frame ``<i len> <JSON {"kv_dtype": ...}>``
-naming the exporter's KV pool dtype (``bf16`` | ``int8`` | ``int4`` —
-``ENGINE_KV_DTYPE``); the server ACKs ``<i status>`` and REJECTS a
-mismatched peer right there, because a page payload quantized for one
-pool layout is garbage in another (the int4 planes are packed nibbles —
-shape-compatible with nothing else, but int8 vs bf16 could otherwise
-fail only deep inside ``handoff_import``'s shape check, after megabytes
-moved). After JOIN, each KV frame is::
+Two wire modes share one JOIN (``_MAGIC`` + hello + int32 ACK), and the
+ACK **is** the version negotiation:
 
-    <i meta_nbytes> <meta JSON> <payload bytes>
+- **GOFR-HANDOFF1 (blob)**: the original protocol. After ``ACK_OK``,
+  each transfer is ONE frame ``<i meta_nbytes><meta JSON><payload>``
+  carrying every page of the prompt — sent only after the whole prefill
+  finished, so at production prompt lengths transfer serializes behind
+  compute on both edges.
+- **GOFR-HANDOFF2 (streaming)**: the hello adds ``version: 2`` and
+  ``streams: N``; a v2 server answers ``ACK_OK_STREAM`` and the exporter
+  opens ``HANDOFF_STREAMS`` parallel connections. Each transfer becomes
+  page-granular *chunks* (``begin`` / ``pages`` / ``end`` / ``abort``,
+  same ``<i meta_nbytes><meta JSON><payload>`` framing) shipped WHILE
+  later chunks of the same prompt are still prefilling: the engine's
+  chunk fold stages already-written pages (tpu/engine.py
+  ``_stream_handoff_chunk``), the exporter reads them back outside every
+  engine lock and writes them as zero-repack scatter-gather memoryviews
+  (``fleet.channel.sendmsg_all``) round-robined across the streams.
+  Per-stream ordering is TCP's; cross-stream order is reconstructed from
+  ``start_page``, and the importer registers each newly *contiguous*
+  page prefix incrementally — an in-flight prompt is claimable on the
+  decode side up to its landed prefix before the transfer even ends.
+  A HANDOFF1 peer answers the same hello with plain ``ACK_OK`` and the
+  exporter negotiates DOWN: pages accumulate and ship as one blob frame
+  at activation, token-exact across an in-place fleet upgrade.
 
-where meta carries the prompt tokens, page count, the kv dtype tag
-(belt and braces vs the JOIN gate: frames are self-describing for
-capture/replay tooling), and per-plane dtype/shape (the paged cache is
-a pytree; each page's payload is the per-layer K/V planes
-``ops.paged.gather_page`` returns, int8/int4 scale planes included),
-and the payload is the pages' planes concatenated in chain order. The
-receiver replies ``<i status>`` (0 = imported) — the ACK is what bounds
-the exporter's wait and closes the ``engine.handoff`` span. Both sides
-inherit ``MAX_FRAME_BYTES`` so a corrupt length can never silently OOM
-the importer.
+JOIN gates are identical in both modes: the hello names the exporter's
+KV pool dtype (``bf16`` | ``int8`` | ``int4`` — a page payload quantized
+for one pool layout is garbage in another), the adapter-set digest, and
+the base-weight epoch; a mismatch is rejected at JOIN with a distinct
+ACK code before any multi-MB payload moves. Both sides inherit
+``MAX_FRAME_BYTES`` so a corrupt length can never silently OOM the
+importer.
 
-Failure contract (the PR 10 deadline plane): the exporter waits at most
-``min(handoff_timeout_s, request deadline remaining)`` for the ACK; a
-stuck or severed transfer completes the request with a 504
-(``where="handoff"``). The prefill side's pages were retained by its own
-prefix cache BEFORE export and the decode side registers only refcount-
-free host payloads, so a transfer severed at ANY byte leaks zero pool
-pages on either side (``assert_page_refs_consistent``) — the chaos point
-``kv.handoff`` (docs/testing.md) proves it from both ends.
+Failure contract (the PR 10 deadline plane): every chunk send and the
+final ACK wait are bounded by ``min(handoff_timeout_s, request deadline
+remaining)``; a stuck or severed transfer — at ANY chunk boundary —
+completes the request with a 504 (``where="handoff"``). The prefill
+side's pages were retained by its own prefix cache BEFORE export and the
+decode side registers only refcount-free host payloads (a partial import
+is simply a shorter valid prefix chain), so a transfer severed at ANY
+byte leaks zero pool pages on either side
+(``assert_page_refs_consistent``). Chaos points: ``kv.handoff``
+(transfer-granular, both ends), ``kv.handoff.hello`` (JOIN, both ends),
+``kv.handoff.chunk`` (chunk-granular, both ends), ``kv.handoff.midchunk``
+(export side, tears the vectored write inside one chunk) —
+docs/testing.md.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import queue
 import socket
 import struct
@@ -55,13 +72,18 @@ import time
 import numpy as np
 
 from gofr_tpu.fleet import chaos
-from gofr_tpu.fleet.channel import MAX_FRAME_BYTES
+from gofr_tpu.fleet.channel import MAX_FRAME_BYTES, sendmsg_all
 from gofr_tpu.http.errors import DeadlineExceeded
 
 _MAGIC = b"GOFR-HANDOFF1\n"
 _I32 = struct.Struct("<i")
 
-ACK_OK = 0
+# GOFR-HANDOFF2: the version rides the JOIN hello and the ACK picks the
+# framing — the magic stays HANDOFF1 so both protocol generations share
+# one JOIN code path (and one set of dtype/adapter/epoch gates)
+PROTOCOL_VERSION = 2
+
+ACK_OK = 0  # JOIN accepted, HANDOFF1 blob frames on this connection
 ACK_REJECTED = 1
 ACK_DTYPE_MISMATCH = 2
 # adapter-era JOIN gates: the P/D split must agree on WHICH adapters
@@ -73,9 +95,14 @@ ACK_DTYPE_MISMATCH = 2
 # rolling-upgrade compatibility.
 ACK_ADAPTER_MISMATCH = 3
 ACK_EPOCH_MISMATCH = 4
+ACK_OK_STREAM = 5  # JOIN accepted, HANDOFF2 chunk frames on this connection
 
 # the JOIN hello is a few dozen bytes of JSON; anything bigger is not ours
 _MAX_HELLO_BYTES = 4096
+
+# the streaming import keeps per-transfer reassembly state across stream
+# connections; bound it so a crashed exporter's orphans can't accumulate
+_MAX_SESSIONS = 64
 
 
 def engine_kv_dtype(engine) -> str:
@@ -113,10 +140,11 @@ def _np_dtype(name: str) -> np.dtype:
 
 def encode_frame(toks: np.ndarray, payloads: list[tuple], nbytes_page: int,
                  kv_dtype: str = "") -> bytes:
-    """One KV frame: meta-length + meta JSON + concatenated plane bytes.
-    ``payloads`` holds one tuple of HOST numpy planes per full page, in
-    chain order (the caller already read the device buffers back).
-    ``kv_dtype`` tags the pool layout the planes were quantized for."""
+    """One HANDOFF1 blob frame: meta-length + meta JSON + concatenated
+    plane bytes. ``payloads`` holds one tuple of HOST numpy planes per
+    full page, in chain order (the caller already read the device buffers
+    back). ``kv_dtype`` tags the pool layout the planes were quantized
+    for."""
     planes = [{"dtype": str(a.dtype), "shape": list(a.shape)} for a in payloads[0]]
     meta = json.dumps({
         "toks": np.asarray(toks, np.int64).tolist(),
@@ -138,17 +166,24 @@ def encode_frame(toks: np.ndarray, payloads: list[tuple], nbytes_page: int,
 
 
 def decode_frame(sock: socket.socket) -> tuple[np.ndarray, list[tuple], int, str]:
-    """Read one KV frame off ``sock``: (prompt tokens, per-page plane
-    tuples, nbytes_page, kv_dtype tag — "" from a pre-tag peer). Raises
-    HandoffClosed on sever, ValueError on a frame that lies about its
-    size."""
+    """Read one HANDOFF1 blob frame off ``sock``: (prompt tokens, per-page
+    plane tuples, nbytes_page, kv_dtype tag — "" from a pre-tag peer).
+    Raises HandoffClosed on sever, ValueError on a frame that lies about
+    its size."""
     (meta_len,) = _I32.unpack(_recv_exact(sock, _I32.size))
     if not 0 < meta_len <= MAX_FRAME_BYTES:
         raise ValueError(f"handoff: frame advertises {meta_len} meta bytes — corrupt stream")
     meta = json.loads(_recv_exact(sock, meta_len).decode("utf-8"))
     toks = np.asarray(meta["toks"], np.int32)
     n_pages = int(meta["n_pages"])
-    planes = meta["planes"]
+    payloads = _recv_planes(sock, meta["planes"], n_pages)
+    return toks, payloads, int(meta["nbytes_page"]), str(meta.get("kv_dtype", ""))
+
+
+def _recv_planes(sock: socket.socket, planes: list, n_pages: int) -> list[tuple]:
+    """Read ``n_pages`` pages' plane payloads as self-described by the
+    frame/chunk meta — shared by the blob and streaming decoders, with
+    the same loud size cap."""
     dtypes = [_np_dtype(p["dtype"]) for p in planes]
     shapes = [tuple(int(d) for d in p["shape"]) for p in planes]
     per_page = sum(int(np.prod(sh)) * dt.itemsize for sh, dt in zip(shapes, dtypes))
@@ -163,7 +198,44 @@ def decode_frame(sock: socket.socket) -> tuple[np.ndarray, list[tuple], int, str
             raw = _recv_exact(sock, int(np.prod(sh)) * dt.itemsize)
             page.append(np.frombuffer(raw, dtype=dt).reshape(sh).copy())
         payloads.append(tuple(page))
-    return toks, payloads, int(meta["nbytes_page"]), str(meta.get("kv_dtype", ""))
+    return payloads
+
+
+def _byte_view(a: np.ndarray) -> memoryview:
+    """A flat uint8 memoryview over an array's bytes WITHOUT copying —
+    the accelerator dtypes (ml_dtypes bfloat16 et al) don't speak the
+    buffer protocol directly, but a uint8 reinterpret of the same memory
+    does."""
+    a = np.ascontiguousarray(a)
+    return memoryview(a.view(np.uint8).reshape(-1))
+
+
+def chunk_parts(meta: dict, payload_parts=()) -> list:
+    """One HANDOFF2 chunk as a scatter-gather buffer list —
+    ``<i meta_nbytes> <meta JSON> <payload>`` where the payload rides as
+    memoryviews over the original arrays (``sendmsg_all`` writes them
+    without a repack copy). ``meta["kind"]`` is begin|pages|end|abort;
+    ``pages`` metas are self-describing (``planes``) so a chunk is
+    parseable on any stream before its transfer's ``begin`` arrived."""
+    meta_b = json.dumps(meta).encode("utf-8")
+    return [_I32.pack(len(meta_b)), meta_b, *payload_parts]
+
+
+def read_chunk(sock: socket.socket) -> tuple[dict, list[tuple], int]:
+    """Read one HANDOFF2 chunk: (meta, page payloads — empty unless
+    ``kind == "pages"`` —, payload byte count)."""
+    (meta_len,) = _I32.unpack(_recv_exact(sock, _I32.size))
+    if not 0 < meta_len <= MAX_FRAME_BYTES:
+        raise ValueError(
+            f"handoff: chunk advertises {meta_len} meta bytes — corrupt stream")
+    meta = json.loads(_recv_exact(sock, meta_len).decode("utf-8"))
+    payloads: list[tuple] = []
+    nbytes = 0
+    if meta.get("kind") == "pages":
+        n_pages = int(meta["n_pages"])
+        payloads = _recv_planes(sock, meta["planes"], n_pages)
+        nbytes = sum(a.nbytes for page in payloads for a in page)
+    return meta, payloads, nbytes
 
 
 def _register_handoff_metrics(metrics) -> None:
@@ -174,16 +246,26 @@ def _register_handoff_metrics(metrics) -> None:
                         "KV pages shipped between role-split workers")
     metrics.new_counter("app_tpu_kv_handoff_bytes_total",
                         "KV handoff wire bytes (frame size, export side)")
+    metrics.new_counter("app_tpu_kv_handoff_overlap_bytes_total",
+                        "KV handoff bytes shipped while the slot was still "
+                        "prefilling (the streaming pipeline's overlap)")
+    metrics.new_gauge("app_tpu_kv_handoff_overlap_ratio",
+                      "overlap bytes / total export bytes since boot "
+                      "(1.0 = every byte hid behind prefill compute)")
+    metrics.new_gauge("app_tpu_kv_handoff_streams",
+                      "negotiated parallel handoff streams "
+                      "(0 = HANDOFF1 blob mode)")
     metrics.new_histogram("app_tpu_kv_handoff_seconds",
                           "prefill-side handoff latency: activation to ACK")
 
 
 class HandoffJob:
-    """One staged export: everything the exporter thread needs to ship a
-    slot's prompt pages and settle the request, captured under the engine
-    state lock at activation time. ``payloads`` are DEVICE buffers — the
-    gathers were dispatched under the lock (the _evict_prefix_page
-    discipline); the exporter blocks on them outside it."""
+    """One staged BLOB export (HANDOFF1 / ``handoff_streams=0``):
+    everything the exporter thread needs to ship a slot's prompt pages
+    and settle the request, captured under the engine state lock at
+    activation time. ``payloads`` are DEVICE buffers — the gathers were
+    dispatched under the lock (the _evict_prefix_page discipline); the
+    exporter blocks on them outside it."""
 
     __slots__ = ("request", "prompt_tokens", "first_token", "payloads",
                  "nbytes_page", "t0")
@@ -198,55 +280,125 @@ class HandoffJob:
         self.t0 = t0
 
 
+class StreamTransfer:
+    """One STREAMING export (HANDOFF2): created at the first full page of
+    a still-prefilling slot (``engine._stream_handoff_chunk``) or at
+    activation for batched prefills. The engine thread appends
+    device-buffer page payloads in chain order (``add``, under its state
+    lock — append-only, so the exporter thread reads a stable prefix
+    without a lock) and flips ``finished`` at activation; the exporter
+    thread owns every other field."""
+
+    __slots__ = ("request", "prompt_tokens", "nbytes_page", "t0", "xfer",
+                 "staged", "sent_pages", "sent_bytes", "overlap_bytes",
+                 "first_token", "finished", "t_activate", "begun", "seq",
+                 "failed", "settled")
+
+    def __init__(self, request, prompt_tokens, nbytes_page, t0, xfer):
+        self.request = request
+        self.prompt_tokens = prompt_tokens
+        self.nbytes_page = int(nbytes_page)
+        self.t0 = t0
+        self.xfer = xfer
+        self.staged: list[tuple] = []  # device payloads, chain order
+        self.sent_pages = 0
+        self.sent_bytes = 0
+        self.overlap_bytes = 0
+        self.first_token: int | None = None
+        self.finished = False
+        self.t_activate: float | None = None
+        self.begun = False
+        self.seq = 0
+        self.failed = False
+        self.settled = False
+
+    @property
+    def staged_pages(self) -> int:
+        return len(self.staged)
+
+    def add(self, payloads: list[tuple]) -> None:
+        self.staged.extend(payloads)
+
+
 class HandoffExporter:
-    """Prefill-side export thread: serializes staged jobs onto one TCP
-    connection to the decode worker's HandoffServer, lazily (re)dialing.
-    Jobs are strictly serial — KV frames are multi-MB and the decode
-    side imports under its state lock, so pipelining frames buys nothing
-    and interleaving them would corrupt the stream."""
+    """Prefill-side export thread: ships staged transfers to the decode
+    worker's HandoffServer, lazily (re)dialing and negotiating the wire
+    mode at JOIN. Transfers are strictly serial on this thread — the
+    decode side imports under its state lock — but each streaming
+    transfer's chunks overlap the EXPORTING engine's remaining prefill
+    compute: the engine stages pages per chunk fold, this thread drains
+    them while the next chunk is still on the device."""
 
     def __init__(self, target: str, *, engine=None, timeout_s: float = 5.0,
-                 logger=None, metrics=None):
+                 streams: int = 2, chunk_pages: int = 4,
+                 pace_mbps: float = 0.0, logger=None, metrics=None):
         host, _, port = target.rpartition(":")
         self.host, self.port = host or "127.0.0.1", int(port)
         self.timeout_s = max(0.1, float(timeout_s))
+        self.streams = max(0, int(streams))
+        self.chunk_pages = max(1, int(chunk_pages))
+        # emulated egress bandwidth cap (HANDOFF_PACE_MBPS): sleep
+        # nbytes/rate after each wire write. 0 = off. A bench/testing
+        # knob first (it makes transfer time deterministic on loopback),
+        # but also a legitimate production rate limit when the P/D pair
+        # shares NICs with training traffic.
+        self.pace_mbps = max(0.0, float(pace_mbps))
         self.engine = engine
         self.logger = logger
         self.metrics = metrics
         if metrics is not None:
             _register_handoff_metrics(metrics)
-        self._sock: socket.socket | None = None
+        self._sock: socket.socket | None = None  # blob-mode connection
+        self._socks: list[socket.socket] = []    # stream-mode connections
+        self._mode: str | None = None            # None until first JOIN
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._stop = threading.Event()
-        self._stats = {"exported": 0, "failed": 0, "pages": 0, "bytes": 0}
+        self._stats = {"exported": 0, "failed": 0, "pages": 0, "bytes": 0,
+                       "overlap_bytes": 0}
+        self._stream_bytes: list[int] = []
+        self._stream_seconds: list[float] = []
+        self._xfer_seq = 0
+        self._xfer_tag = f"{os.getpid():x}.{id(self) & 0xFFFFFF:x}"
         self._lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, name="kv-handoff-export", daemon=True)
         self._thread.start()
 
-    # -- connection ------------------------------------------------------------
+    # -- connection / negotiation ----------------------------------------------
 
-    def _connect(self) -> socket.socket:
-        if self._sock is not None:
-            return self._sock
-        s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # JOIN: magic + hello (kv dtype, adapter-set digest, base-weight
-        # epoch); a mismatched pool layout / adapter set / weights epoch
-        # is rejected HERE, before any multi-MB page frame moves
-        hello = json.dumps({
+    def _hello(self) -> bytes:
+        """JOIN hello: kv dtype, adapter-set digest, base-weight epoch —
+        plus the HANDOFF2 version/stream announcement when streaming is
+        configured (a HANDOFF1 server ignores the extra keys and ACKs
+        plain OK: that ACK *is* the down-negotiation)."""
+        hello = {
             "kv_dtype": engine_kv_dtype(self.engine),
             "adapters": str(getattr(self.engine, "adapters_digest",
                                     lambda: "")()),
             "weights_epoch": int(getattr(self.engine, "weights_epoch", 0) or 0),
-        }).encode("utf-8")
+        }
+        if self.streams > 0:
+            hello["version"] = PROTOCOL_VERSION
+            hello["streams"] = self.streams
+        return json.dumps(hello).encode("utf-8")
+
+    def _dial(self) -> tuple[socket.socket, int]:
+        """One connection's JOIN: dial, send magic+hello, return (socket,
+        ACK status) for an accepted JOIN; raise HandoffClosed (with the
+        config hint) on rejection or sever."""
+        s = socket.create_connection((self.host, self.port), timeout=self.timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if chaos.fire("kv.handoff.hello", side="export"):
+            s.close()
+            raise HandoffClosed("handoff JOIN severed (chaos kv.handoff.hello)")
+        hello = self._hello()
         s.sendall(_MAGIC + _I32.pack(len(hello)) + hello)
         try:
             (status,) = _I32.unpack(_recv_exact(s, _I32.size))
         except HandoffClosed:
             s.close()
             raise
-        if status != ACK_OK:
+        if status not in (ACK_OK, ACK_OK_STREAM):
             s.close()
             if status == ACK_ADAPTER_MISMATCH:
                 raise HandoffClosed(
@@ -262,34 +414,309 @@ class HandoffExporter:
                 f"decode worker rejected JOIN (status {status}): "
                 f"kv dtype {engine_kv_dtype(self.engine)!r} does not match the "
                 "import pool (ENGINE_KV_DTYPE must agree across the P/D split)")
-        self._sock = s
-        return s
+        return s, status
+
+    def _negotiate(self) -> None:
+        """Resolve the wire mode on first use. ACK_OK_STREAM selects the
+        chunked pipeline over up to ``streams`` connections (extra-stream
+        dial failures degrade to fewer streams, never fail the JOIN);
+        plain ACK_OK from a HANDOFF1 peer negotiates DOWN to blob mode on
+        that same connection."""
+        if self._mode is not None:
+            return
+        s, status = self._dial()
+        if status == ACK_OK_STREAM and self.streams > 0:
+            socks = [s]
+            for _ in range(1, self.streams):
+                try:
+                    s2, st2 = self._dial()
+                except (OSError, HandoffClosed):
+                    break
+                if st2 != ACK_OK_STREAM:
+                    s2.close()
+                    break
+                socks.append(s2)
+            self._socks = socks
+            self._mode = "stream"
+            with self._lock:
+                self._stream_bytes = [0] * len(socks)
+                self._stream_seconds = [0.0] * len(socks)
+            if self.metrics is not None:
+                self.metrics.set_gauge("app_tpu_kv_handoff_streams", len(socks))
+            if self.logger is not None:
+                self.logger.infof(
+                    "kv handoff: GOFR-HANDOFF2 streaming over %d stream(s)",
+                    len(socks))
+        else:
+            self._sock = s
+            self._mode = "blob"
+            if self.metrics is not None:
+                self.metrics.set_gauge("app_tpu_kv_handoff_streams", 0)
+            if self.logger is not None and self.streams > 0:
+                self.logger.warn(
+                    "kv handoff: peer speaks GOFR-HANDOFF1 — negotiated down "
+                    "to blob mode (transfer will not overlap prefill)")
+
+    def _connect(self) -> socket.socket:
+        """The blob-mode connection (HANDOFF1 path and negotiated-down
+        HANDOFF2 transfers)."""
+        self._negotiate()
+        if self._mode != "blob" or self._sock is None:
+            raise HandoffClosed("handoff: blob send without a blob-mode JOIN")
+        return self._sock
 
     def _sever(self) -> None:
-        if self._sock is not None:
+        for s in ([self._sock] if self._sock is not None else []) + self._socks:
             try:
-                self._sock.close()
+                s.close()
             except OSError:
                 pass
-            self._sock = None
+        self._sock = None
+        self._socks = []
+        self._mode = None  # the next transfer re-dials and re-negotiates
 
-    # -- export ----------------------------------------------------------------
+    def _pace(self, nbytes: int) -> None:
+        if self.pace_mbps > 0.0 and nbytes > 0:
+            time.sleep(nbytes / (self.pace_mbps * 1e6))
+
+    def _budget(self, req) -> float:
+        """Per-write budget: the tighter of the handoff timeout and the
+        request's remaining deadline (PR 10 plane) — enforced per CHUNK
+        in streaming mode, so a mid-stream stall sheds at the chunk
+        boundary instead of after the whole transfer's worth of waiting."""
+        budget = self.timeout_s
+        if req is not None and req.deadline is not None:
+            budget = min(budget, max(0.05, req.deadline - time.monotonic()))
+        return budget
+
+    # -- engine-facing API -----------------------------------------------------
 
     def submit(self, job: HandoffJob) -> None:
         self._q.put(job)
 
+    def begin_stream(self, request, prompt_tokens, nbytes_page,
+                     t0: float) -> StreamTransfer:
+        """Allocate a transfer handle for a (possibly still-prefilling)
+        slot. Pure bookkeeping — nothing moves until ``kick``."""
+        with self._lock:
+            self._xfer_seq += 1
+            n = self._xfer_seq
+        return StreamTransfer(request, prompt_tokens, nbytes_page, t0,
+                              f"{self._xfer_tag}:{n}")
+
+    def kick(self, transfer: StreamTransfer) -> None:
+        """New pages staged: wake the exporter thread to drain them."""
+        self._q.put(("xfer", transfer))
+
+    def finish(self, transfer: StreamTransfer, first_token: int,
+               now: float) -> None:
+        """Activation: the slot sampled its first token and was freed —
+        ship the tail, send ``end``, settle on the ACK."""
+        transfer.first_token = int(first_token)
+        transfer.t_activate = now
+        transfer.finished = True
+        self._q.put(("xfer", transfer))
+
+    def abort(self, transfer: StreamTransfer) -> None:
+        """The slot died before activation (preemption, cancel): tear the
+        wire state down WITHOUT touching the request — a preempted prompt
+        re-enters prefill and re-streams from page 0 (the importer
+        touch-skips positions it already holds)."""
+        transfer.failed = True
+        self._q.put(("abort", transfer))
+
+    def known_blob(self) -> bool:
+        """True once the peer negotiated down to HANDOFF1 — the engine
+        skips mid-prefill staging (pages would only accumulate)."""
+        return self._mode == "blob"
+
+    # -- exporter thread -------------------------------------------------------
+
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                job = self._q.get(timeout=0.2)
+                item = self._q.get(timeout=0.2)
             except queue.Empty:
                 continue
-            if job is None:
+            if item is None:
                 break
             try:
-                self._export(job)
+                if isinstance(item, HandoffJob):
+                    self._export(item)
+                else:
+                    kind, transfer = item
+                    if kind == "abort":
+                        self._drop(transfer)
+                    else:
+                        self._advance(transfer)
             except Exception as e:  # noqa: BLE001 - one bad job must not kill the thread
-                self._fail(job, f"handoff export error: {e}")
+                if isinstance(item, HandoffJob):
+                    self._fail(item, f"handoff export error: {e}")
+                elif item[0] != "abort":
+                    self._fail_stream(item[1], f"handoff export error: {e}")
+
+    # -- streaming path --------------------------------------------------------
+
+    def _advance(self, t: StreamTransfer) -> None:
+        if t.failed or t.settled:
+            return
+        try:
+            self._negotiate()
+        except (OSError, HandoffClosed) as e:
+            self._sever()
+            self._fail_stream(t, f"handoff JOIN failed: {e}")
+            return
+        if self._mode == "blob":
+            # negotiated down: pages accumulate on the handle and ship as
+            # one HANDOFF1 frame at activation (satellite: mixed-version
+            # pairs stay token-exact through an in-place upgrade)
+            if t.finished:
+                job = HandoffJob(t.request, t.prompt_tokens, t.first_token,
+                                 list(t.staged), t.nbytes_page,
+                                 t.t_activate or t.t0)
+                t.settled = True  # _export settles/fails the request
+                self._export(job)
+            return
+        try:
+            self._pump(t)
+        except (OSError, HandoffClosed, ValueError) as e:
+            self._sever()
+            self._fail_stream(t, f"handoff stream failed: {e}")
+
+    def _pump(self, t: StreamTransfer) -> None:
+        """Drain staged pages onto the streams; on the finished transfer,
+        close with ``end`` and settle on the ACK."""
+        req = t.request
+        now = time.monotonic()
+        if req.cancelled or req.expired(now):
+            raise HandoffClosed("request expired mid-stream")
+        if not t.begun:
+            # transfer-granular chaos (the original kv.handoff drill):
+            # sever before ANY chunk moves
+            if chaos.fire("kv.handoff", side="export", pages=t.staged_pages):
+                raise HandoffClosed("handoff transfer severed (chaos kv.handoff)")
+            meta = {"v": PROTOCOL_VERSION, "kind": "begin", "xfer": t.xfer,
+                    "toks": np.asarray(t.prompt_tokens, np.int64).tolist(),
+                    "nbytes_page": t.nbytes_page,
+                    "kv_dtype": engine_kv_dtype(self.engine)}
+            t.sent_bytes += self._send_chunk(0, req, meta, ())
+            t.begun = True
+        while t.sent_pages < t.staged_pages:
+            hi = min(t.sent_pages + self.chunk_pages, t.staged_pages)
+            batch = t.staged[t.sent_pages:hi]
+            # device→host readback OUTSIDE every engine lock: the gathers
+            # were dispatched at the chunk fold; np.asarray blocks here,
+            # overlapped with the device's next chunk
+            t_rb = time.monotonic()
+            host = [tuple(np.asarray(a) for a in page) for page in batch]
+            plane = getattr(self.engine, "perf", None)
+            if plane is not None:
+                now = time.monotonic()
+                flops, bytes_ = plane.model.handoff_export(len(host))
+                plane.note_external("handoff_export", now - t_rb, flops,
+                                    bytes_, now)
+            # chunk-granular chaos: sever at this chunk boundary
+            if chaos.fire("kv.handoff.chunk", side="export", seq=t.seq):
+                raise HandoffClosed(
+                    "handoff stream severed at a chunk boundary "
+                    "(chaos kv.handoff.chunk)")
+            overlap = not t.finished
+            meta = {"v": PROTOCOL_VERSION, "kind": "pages", "xfer": t.xfer,
+                    "seq": t.seq, "start_page": t.sent_pages,
+                    "n_pages": len(host),
+                    "planes": [{"dtype": str(a.dtype), "shape": list(a.shape)}
+                               for a in host[0]]}
+            parts = [_byte_view(a) for page in host for a in page]
+            si = t.seq % max(1, len(self._socks))
+            nbytes = self._send_chunk(si, req, meta, parts)
+            t.sent_bytes += nbytes
+            if overlap:
+                t.overlap_bytes += nbytes
+            t.sent_pages = hi
+            t.seq += 1
+        if not t.finished:
+            return  # more chunks still prefilling; the next kick resumes
+        meta = {"v": PROTOCOL_VERSION, "kind": "end", "xfer": t.xfer,
+                "total_pages": t.sent_pages}
+        t.sent_bytes += self._send_chunk(0, req, meta, ())
+        s = self._socks[0]
+        s.settimeout(self._budget(req))
+        (status,) = _I32.unpack(_recv_exact(s, _I32.size))
+        if status != ACK_OK:
+            self._fail_stream(
+                t, f"decode worker rejected the KV stream (status {status})")
+            return
+        t.settled = True
+        self._settle(req, t.first_token, t.sent_pages, t.sent_bytes,
+                     t.overlap_bytes, t.t_activate or t.t0)
+
+    def _send_chunk(self, si: int, req, meta: dict, parts) -> int:
+        """One bounded vectored chunk write on stream ``si``; returns the
+        bytes written. Prices the wire time into the perf plane as
+        off-device-thread work (never moves the ``_dq`` bubble floor)."""
+        if req is not None and req.expired(time.monotonic()):
+            raise HandoffClosed("request deadline exhausted mid-stream")
+        s = self._socks[si]
+        bufs = chunk_parts(meta, parts)
+        nbytes = sum(memoryview(b).nbytes for b in bufs)
+        s.settimeout(self._budget(req))
+        t_w = time.monotonic()
+        if chaos.fire("kv.handoff.midchunk", side="export"):
+            # tear the write INSIDE the chunk: header out, payload not —
+            # the importer sees a short read, the drill proves neither
+            # side leaks on a torn frame
+            sendmsg_all(s, bufs[:1])
+            raise HandoffClosed("handoff stream severed mid-chunk "
+                                "(chaos kv.handoff.midchunk)")
+        sendmsg_all(s, bufs)
+        dt = time.monotonic() - t_w
+        self._pace(nbytes)
+        with self._lock:
+            if si < len(self._stream_bytes):
+                self._stream_bytes[si] += nbytes
+                self._stream_seconds[si] = round(
+                    self._stream_seconds[si] + dt, 6)
+        plane = getattr(self.engine, "perf", None)
+        if plane is not None:
+            now = time.monotonic()
+            plane.note_external("handoff_stream", dt, 0.0, nbytes, now)
+        return nbytes
+
+    def _drop(self, t: StreamTransfer) -> None:
+        """Abort a dead slot's transfer: best-effort ``abort`` chunk so
+        the importer frees its reassembly session, request untouched."""
+        if t.settled or not t.begun or self._mode != "stream" or not self._socks:
+            return
+        try:
+            self._send_chunk(0, t.request,
+                             {"v": PROTOCOL_VERSION, "kind": "abort",
+                              "xfer": t.xfer}, ())
+        except (OSError, HandoffClosed, ValueError):
+            self._sever()
+
+    def _fail_stream(self, t: StreamTransfer, why: str) -> None:
+        if t.settled:
+            return
+        t.failed = True
+        t.settled = True
+        with self._lock:
+            self._stats["failed"] += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_request_deadline_exceeded_total", 1, where="handoff")
+        rt = t.request.kw.get("_rt")
+        if rt is not None and t.t_activate is not None:
+            rt.end("engine.handoff", error=why[:120])
+        if self.logger is not None:
+            self.logger.warn(f"kv handoff: {why}")
+        # a transfer can die while its slot is still PREFILLING: cancel
+        # cooperatively so the next chunk fold frees the slot/pages (the
+        # zero-leak half), then complete — first-writer-wins makes the
+        # fold's RequestTimeout a no-op
+        t.request.cancel("kv handoff severed")
+        t.request.complete(error=DeadlineExceeded(f"kv handoff failed: {why}"))
+
+    # -- blob path (HANDOFF1 / negotiated-down) --------------------------------
 
     def _export(self, job: HandoffJob) -> None:
         req = job.request
@@ -315,9 +742,7 @@ class HandoffExporter:
             return
         # bound the whole send+ACK by the tighter of the handoff budget and
         # the request's remaining deadline (PR 10 plane)
-        budget = self.timeout_s
-        if req.deadline is not None:
-            budget = min(budget, max(0.05, req.deadline - time.monotonic()))
+        budget = self._budget(req)
         # chaos kv.handoff, client side: drop = sever the connection with
         # the frame (possibly partially) unsent — no ACK ever arrives
         if chaos.fire("kv.handoff", side="export", pages=len(host_pages)):
@@ -328,6 +753,7 @@ class HandoffExporter:
             s = self._connect()
             s.settimeout(budget)
             s.sendall(frame)
+            self._pace(len(frame))
             (status,) = _I32.unpack(_recv_exact(s, _I32.size))
         except (OSError, HandoffClosed) as e:
             self._sever()
@@ -336,29 +762,43 @@ class HandoffExporter:
         if status != ACK_OK:
             self._fail(job, f"decode worker rejected the KV frame (status {status})")
             return
-        self._settle(job, len(host_pages), len(frame))
+        self._settle(req, job.first_token, len(host_pages), len(frame), 0,
+                     job.t0)
 
-    def _settle(self, job: HandoffJob, n_pages: int, nbytes: int) -> None:
-        req = job.request
+    # -- shared settle/fail ----------------------------------------------------
+
+    def _settle(self, req, first_token, n_pages: int, nbytes: int,
+                overlap_bytes: int, t_anchor: float) -> None:
         now = time.monotonic()
         with self._lock:
             self._stats["exported"] += 1
             self._stats["pages"] += n_pages
             self._stats["bytes"] += nbytes
+            self._stats["overlap_bytes"] += overlap_bytes
+            tot_b = self._stats["bytes"]
+            tot_o = self._stats["overlap_bytes"]
         if self.metrics is not None:
             self.metrics.increment_counter(
                 "app_tpu_kv_handoff_pages_total", n_pages, side="export")
             self.metrics.increment_counter(
                 "app_tpu_kv_handoff_bytes_total", nbytes, side="export")
+            if overlap_bytes:
+                self.metrics.increment_counter(
+                    "app_tpu_kv_handoff_overlap_bytes_total", overlap_bytes,
+                    side="export")
+            self.metrics.set_gauge(
+                "app_tpu_kv_handoff_overlap_ratio",
+                round(tot_o / tot_b, 4) if tot_b else 0.0)
             self.metrics.record_histogram(
-                "app_tpu_kv_handoff_seconds", now - job.t0)
+                "app_tpu_kv_handoff_seconds", now - t_anchor)
         rt = req.kw.get("_rt")
         if rt is not None:
-            rt.end("engine.handoff", pages=n_pages, bytes=nbytes)
+            rt.end("engine.handoff", pages=n_pages, bytes=nbytes,
+                   overlap_bytes=overlap_bytes)
         eng = self.engine
         tokenizer = getattr(eng, "tokenizer", None) if eng is not None else None
-        tokens = [int(job.first_token)]
-        ft = req.kw.get("_first_token_at", job.t0)
+        tokens = [int(first_token)]
+        ft = req.kw.get("_first_token_at", t_anchor)
         req.complete(result={
             "tokens": tokens,
             "text": tokenizer.decode(tokens) if tokenizer is not None else None,
@@ -379,9 +819,16 @@ class HandoffExporter:
             self.logger.warn(f"kv handoff: {why}")
         job.request.complete(error=DeadlineExceeded(f"kv handoff failed: {why}"))
 
-    def stats(self) -> dict[str, int]:
+    def stats(self) -> dict:
         with self._lock:
-            return dict(self._stats)
+            out = dict(self._stats)
+            out["stream_bytes"] = list(self._stream_bytes)
+            out["stream_seconds"] = list(self._stream_seconds)
+        out["mode"] = self._mode or ""
+        out["streams"] = len(self._socks)
+        b = out["bytes"]
+        out["overlap_ratio"] = round(out["overlap_bytes"] / b, 4) if b else 0.0
+        return out
 
     def close(self) -> None:
         self._stop.set()
@@ -390,17 +837,52 @@ class HandoffExporter:
         self._sever()
 
 
+class _ImportSession:
+    """Reassembly state for one streamed transfer, shared across every
+    stream connection of the exporting peer: chunks carry the transfer
+    id, per-stream ordering is TCP's, cross-stream order is rebuilt from
+    ``start_page``. ``done`` fires when the contiguous imported prefix
+    reaches the ``end`` chunk's total (or the session fails)."""
+
+    __slots__ = ("toks", "nbytes_page", "pages", "cursor", "total",
+                 "added", "bytes", "status", "done", "lock")
+
+    def __init__(self):
+        self.toks = None
+        self.nbytes_page = 0
+        self.pages: dict[int, tuple] = {}
+        self.cursor = 0
+        self.total: int | None = None
+        self.added = 0
+        self.bytes = 0
+        self.status = ACK_OK
+        self.done = threading.Event()
+        self.lock = threading.Lock()
+
+
 class HandoffServer:
     """Decode-side import listener: accepts prefill workers' connections
-    and registers each frame's pages as host-tier prefix nodes via
+    and registers shipped pages as host-tier prefix nodes via
     ``engine.handoff_import`` — refcount-free payloads the next prefix
-    hit promotes and uploads through the normal ``swapin`` path."""
+    hit promotes and uploads through the normal ``swapin`` path. Speaks
+    both protocol generations: a HANDOFF1 peer gets the blob frame loop,
+    a HANDOFF2 peer gets chunk streaming with INCREMENTAL import — every
+    newly contiguous page prefix registers immediately, so a request
+    arriving mid-transfer already gets a (partial) prefix hit and its
+    first decode step dispatches onto ``_dq`` as soon as early pages
+    land, not after the last frame."""
 
     def __init__(self, engine, listen: str = "127.0.0.1:0", *,
-                 logger=None, metrics=None):
+                 logger=None, metrics=None,
+                 max_version: int = PROTOCOL_VERSION):
         self.engine = engine
         self.logger = logger
         self.metrics = metrics
+        # rolling-upgrade escape hatch (and the mixed-version test seam):
+        # max_version=1 makes this server answer every JOIN with plain
+        # ACK_OK, forcing HANDOFF1 blob mode exactly like a pre-streaming
+        # build would
+        self.max_version = int(max_version)
         if metrics is not None:
             _register_handoff_metrics(metrics)
         host, _, port = listen.rpartition(":")
@@ -414,6 +896,7 @@ class HandoffServer:
         self._stats = {"imported": 0, "rejected": 0, "pages": 0, "bytes": 0}
         self._lock = threading.Lock()
         self._conns: list[socket.socket] = []
+        self._sessions: dict[str, _ImportSession] = {}
         self._thread = threading.Thread(
             target=self._accept_loop, name="kv-handoff-server", daemon=True)
         self._thread.start()
@@ -489,6 +972,15 @@ class HandoffServer:
                             f"must land on both sides before pages move)")
                     conn.sendall(_I32.pack(ACK_EPOCH_MISMATCH))
                     return
+            # chaos kv.handoff.hello, import side: drop AFTER the gates
+            # but BEFORE the ACK — the dialer's JOIN wait times out
+            if chaos.fire("kv.handoff.hello", side="import"):
+                return
+            if (int(hello.get("version", 1) or 1) >= PROTOCOL_VERSION
+                    and self.max_version >= PROTOCOL_VERSION):
+                conn.sendall(_I32.pack(ACK_OK_STREAM))
+                self._serve_stream(conn, want)
+                return
             conn.sendall(_I32.pack(ACK_OK))
             while not self._stop.is_set():
                 toks, payloads, nbytes_page, frame_dtype = decode_frame(conn)
@@ -537,6 +1029,114 @@ class HandoffServer:
                 if conn in self._conns:
                     self._conns.remove(conn)
 
+    # -- HANDOFF2 streaming import ---------------------------------------------
+
+    def _session(self, xfer: str) -> _ImportSession:
+        with self._lock:
+            sess = self._sessions.get(xfer)
+            if sess is None:
+                while len(self._sessions) >= _MAX_SESSIONS:
+                    # oldest-first orphan drop (dict preserves insertion
+                    # order): host payloads only, nothing pool-owned
+                    self._sessions.pop(next(iter(self._sessions)))
+                sess = self._sessions[xfer] = _ImportSession()
+            return sess
+
+    def _ingest(self, sess: _ImportSession) -> None:
+        """Advance the contiguous-prefix cursor and register every newly
+        contiguous page — the INCREMENTAL import. Repeated ``insert_host``
+        calls with a growing payload prefix touch-skip positions already
+        registered (tpu/prefix.py), so pages become claimable the moment
+        the prefix is contiguous, not at ``end``. Caller holds sess.lock."""
+        if sess.toks is None or sess.status != ACK_OK:
+            return
+        cur = sess.cursor
+        while cur in sess.pages:
+            cur += 1
+        if cur > sess.cursor:
+            try:
+                sess.added += self.engine.handoff_import(
+                    sess.toks, [sess.pages[i] for i in range(cur)],
+                    sess.nbytes_page)
+                sess.cursor = cur
+            except Exception as e:  # noqa: BLE001 - reject the transfer, keep serving
+                sess.status = ACK_REJECTED
+                if self.logger is not None:
+                    self.logger.warn(f"kv handoff stream import rejected: {e}")
+        if sess.status != ACK_OK or (sess.total is not None
+                                     and sess.cursor >= sess.total):
+            sess.done.set()
+
+    def _serve_stream(self, conn: socket.socket, want: str) -> None:
+        """One stream connection's chunk loop. Sessions are shared across
+        the peer's streams, so a ``pages`` chunk racing ahead of its
+        transfer's ``begin`` (different TCP connection) just parks in the
+        reassembly dict until the tokens arrive."""
+        while not self._stop.is_set():
+            meta, payloads, nbytes = read_chunk(conn)
+            kind = str(meta.get("kind", ""))
+            xfer = str(meta.get("xfer", ""))
+            if kind == "begin":
+                # transfer-granular chaos (the original kv.handoff drill,
+                # import side): sever before ANY page imports
+                if chaos.fire("kv.handoff", side="import", pages=0):
+                    return
+                sess = self._session(xfer)
+                with sess.lock:
+                    sess.toks = np.asarray(meta["toks"], np.int32)
+                    sess.nbytes_page = int(meta["nbytes_page"])
+                    if str(meta.get("kv_dtype", "") or want) != want:
+                        sess.status = ACK_DTYPE_MISMATCH
+                    self._ingest(sess)
+            elif kind == "pages":
+                # chunk-granular chaos, import side: the chunk arrived
+                # but is dropped before import; the connection severs
+                if chaos.fire("kv.handoff.chunk", side="import",
+                              seq=int(meta.get("seq", 0))):
+                    return
+                sess = self._session(xfer)
+                with sess.lock:
+                    start = int(meta["start_page"])
+                    for j, page in enumerate(payloads):
+                        sess.pages[start + j] = page
+                    sess.bytes += nbytes
+                    self._ingest(sess)
+            elif kind == "end":
+                sess = self._session(xfer)
+                with sess.lock:
+                    sess.total = int(meta["total_pages"])
+                    self._ingest(sess)
+                # other streams may still be draining their chunks: bound
+                # the wait by the engine's own handoff budget, then answer
+                # on THIS connection (the exporter's control stream)
+                ok = sess.done.wait(max(
+                    0.1, float(getattr(self.engine, "handoff_timeout_s", 5.0))))
+                status = sess.status if ok else ACK_REJECTED
+                with self._lock:
+                    self._sessions.pop(xfer, None)
+                    if status == ACK_OK:
+                        self._stats["imported"] += 1
+                        self._stats["pages"] += sess.added
+                        self._stats["bytes"] += sess.bytes
+                    else:
+                        self._stats["rejected"] += 1
+                if self.metrics is not None and status == ACK_OK:
+                    self.metrics.increment_counter(
+                        "app_tpu_kv_handoff_pages_total", sess.added,
+                        side="import")
+                    self.metrics.increment_counter(
+                        "app_tpu_kv_handoff_bytes_total", sess.bytes,
+                        side="import")
+                conn.sendall(_I32.pack(status))
+            elif kind == "abort":
+                # exporter-side slot death (preemption/cancel): drop the
+                # reassembly state; pages ALREADY registered stay — they
+                # are a valid prefix of that prompt, refcount-free
+                with self._lock:
+                    self._sessions.pop(xfer, None)
+            else:
+                raise ValueError(f"handoff: unknown chunk kind {kind!r}")
+
     def stats(self) -> dict[str, int]:
         with self._lock:
             return dict(self._stats)
@@ -549,6 +1149,7 @@ class HandoffServer:
             pass
         with self._lock:
             conns, self._conns = list(self._conns), []
+            self._sessions.clear()
         for c in conns:
             try:
                 c.close()
@@ -559,7 +1160,8 @@ class HandoffServer:
 
 __all__ = [
     "ACK_ADAPTER_MISMATCH", "ACK_DTYPE_MISMATCH", "ACK_EPOCH_MISMATCH",
-    "ACK_OK", "ACK_REJECTED", "HandoffClosed",
-    "HandoffExporter", "HandoffJob", "HandoffServer", "decode_frame",
-    "encode_frame", "engine_kv_dtype",
+    "ACK_OK", "ACK_OK_STREAM", "ACK_REJECTED", "HandoffClosed",
+    "HandoffExporter", "HandoffJob", "HandoffServer", "PROTOCOL_VERSION",
+    "StreamTransfer", "chunk_parts", "decode_frame", "encode_frame",
+    "engine_kv_dtype", "read_chunk",
 ]

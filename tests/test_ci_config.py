@@ -257,6 +257,16 @@ def test_handoff_suite_is_in_quick_tier():
     assert "token_exact_bf16" in text and "token_exact_int8" in text
     assert "kv.handoff" in text and "assert_page_refs_consistent" in text
     assert "deadline" in text and "stage" in text
+    # ISSUE 18: the GOFR-HANDOFF2 streaming units ride the same quick
+    # tier — chunk sequencing across streams, out-of-order reassembly,
+    # the mid-stream deadline shed, the mixed-version (blob fallback)
+    # pair, and the stream-granular chaos sever drills
+    assert "ACK_OK_STREAM" in text, "v2 negotiation units missing"
+    assert "test_out_of_order_multistream_reassembly" in text
+    assert "test_deadline_expiry_mid_stream_sheds_504" in text
+    assert "test_mixed_version_pair_token_exact" in text
+    assert "kv.handoff.chunk" in text and "kv.handoff.midchunk" in text
+    assert "kv.handoff.hello" in text
 
 
 def test_ci_runs_the_disagg_smoke():
@@ -282,6 +292,34 @@ def test_ci_runs_the_disagg_smoke():
         for step in job.get("steps", [])
         if "disagg" in step.get("run", ""))
     assert "tpot" in checks and "handoff" in checks and "token_exact" in checks
+
+
+def test_ci_runs_the_handoff_stream_smoke():
+    """ISSUE 18 satellite: CI must run the blob-vs-streaming handoff A/B
+    as an explicit CPU run and assert the tentpole perf claim from the
+    archive — the streaming arm's decode-side TTFT slope strictly below
+    the blob arm's, its longest/shortest flatness ratio bounded, a
+    nonzero overlap ratio, and token-exact serving."""
+    ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+    smoke_runs = [
+        step.get("run", "")
+        for job in ci["jobs"].values()
+        for step in job.get("steps", [])
+        if "GOFR_BENCH_HANDOFF_STREAM=1" in step.get("run", "")
+    ]
+    assert smoke_runs, (
+        "ci.yml has no job running the GOFR_BENCH_HANDOFF_STREAM smoke")
+    joined = " ".join(smoke_runs)
+    assert "GOFR_BENCH_PLATFORM=cpu" in joined
+    assert "bench.py" in joined
+    # the verdict step must assert the flattening, not just presence
+    checks = " ".join(
+        step.get("run", "")
+        for job in ci["jobs"].values()
+        for step in job.get("steps", [])
+        if "handoff_stream" in step.get("run", ""))
+    assert "slope_s_per_page" in checks and "flatness_p50" in checks
+    assert "overlap_ratio" in checks and "token_exact" in checks
 
 
 def test_kv_int4_suite_is_in_quick_tier():

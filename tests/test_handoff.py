@@ -460,3 +460,335 @@ class TestAdapterEraJoinGates:
             assert dec._handoff_server.stats().get("rejected", 0) == 0
         finally:
             dec.stop()
+
+
+# -- GOFR-HANDOFF2 streaming pipeline (ISSUE 18) ---------------------------------
+
+
+LONG_PROMPT = [(7 * i) % 180 + 1 for i in range(40)]  # 5 pages @ page_size 8
+
+# chunked prefill (prompt > top bucket) with one page per chunk, one page
+# per wire chunk: five folds, each staging + shipping one page while the
+# next chunk is still on the device
+STREAM_KW = dict(prefill_buckets=[8], handoff_chunk_pages=1,
+                 total_pages=32, max_len=128)
+
+
+def _v2_dial(dec, streams=2):
+    """One raw GOFR-HANDOFF2 stream connection: dial, hello with
+    version=2, assert the server ACKs streaming; returns the socket."""
+    import json as _json
+
+    host, port = dec.handoff_addr.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=5.0)
+    hello = _json.dumps({
+        "kv_dtype": handoff.engine_kv_dtype(dec),
+        "version": handoff.PROTOCOL_VERSION, "streams": streams,
+    }).encode()
+    s.sendall(handoff._MAGIC + handoff._I32.pack(len(hello)) + hello)
+    buf = b""
+    while len(buf) < 4:
+        buf += s.recv(4 - len(buf))
+    (status,) = handoff._I32.unpack(buf)
+    assert status == handoff.ACK_OK_STREAM
+    return s
+
+
+def _send_v2(sock, meta, payloads=()):
+    """Ship one chunk the way the exporter frames it."""
+    parts = [handoff._byte_view(np.ascontiguousarray(a))
+             for page in payloads for a in page]
+    for buf in handoff.chunk_parts(meta, parts):
+        sock.sendall(bytes(buf) if isinstance(buf, memoryview) else buf)
+
+
+def _pool_planes(dec, fill):
+    """One hand-built page payload matching dec's pool plane geometry."""
+    want = [((leaf.shape[0],) + tuple(leaf.shape[2:]), leaf.dtype)
+            for leaf in jax.tree.leaves(dec.kv_cache)]
+    return tuple(np.full(shape, fill).astype(dtype)
+                 for shape, dtype in want)
+
+
+class TestStreamingHandoff:
+    def test_v2_hello_negotiates_streaming_ack(self, setup):
+        """A version-2 hello gets ACK_OK_STREAM; the v1 hello (previous
+        class) keeps getting plain ACK_OK — both generations JOIN through
+        the same magic and the same dtype/adapter/epoch gates."""
+        cfg, params = setup
+        dec = make_engine(cfg, params, role="decode")
+        try:
+            _v2_dial(dec).close()
+        finally:
+            dec.stop()
+
+    def test_streaming_chunked_prefill_token_exact(self, setup):
+        """Tentpole acceptance: a chunked-prefill prompt streams page
+        chunks DURING prefill, the decode side imports them incrementally,
+        and the result is token-exact vs colocated. The exporter stats
+        prove the negotiated mode and the per-stream accounting."""
+        cfg, params = setup
+        colo = make_engine(cfg, params, **STREAM_KW)
+        try:
+            want = colo.generate(LONG_PROMPT, max_new_tokens=6,
+                                 timeout=300)["tokens"]
+        finally:
+            colo.stop()
+        pre, dec = _disagg_pair(cfg, params, **STREAM_KW)
+        try:
+            res = pre.generate(LONG_PROMPT, max_new_tokens=6, timeout=300)
+            assert res["finish_reason"] == "handoff"
+            assert res["tokens"] == [want[0]]
+            st = pre._handoff_exporter.stats()
+            assert st["mode"] == "stream" and st["streams"] == 2
+            assert st["exported"] == 1 and st["pages"] == 5
+            # every stream carried bytes (round-robin chunk placement)
+            assert len(st["stream_bytes"]) == 2
+            assert sum(st["stream_bytes"]) >= st["bytes"]  # + begin/end framing
+            assert dec._prefix.host_pages == 5
+            assert dec._handoff_server.stats()["imported"] == 1
+            out = dec.generate(LONG_PROMPT, max_new_tokens=6, timeout=300)
+            assert out["tokens"] == want, "streamed decode diverged from colocated"
+            assert_page_refs_consistent(pre)
+            assert_page_refs_consistent(dec)
+            assert_paged_pool_consistent(dec, slots_empty=True)
+        finally:
+            pre.stop()
+            dec.stop()
+
+    @pytest.mark.parametrize("kvq", ["int8", "int4"])
+    def test_streaming_token_exact_quantized(self, setup, kvq):
+        """Acceptance: streaming stays token-exact vs colocated on the
+        quantized pools too (bf16 is the test above) — the chunk codec
+        ships nibble planes and scale planes bit-identically."""
+        cfg, params = setup
+        kw = dict(STREAM_KW, kv_quantize=kvq)
+        colo = make_engine(cfg, params, **kw)
+        try:
+            want = colo.generate(LONG_PROMPT, max_new_tokens=5,
+                                 timeout=300)["tokens"]
+        finally:
+            colo.stop()
+        pre, dec = _disagg_pair(cfg, params, **kw)
+        try:
+            res = pre.generate(LONG_PROMPT, max_new_tokens=5, timeout=300)
+            assert res["tokens"] == [want[0]]
+            out = dec.generate(LONG_PROMPT, max_new_tokens=5, timeout=300)
+            assert out["tokens"] == want
+            assert pre._handoff_exporter.stats()["mode"] == "stream"
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_streaming_overlap_accounting(self, setup):
+        """Overlap is counted deterministically at the exporter API level:
+        pages staged and shipped BEFORE finish() count as overlap bytes,
+        the tail after finish() does not; the overlap counter and gauge
+        land in the registry."""
+        from gofr_tpu.tpu.engine import Request
+
+        cfg, params = setup
+        dec = make_engine(cfg, params, role="decode")
+        exp = None
+        try:
+            from gofr_tpu.metrics import Registry
+
+            metrics = Registry()
+            exp = handoff.HandoffExporter(
+                dec.handoff_addr, engine=None, timeout_s=5.0, streams=2,
+                chunk_pages=1, metrics=metrics)
+            req = Request(list(PROMPT), {}, timeout=30.0)
+            t = exp.begin_stream(req, np.asarray(PROMPT, np.int32),
+                                 dec._page_bytes, time.monotonic())
+            t.add([_pool_planes(dec, 0.25)])
+            exp.kick(t)
+            deadline = time.monotonic() + 5.0
+            while t.sent_pages < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)  # first page must ship pre-finish
+            assert t.sent_pages == 1
+            assert dec._prefix.host_pages == 1, "first page not imported incrementally"
+            t.add([_pool_planes(dec, 0.5)])
+            exp.finish(t, first_token=3, now=time.monotonic())
+            res = req.result(timeout=10.0)
+            assert res["finish_reason"] == "handoff" and res["tokens"] == [3]
+            st = exp.stats()
+            assert st["exported"] == 1 and st["pages"] == 2
+            assert 0 < st["overlap_bytes"] < st["bytes"]
+            assert 0 < st["overlap_ratio"] < 1
+            ovl = metrics.get("app_tpu_kv_handoff_overlap_bytes_total")
+            assert ovl is not None and sum(ovl._values.values()) == st["overlap_bytes"]
+            assert dec._prefix.host_pages == 2
+            assert dec._handoff_server.stats()["imported"] == 1
+        finally:
+            if exp is not None:
+                exp.close()
+            dec.stop()
+
+    def test_out_of_order_multistream_reassembly(self, setup):
+        """Chunk seq/start_page sequencing: page 1 lands on stream B
+        before page 0 lands on stream A (and before ``begin``!); the
+        importer parks it, then registers the contiguous prefix once page
+        0 arrives, and ACKs the ``end`` with everything imported."""
+        cfg, params = setup
+        dec = make_engine(cfg, params, role="decode")
+        try:
+            s0, s1 = _v2_dial(dec), _v2_dial(dec)
+            try:
+                pages = [_pool_planes(dec, 0.125), _pool_planes(dec, 0.375)]
+                planes_meta = [{"dtype": str(a.dtype), "shape": list(a.shape)}
+                               for a in pages[0]]
+                xfer = "test:oOo"
+                # page 1 first, on the OTHER stream, before begin
+                _send_v2(s1, {"v": 2, "kind": "pages", "xfer": xfer, "seq": 1,
+                              "start_page": 1, "n_pages": 1,
+                              "planes": planes_meta}, [pages[1]])
+                time.sleep(0.1)  # let it park (toks unknown: no import yet)
+                assert dec._prefix.host_pages == 0
+                _send_v2(s0, {"v": 2, "kind": "begin", "xfer": xfer,
+                              "toks": [int(x) for x in PROMPT],
+                              "nbytes_page": int(dec._page_bytes),
+                              "kv_dtype": handoff.engine_kv_dtype(dec)})
+                _send_v2(s0, {"v": 2, "kind": "pages", "xfer": xfer, "seq": 0,
+                              "start_page": 0, "n_pages": 1,
+                              "planes": planes_meta}, [pages[0]])
+                _send_v2(s0, {"v": 2, "kind": "end", "xfer": xfer,
+                              "total_pages": 2})
+                s0.settimeout(10.0)
+                (status,) = handoff._I32.unpack(s0.recv(4))
+                assert status == handoff.ACK_OK
+                assert dec._prefix.host_pages == 2
+                assert dec._handoff_server.stats()["imported"] == 1
+                assert dec._handoff_server.stats()["pages"] == 2
+                assert_page_refs_consistent(dec)
+            finally:
+                s0.close()
+                s1.close()
+        finally:
+            dec.stop()
+
+    def test_mixed_version_pair_token_exact(self, setup):
+        """Satellite: protocol compat across an in-place fleet upgrade,
+        both directions. A v2 exporter against a HANDOFF1-only server
+        negotiates DOWN to blob mode; a v1 exporter (streams=0) against a
+        v2 server JOINs as blob. Both pairs serve token-exact."""
+        cfg, params = setup
+        colo = make_engine(cfg, params)
+        try:
+            want = colo.generate(PROMPT, max_new_tokens=5, timeout=300)["tokens"]
+        finally:
+            colo.stop()
+        # new exporter → old server
+        dec = make_engine(cfg, params, role="decode")
+        dec._handoff_server.max_version = 1  # a pre-streaming build
+        pre = make_engine(cfg, params, role="prefill",
+                          handoff_target=dec.handoff_addr)
+        try:
+            res = pre.generate(PROMPT, max_new_tokens=5, timeout=300)
+            assert res["finish_reason"] == "handoff"
+            assert res["tokens"] == [want[0]]
+            st = pre._handoff_exporter.stats()
+            assert st["mode"] == "blob" and st["overlap_bytes"] == 0
+            assert dec._prefix.host_pages == 2
+            out = dec.generate(PROMPT, max_new_tokens=5, timeout=300)
+            assert out["tokens"] == want, "down-negotiated pair diverged"
+        finally:
+            pre.stop()
+            dec.stop()
+        # old exporter (streams=0 → version-less hello) → new server
+        dec = make_engine(cfg, params, role="decode")
+        pre = make_engine(cfg, params, role="prefill",
+                          handoff_target=dec.handoff_addr, handoff_streams=0)
+        try:
+            res = pre.generate(PROMPT, max_new_tokens=5, timeout=300)
+            assert res["finish_reason"] == "handoff"
+            assert res["tokens"] == [want[0]]
+            assert pre._handoff_exporter.stats()["mode"] == "blob"
+            out = dec.generate(PROMPT, max_new_tokens=5, timeout=300)
+            assert out["tokens"] == want, "v1-exporter pair diverged"
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_deadline_expiry_mid_stream_sheds_504(self, setup):
+        """A peer that ACKs the streaming JOIN and then goes silent (never
+        ACKs ``end``) is shed by the per-chunk deadline budget: 504
+        DeadlineExceeded with where="handoff", bounded by
+        HANDOFF_TIMEOUT_S, zero pages leaked on the prefill side."""
+        import json as _json
+        import threading
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+
+        conns = []  # keep refs: GC closing a conn would mask the stall
+
+        def _ack_stream_then_stall():
+            while True:
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                conns.append(conn)
+                conn.recv(len(handoff._MAGIC))
+                (n,) = handoff._I32.unpack(conn.recv(4))
+                _json.loads(conn.recv(n))
+                conn.sendall(handoff._I32.pack(handoff.ACK_OK_STREAM))
+                # accept chunks into the TCP buffer, never ACK the end
+
+        threading.Thread(target=_ack_stream_then_stall, daemon=True).start()
+        cfg, params = setup
+        eng = make_engine(cfg, params, role="prefill",
+                          handoff_target=f"127.0.0.1:{srv.getsockname()[1]}",
+                          handoff_timeout_s=0.5, **STREAM_KW)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded, match="handoff"):
+                eng.generate(LONG_PROMPT, max_new_tokens=4, timeout=300)
+            assert time.monotonic() - t0 < 10.0, "shed was not deadline-bounded"
+            shed = eng.metrics.get("app_request_deadline_exceeded_total")
+            counts = {dict(ls).get("where"): v for ls, v in shed._values.items()}
+            assert counts.get("handoff") == 1
+            assert_page_refs_consistent(eng)
+        finally:
+            eng.stop()
+            srv.close()
+
+    @pytest.mark.parametrize("spec", ["kv.handoff.hello:drop,nth=1",
+                                      "kv.handoff.hello:drop,nth=2",
+                                      "kv.handoff.chunk:drop,nth=1",
+                                      "kv.handoff.chunk:drop,nth=2",
+                                      "kv.handoff.midchunk:drop,nth=1"])
+    def test_chaos_stream_sever_points_zero_leak(self, setup, spec):
+        """Satellite: stream-granular sever drills. hello nth=1 severs the
+        export-side JOIN, nth=2 the import side (gates passed, ACK never
+        sent); chunk nth=1 severs at an export chunk boundary mid-prefill,
+        nth=2 drops the first chunk on the import side before any page
+        registers; midchunk tears the vectored write inside one frame.
+        Every drill: clean 504, zero leaked pages BOTH sides, and the
+        pair heals once chaos clears."""
+        cfg, params = setup
+        pre, dec = _disagg_pair(cfg, params, **STREAM_KW)
+        try:
+            with chaos.override(spec):
+                with pytest.raises(DeadlineExceeded, match="handoff"):
+                    pre.generate(LONG_PROMPT, max_new_tokens=4, timeout=300)
+            if "hello" in spec:
+                # the sever landed before ANY import could register
+                assert dec._prefix.host_pages == 0
+            # pages imported before a chunk-boundary sever are a valid
+            # (shorter) host prefix — retained by design, not a leak; the
+            # transfer itself never completes either way
+            assert dec._handoff_server.stats()["imported"] == 0
+            assert_page_refs_consistent(pre)
+            assert_page_refs_consistent(dec)
+            assert_paged_pool_consistent(dec, slots_empty=True)
+            # chaos cleared: the exporter re-dials, re-negotiates, heals
+            res = pre.generate(LONG_PROMPT, max_new_tokens=4, timeout=300)
+            assert res["finish_reason"] == "handoff"
+            assert dec._prefix.host_pages == 5
+            assert_page_refs_consistent(pre)
+            assert_page_refs_consistent(dec)
+        finally:
+            pre.stop()
+            dec.stop()
