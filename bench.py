@@ -42,6 +42,16 @@ Env knobs:
                               p50/p99, exact pool bytes-per-decode-token,
                               per-arm mbu_decode_lb, and token_exact/parity
                               vs the bf16 arm land in extra.kvdtype
+    GOFR_BENCH_TP             1 = also run the tensor-parallel paged-pool A/B
+                              (ISSUE 19): replicated vs tp-sharded KV pool
+                              on a forced multi-device host mesh (export
+                              XLA_FLAGS=--xla_force_host_platform_device_
+                              count=8), asserting token-exactness vs the
+                              single-device greedy reference, per-device
+                              pool bytes ≈ 1/tp, and strictly more pool
+                              pages at equal per-device HBM budget; verdicts
+                              land in extra.tp
+    GOFR_BENCH_TP_MESH        mesh for the TP A/B (default "dp:2,tp:4")
     GOFR_BENCH_SPEC           N>0 = speculative decoding with N lookup drafts
     GOFR_BENCH_SPEC_AB        1 = also measure paced mixed arrivals with spec
                               rounds on vs off at the configured KV layout
@@ -1821,6 +1831,103 @@ def main() -> None:
                 kvd[arm]["parity"] = None
                 kvd[arm]["token_exact"] = None
         extra["kvdtype"] = kvd
+
+    # Tensor-parallel paged-pool A/B (ISSUE 19): replicated vs tp-sharded
+    # pool on a forced multi-device host mesh (the CI job exports
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8; pin_cpu never
+    # lowers an existing count). Self-contained arms on the tiny f32 config
+    # — f32 keeps the argmax stable under the sharded o-projection reduce,
+    # so token-exactness vs the single-device greedy reference is a hard
+    # verdict, not a tolerance. Three claims: tokens exact on both arms,
+    # per-device pool bytes ≈ 1/tp of replicated, and strictly more pool
+    # pages per device at the replicated arm's per-device HBM budget.
+    if os.environ.get("GOFR_BENCH_TP") == "1":
+        from gofr_tpu.container import new_mock_container as _tp_container
+        from gofr_tpu.models import ModelSpec as _TPSpec
+        from gofr_tpu.testutil import greedy_reference as _tp_ref
+        from gofr_tpu.testutil import tiny_f32_llama as _tp_tiny
+        from gofr_tpu.tpu.engine import build_engine as _tp_build
+
+        tp_mesh = os.environ.get("GOFR_BENCH_TP_MESH", "dp:2,tp:4")
+        tp_size = 1
+        for _part in tp_mesh.split(","):
+            _ax, _, _n = _part.partition(":")
+            tp_size = tp_size * int(_n or 1) if _ax.strip() == "tp" else tp_size
+        needed = 1
+        for _part in tp_mesh.split(","):
+            needed *= int(_part.partition(":")[2] or 1)
+        if len(jax.devices()) < needed:
+            extra["tp"] = (f"skipped: mesh {tp_mesh!r} needs {needed} host "
+                           f"devices, have {len(jax.devices())} (export XLA_"
+                           f"FLAGS=--xla_force_host_platform_device_count={needed})")
+        else:
+            tcfg, tparams = _tp_tiny()
+            tref = _tp_ref(tcfg, tparams)
+            tp_new = 8
+            tp_prompts = [[1 + (13 * i + j) % 200 for j in range(6 + i % 3)]
+                          for i in range(6)]
+            tp_want = [tref(p, tp_new) for p in tp_prompts]
+            tp_arms: dict = {}
+            for arm, shard in (("replicated", "off"), ("sharded", "tp")):
+                ca = _tp_container({"TPU_MESH": tp_mesh,
+                                    "ENGINE_KV_SHARD": shard})
+                try:
+                    eng = _tp_build(
+                        _TPSpec(family="llama", task="generate", config=tcfg),
+                        ca, seed=3, slots=4, max_len=64, max_prefill_batch=2,
+                        kv_layout="paged", page_size=8)
+                    try:
+                        # per-device footprint at ALLOCATION time — the
+                        # high-water mark capacity sizing must fit. The
+                        # unsharded pool materializes whole on one device
+                        # (GSPMD may opportunistically reshard it after the
+                        # first donated step, but total_pages was already
+                        # sized against full planes); the sharded pool is
+                        # born 1/tp per device.
+                        per_dev: dict = {}
+                        for leaf in jax.tree.leaves(eng.kv_cache):
+                            for sh in leaf.addressable_shards:
+                                key = str(sh.device.id)
+                                per_dev[key] = per_dev.get(key, 0) + sh.data.nbytes
+                        t0a = time.monotonic()
+                        reqs = [eng.submit(p, max_new_tokens=tp_new,
+                                           timeout=timeout) for p in tp_prompts]
+                        res = [r.result(timeout) for r in reqs]
+                        el = time.monotonic() - t0a
+                        stats = eng.page_pool_stats() or {}
+                        tp_arms[arm] = {
+                            "kv_shards": int(getattr(eng, "kv_shards", 1)),
+                            "req_per_s": round(len(tp_prompts) / el, 3),
+                            "pool_bytes_per_device": max(per_dev.values()),
+                            "page_bytes_per_device": int(
+                                stats.get("page_bytes_device", 0)),
+                            "token_exact": [r["tokens"] for r in res] == tp_want,
+                        }
+                    finally:
+                        eng.stop()
+                except Exception as e:  # noqa: BLE001
+                    tp_arms[arm] = f"error: {e}"[:200]
+            tp_rec: dict = {"mesh": tp_mesh, "tp": tp_size, "arms": tp_arms}
+            rep, shd = tp_arms.get("replicated"), tp_arms.get("sharded")
+            if isinstance(rep, dict) and isinstance(shd, dict):
+                ratio = (shd["pool_bytes_per_device"]
+                         / max(1, rep["pool_bytes_per_device"]))
+                budget = rep["pool_bytes_per_device"]
+                pages_rep = budget // max(1, rep["page_bytes_per_device"])
+                pages_shd = budget // max(1, shd["page_bytes_per_device"])
+                tp_rec["verdicts"] = {
+                    "token_exact": bool(rep["token_exact"]
+                                        and shd["token_exact"]),
+                    "device_bytes_ratio": round(ratio, 4),
+                    # ≈ 1/tp with slack for the non-plane leaves (spec
+                    # history stays replicated when enabled; none here)
+                    "device_bytes_shrink_ok": ratio <= (1.0 / tp_size) * 1.25,
+                    "max_pages_equal_budget": {
+                        "replicated": int(pages_rep), "sharded": int(pages_shd),
+                        "sharded_gt": bool(pages_shd > pages_rep),
+                    },
+                }
+            extra["tp"] = tp_rec
 
     # Quality-plane drill (ISSUE 17). Clean arms: each KV dtype runs the
     # divergence shadow at rate 1.0 and must close with zero quality-SLO

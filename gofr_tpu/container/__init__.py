@@ -272,6 +272,9 @@ class Container:
                     "allocated fraction of the paged KV pool (engine)")
         m.new_gauge("app_tpu_kv_pool_fragmentation",
                     "claimed-but-unwritten fraction of slot-held pages (engine)")
+        m.new_gauge("app_tpu_kv_pool_device_bytes",
+                    "shard-local paged-KV pool bytes resident per device "
+                    "(engine, kv_shards) — fleet rollups sum, never average")
         # quality plane (metrics/quality.py; docs/observability.md): shadow
         # re-score divergence vs the reference configuration, keyed by what
         # the serving path actually used (kv_dtype, backend, adapter)
@@ -397,11 +400,21 @@ class Container:
             stats_fn = getattr(e, "page_pool_stats", None)
             stats = stats_fn() if callable(stats_fn) else None
             if stats:
+                # occupancy/fragmentation are page-count ratios — identical
+                # on every shard of a tp-sharded pool, so one gauge per
+                # engine IS the shard-local reading; the byte gauge is the
+                # per-DEVICE slice (engine.page_pool_stats), so a fleet
+                # sum-of-parts rollup over devices stays exact
                 self.metrics.set_gauge(
                     "app_tpu_kv_pool_occupancy", stats["occupancy"], engine=name)
                 self.metrics.set_gauge(
                     "app_tpu_kv_pool_fragmentation", stats["fragmentation"],
                     engine=name)
+                if "pool_bytes_device" in stats:
+                    self.metrics.set_gauge(
+                        "app_tpu_kv_pool_device_bytes",
+                        stats["pool_bytes_device"], engine=name,
+                        kv_shards=str(stats.get("kv_shards", 1)))
 
     def _maybe_remote_log_level(self) -> None:
         url = self.config.get("REMOTE_LOG_URL")

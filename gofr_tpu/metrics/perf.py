@@ -159,17 +159,22 @@ class CostModel:
     byte size. Pure arithmetic — every method is safe under any lock."""
 
     __slots__ = ("n_params", "weight_bytes", "kv_bytes_per_pos",
-                 "page_bytes", "page_size", "kv_dtype")
+                 "page_bytes", "page_size", "kv_dtype", "kv_shards")
 
     def __init__(self, *, n_params: float, weight_bytes: float,
                  kv_bytes_per_pos: float, page_bytes: float = 0.0,
-                 page_size: int = 0, kv_dtype: str = "bf16"):
+                 page_size: int = 0, kv_dtype: str = "bf16",
+                 kv_shards: int = 1):
         self.n_params = float(n_params)
         self.weight_bytes = float(weight_bytes)
+        # on a tp-sharded pool the engine passes PER-DEVICE byte figures
+        # (1/kv_shards of the logical planes): every roofline this model
+        # prices is a per-device bound, and the fleet rollup sums parts
         self.kv_bytes_per_pos = float(kv_bytes_per_pos)
         self.page_bytes = float(page_bytes)
         self.page_size = int(page_size)
         self.kv_dtype = kv_dtype or "bf16"
+        self.kv_shards = max(1, int(kv_shards))
 
     def prefill(self, tokens: int) -> tuple[float, float]:
         """Batched prefill of ``tokens`` real prompt tokens (padding
@@ -231,6 +236,7 @@ class CostModel:
             "page_bytes": self.page_bytes,
             "page_size": self.page_size,
             "kv_dtype": self.kv_dtype,
+            "kv_shards": self.kv_shards,
         }
 
 

@@ -584,27 +584,32 @@ def verify_step_paged(cfg: LlamaConfig, params: dict, tokens: jnp.ndarray,
     return logits, out_cache
 
 
-def make_paged_cache(cfg: LlamaConfig, pages: int, page_size: int = 128) -> PagedKVCache:
+def make_paged_cache(cfg: LlamaConfig, pages: int, page_size: int = 128,
+                     sharding=None) -> PagedKVCache:
     return PagedKVCache.create(
         cfg.num_layers, pages, page_size, cfg.num_kv_heads, cfg.head_size,
-        dtype=cfg.dtype,
+        dtype=cfg.dtype, sharding=sharding,
     )
 
 
-def make_paged_cache_q(cfg: LlamaConfig, pages: int, page_size: int = 128) -> QPagedKVCache:
+def make_paged_cache_q(cfg: LlamaConfig, pages: int, page_size: int = 128,
+                       sharding=None) -> QPagedKVCache:
     """int8 paged pool (ops.paged.QPagedKVCache): prefill_paged /
     decode_step_paged branch on the cache type, like the slot layout."""
     return QPagedKVCache.create(
         cfg.num_layers, pages, page_size, cfg.num_kv_heads, cfg.head_size,
+        sharding=sharding,
     )
 
 
-def make_paged_cache_q4(cfg: LlamaConfig, pages: int, page_size: int = 128) -> Q4PagedKVCache:
+def make_paged_cache_q4(cfg: LlamaConfig, pages: int, page_size: int = 128,
+                        sharding=None) -> Q4PagedKVCache:
     """Packed-int4 paged pool (ops.paged.Q4PagedKVCache): same plane names
     as the int8 pool so the scan xs plumbing is shared; only the per-plane
     write/gather/attention helpers differ (cache-type branch)."""
     return Q4PagedKVCache.create(
         cfg.num_layers, pages, page_size, cfg.num_kv_heads, cfg.head_size,
+        sharding=sharding,
     )
 
 

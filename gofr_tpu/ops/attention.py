@@ -248,6 +248,51 @@ def decode_attention_q(
     return out.reshape(b, hq, d)
 
 
+# -- tensor-parallel dispatch ------------------------------------------------
+#
+# When an engine pins a paged.KVShardCtx (pool planes sharded over the
+# mesh's tp axis along KV heads), the three paged decode entry points wrap
+# their single-device bodies in shard_map: each device runs the SAME kernel
+# (Pallas or XLA gather) over its own Hkv/tp heads and Hq/tp query heads,
+# block tables and lengths replicated. No collective is emitted here — the
+# output stays head-sharded and the model's o-projection matmul (tp-sharded
+# wo) supplies the single psum that already existed for the weights.
+
+
+def _kv_shard_ctx(q: jnp.ndarray, pool: jnp.ndarray):
+    """The pinned shard ctx, or None when the geometry can't split (head
+    counts must divide evenly — sharding never pads heads)."""
+    from gofr_tpu.ops.paged import current_kv_shard
+
+    ctx = current_kv_shard()
+    if ctx is None:
+        return None
+    if q.shape[1] % ctx.shards or pool.shape[1] % ctx.shards:
+        return None
+    return ctx
+
+
+def _shard_paged_call(impl, ctx, q, pools, table, lengths):
+    """Run ``impl(q, *pools, table, lengths)`` per-shard: q and every pool
+    plane split on their head axis (dim 1), table/lengths replicated, output
+    head-sharded (no reduce — see module note above)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ax = ctx.axis
+    pool_specs = tuple(
+        P(None, ax, None, None) if p.ndim == 4 else P(None, ax, None)
+        for p in pools
+    )
+    return shard_map(
+        impl,
+        mesh=ctx.mesh,
+        in_specs=(P(None, ax, None),) + pool_specs + (P(), P()),
+        out_specs=P(None, ax, None),
+        check_rep=False,
+    )(q, *pools, table, lengths)
+
+
 def paged_decode_attention_q(
     q: jnp.ndarray,        # [N, Hq, D]
     kq_pool: jnp.ndarray,  # int8 [P, Hkv, page, D]
@@ -255,6 +300,29 @@ def paged_decode_attention_q(
     ks_pool: jnp.ndarray,  # [P, Hkv, page]
     vs_pool: jnp.ndarray,
     table: jnp.ndarray,    # [N, MaxP]
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    backend: str = "auto",
+) -> jnp.ndarray:
+    ctx = _kv_shard_ctx(q, kq_pool)
+    if ctx is not None:
+        impl = partial(_paged_decode_attention_q_local, scale=scale, backend=backend)
+        return _shard_paged_call(impl, ctx, q, (kq_pool, vq_pool, ks_pool, vs_pool),
+                                 table, lengths)
+    return _paged_decode_attention_q_local(
+        q, kq_pool, vq_pool, ks_pool, vs_pool, table, lengths,
+        scale=scale, backend=backend,
+    )
+
+
+def _paged_decode_attention_q_local(
+    q: jnp.ndarray,
+    kq_pool: jnp.ndarray,
+    vq_pool: jnp.ndarray,
+    ks_pool: jnp.ndarray,
+    vs_pool: jnp.ndarray,
+    table: jnp.ndarray,
     lengths: jnp.ndarray,
     *,
     scale: float | None = None,
@@ -307,6 +375,29 @@ def paged_decode_attention_q4(
     scale: float | None = None,
     backend: str = "auto",
 ) -> jnp.ndarray:
+    ctx = _kv_shard_ctx(q, kq_pool)
+    if ctx is not None:
+        impl = partial(_paged_decode_attention_q4_local, scale=scale, backend=backend)
+        return _shard_paged_call(impl, ctx, q, (kq_pool, vq_pool, ks_pool, vs_pool),
+                                 table, lengths)
+    return _paged_decode_attention_q4_local(
+        q, kq_pool, vq_pool, ks_pool, vs_pool, table, lengths,
+        scale=scale, backend=backend,
+    )
+
+
+def _paged_decode_attention_q4_local(
+    q: jnp.ndarray,
+    kq_pool: jnp.ndarray,
+    vq_pool: jnp.ndarray,
+    ks_pool: jnp.ndarray,
+    vs_pool: jnp.ndarray,
+    table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    backend: str = "auto",
+) -> jnp.ndarray:
     """paged_decode_attention over a PACKED int4 pool (ops.paged.
     Q4PagedKVCache; ops/quant.pack_int4 split-half nibble format).
 
@@ -347,6 +438,25 @@ def paged_decode_attention_q4(
 
 
 def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    backend: str = "auto",
+) -> jnp.ndarray:
+    ctx = _kv_shard_ctx(q, k_pool)
+    if ctx is not None:
+        impl = partial(_paged_decode_attention_local, scale=scale, backend=backend)
+        return _shard_paged_call(impl, ctx, q, (k_pool, v_pool), table, lengths)
+    return _paged_decode_attention_local(
+        q, k_pool, v_pool, table, lengths, scale=scale, backend=backend,
+    )
+
+
+def _paged_decode_attention_local(
     q: jnp.ndarray,
     k_pool: jnp.ndarray,
     v_pool: jnp.ndarray,

@@ -107,13 +107,19 @@ def shape_key(*dims: int) -> str:
 
 
 def entry_key(device_kind: str, op: str, shape: str, kv_dtype: str,
-              role: str = "") -> str:
-    """Cache key. ``role`` (ENGINE_ROLE, disaggregated serving) is appended
-    only when it narrows the decision — ``""``/``"both"`` keep the exact
-    pre-role key so existing cache files stay valid."""
+              role: str = "", sharding: str = "") -> str:
+    """Cache key. ``role`` (ENGINE_ROLE, disaggregated serving) and
+    ``sharding`` (pool mesh sharding, e.g. ``"tp4"``) are appended only
+    when they narrow the decision — ``""``/``"both"`` role and ``""``
+    sharding keep the exact pre-feature key, so existing cache files stay
+    valid and an unsharded engine never reads a sharded pin (or vice
+    versa: per-shard shapes change the winner, so pins must not leak
+    across mesh geometries)."""
     key = "|".join((str(device_kind), op, shape, str(kv_dtype)))
     if role and role != "both":
         key += f"|role={role}"
+    if sharding:
+        key += f"|shard={sharding}"
     return key
 
 
@@ -173,7 +179,7 @@ class Autotuner:
 
     def __init__(self, device_kind: str = "cpu", cache_file: str | None = None,
                  timer: Callable[[Callable[[], Any]], float] | None = None,
-                 logger: Any = None, role: str = ""):
+                 logger: Any = None, role: str = "", sharding: str = ""):
         self.device_kind = device_kind
         self.cache_file = cache_file
         self.timer = timer or _default_timer
@@ -182,6 +188,10 @@ class Autotuner:
         # under their own cache keys, so its warmup neither waits on nor
         # clobbers a colocated engine's measurements for the same shapes
         self.role = role if role not in ("", "both") else ""
+        # sharding-scoped keys (tp pool sharding): per-shard shapes are
+        # 1/tp the replicated ones, so a pin measured on one mesh geometry
+        # is stale for another; "" (unsharded) keeps pre-feature keys
+        self.sharding = sharding or ""
         self.decisions: dict[str, dict] = {}  # op -> decision record
         self._cache = _load_cache(cache_file, logger)  # lookups only
         self._own: dict[str, dict] = {}  # keys THIS tuner decided (persisted)
@@ -193,7 +203,8 @@ class Autotuner:
         fallback path costs zero device work). A candidate that raises
         (e.g. Mosaic rejects the shape) loses by disqualification; if every
         candidate fails, 'xla' — the everywhere-correct path — is pinned."""
-        key = entry_key(self.device_kind, op, shape, kv_dtype, self.role)
+        key = entry_key(self.device_kind, op, shape, kv_dtype, self.role,
+                        self.sharding)
         cached = self._cache.get(key)
         if cached is not None and cached.get("backend") in candidates:
             rec = {"backend": cached["backend"], "shape": shape, "kv_dtype": kv_dtype,
@@ -256,6 +267,8 @@ class Autotuner:
                                "decisions": dict(self.decisions)}
         if self.role:
             out["role"] = self.role
+        if self.sharding:
+            out["sharding"] = self.sharding
         return out
 
 

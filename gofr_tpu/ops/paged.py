@@ -72,6 +72,93 @@ def write_mode_scope(mode: str | None):
         _WRITE_MODE.reset(tok)
 
 
+# -- tensor-parallel pool sharding ------------------------------------------
+#
+# The pool planes shard over the mesh's tp axis along the KV-head dimension
+# (axis 2 of [L, P, Hkv, page, D]; axis 2 of the [L, P, Hkv, page] scale
+# planes too). Block tables stay replicated — page ids are logical, not
+# per-shard — and the decode attention ops run per-shard under shard_map
+# when an engine pins a KVShardCtx for its traces (engine._trace_scope),
+# mirroring the write-mode pin above.
+
+
+@dataclass(frozen=True)
+class KVShardCtx:
+    """Trace-time pin describing how the paged pool is sharded: the mesh,
+    the mesh axis the KV-head dimension is split over, and the shard count
+    (= mesh.shape[axis]). Engines enter ``kv_shard_scope`` with this for
+    every trace they drive so the paged decode ops wrap themselves in
+    shard_map; direct ops callers (unit tests) enter it explicitly."""
+
+    mesh: object  # jax.sharding.Mesh
+    axis: str = "tp"
+    shards: int = 1
+
+
+_KV_SHARD: contextvars.ContextVar[KVShardCtx | None] = contextvars.ContextVar(
+    "gofr_paged_kv_shard", default=None
+)
+
+
+def current_kv_shard() -> KVShardCtx | None:
+    """The pinned pool-sharding context, or None (unsharded pool)."""
+    ctx = _KV_SHARD.get()
+    if ctx is not None and ctx.shards > 1:
+        return ctx
+    return None
+
+
+@contextlib.contextmanager
+def kv_shard_scope(ctx: KVShardCtx | None):
+    """Pin the pool sharding for traces inside the scope (None = unsharded)."""
+    tok = _KV_SHARD.set(ctx)
+    try:
+        yield
+    finally:
+        _KV_SHARD.reset(tok)
+
+
+def plane_partition_spec(ndim: int, axis: str = "tp"):
+    """PartitionSpec for one pool plane by rank: K/V planes are 5-D
+    [L, P, Hkv, page, D], scale planes 4-D [L, P, Hkv, page] — the KV-head
+    axis is dim 2 in both. Anything else (spec history planes, block
+    tables) stays replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    if ndim == 5:
+        return P(None, None, axis, None, None)
+    if ndim == 4:
+        return P(None, None, axis, None)
+    return P()
+
+
+def pool_sharding(mesh, axis: str = "tp"):
+    """NamedSharding for the 5-D K/V planes — what engines hand to the
+    cache constructors. Scale planes derive their 4-D spec internally."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, plane_partition_spec(5, axis))
+
+
+def _shard_for(sharding, ndim: int):
+    """Re-rank a 5-D plane NamedSharding for an ndim-rank plane (the scale
+    planes drop the trailing head_dim axis)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = tuple(sharding.spec) + (None,) * (5 - len(tuple(sharding.spec)))
+    return NamedSharding(sharding.mesh, PartitionSpec(*spec[:ndim]))
+
+
+def _zeros(shape, dtype, sharding=None) -> jnp.ndarray:
+    """Zero-filled plane, allocated DIRECTLY under ``sharding`` when given —
+    jit with out_shardings materializes each device's shard in place, so a
+    sharded pool never exists replicated, not even transiently at create."""
+    if sharding is None:
+        return jnp.zeros(shape, dtype)
+    return jax.jit(partial(jnp.zeros, shape, dtype),
+                   out_shardings=_shard_for(sharding, len(shape)))()
+
+
 def _locate(pages: jnp.ndarray, pos: jnp.ndarray, page: int) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(physical page, in-page offset) per logical position. ``pages``
     [B, MaxP] block-table rows, ``pos`` [B, S] logical positions. The
@@ -96,9 +183,10 @@ class PagedKVCache:
         kv_heads: int,
         head_dim: int,
         dtype=jnp.bfloat16,
+        sharding=None,
     ) -> "PagedKVCache":
         shape = (layers, pages, kv_heads, page_size, head_dim)
-        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+        return cls(k=_zeros(shape, dtype, sharding), v=_zeros(shape, dtype, sharding))
 
     @property
     def num_layers(self) -> int:
@@ -129,13 +217,14 @@ class QPagedKVCache:
 
     @classmethod
     def create(cls, layers: int, pages: int, page_size: int, kv_heads: int,
-               head_dim: int, dtype=None) -> "QPagedKVCache":
+               head_dim: int, dtype=None, sharding=None) -> "QPagedKVCache":
         del dtype
         shape = (layers, pages, kv_heads, page_size, head_dim)
         sshape = (layers, pages, kv_heads, page_size)
         return cls(
-            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
-            ks=jnp.zeros(sshape, jnp.bfloat16), vs=jnp.zeros(sshape, jnp.bfloat16),
+            k=_zeros(shape, jnp.int8, sharding), v=_zeros(shape, jnp.int8, sharding),
+            ks=_zeros(sshape, jnp.bfloat16, sharding),
+            vs=_zeros(sshape, jnp.bfloat16, sharding),
         )
 
     @property
@@ -173,15 +262,16 @@ class Q4PagedKVCache:
 
     @classmethod
     def create(cls, layers: int, pages: int, page_size: int, kv_heads: int,
-               head_dim: int, dtype=None) -> "Q4PagedKVCache":
+               head_dim: int, dtype=None, sharding=None) -> "Q4PagedKVCache":
         del dtype
         if head_dim % 2:
             raise ValueError(f"int4 packing needs an even head_dim, got {head_dim}")
         shape = (layers, pages, kv_heads, page_size, head_dim // 2)
         sshape = (layers, pages, kv_heads, page_size)
         return cls(
-            k=jnp.zeros(shape, jnp.uint8), v=jnp.zeros(shape, jnp.uint8),
-            ks=jnp.zeros(sshape, jnp.bfloat16), vs=jnp.zeros(sshape, jnp.bfloat16),
+            k=_zeros(shape, jnp.uint8, sharding), v=_zeros(shape, jnp.uint8, sharding),
+            ks=_zeros(sshape, jnp.bfloat16, sharding),
+            vs=_zeros(sshape, jnp.bfloat16, sharding),
         )
 
     @property
@@ -199,7 +289,8 @@ class Q4PagedKVCache:
 
 def kv_plane_bytes_per_position(layers: int, kv_heads: int, head_dim: int,
                                 kv_dtype: str = "bf16",
-                                dense_bytes: int = 2) -> int:
+                                dense_bytes: int = 2,
+                                shards: int = 1) -> int:
     """Analytic per-position pool footprint across every cache plane, by
     layout contract: dense pools carry k+v at ``dense_bytes`` per element
     (bf16 on TPU; pass 4 where the backend promotes to fp32, as CPU
@@ -208,7 +299,17 @@ def kv_plane_bytes_per_position(layers: int, kv_heads: int, head_dim: int,
     cross-check for the EXACT accounting the live perf plane reads off
     the pool leaves (metrics/perf.py) and what bench archives as
     ``kv_bytes_per_decode_token`` — on the tiny CPU config the three
-    layouts come out 512 / 144 / 80."""
+    layouts come out 512 / 144 / 80.
+
+    ``shards`` > 1 reports the PER-DEVICE footprint of a tp-sharded pool
+    (KV heads split over the mesh's tp axis): each device holds
+    ``kv_heads // shards`` heads of every plane. Requires divisibility —
+    sharding never pads heads."""
+    if shards > 1:
+        if kv_heads % shards:
+            raise ValueError(
+                f"kv_heads={kv_heads} not divisible by shards={shards}")
+        kv_heads //= shards
     if kv_dtype == "int4":
         per = 2 * (head_dim // 2) + 4   # packed k+v nibbles + bf16 scales
     elif kv_dtype in ("int8", "q", "quant"):

@@ -324,6 +324,11 @@ class _EngineBase:
             from gofr_tpu.ops.paged import write_mode_scope
 
             stack.enter_context(write_mode_scope(mode))
+        ctx = self._kv_shard_ctx() if hasattr(self, "_kv_shard_ctx") else None
+        if ctx is not None:
+            from gofr_tpu.ops.paged import kv_shard_scope
+
+            stack.enter_context(kv_shard_scope(ctx))
         pins = getattr(self, "_autotune_pins", None)
         if pins:
             from gofr_tpu.ops import autotune
@@ -941,6 +946,7 @@ class GenerateEngine(_EngineBase):
         prefix_host_mb: float = 0.0,
         spec_tokens: int = 0,
         kv_quantize: str = "",
+        kv_shard: str = "auto",
         prefill_attn_fn: Any = None,
         prefill_attn_divisor: int = 1,
         lockstep_role: str | None = None,
@@ -1142,6 +1148,10 @@ class GenerateEngine(_EngineBase):
             raise ValueError(
                 "kv_quantize='int4' needs kv_layout='paged' (packed-nibble "
                 "pages); the slot layout supports '' or 'int8'")
+        # tensor-parallel pool sharding (ENGINE_KV_SHARD): 1 = unsharded.
+        # Resolved before the cache is built; the slot layout never shards.
+        self.kv_shards = 1
+        self._kv_pool_sharding = None
         if kv_layout == "paged":
             kvq_attr = ("make_paged_cache_q4" if kv_quantize == "int4"
                         else "make_paged_cache_q")
@@ -1167,6 +1177,13 @@ class GenerateEngine(_EngineBase):
             from gofr_tpu.ops.paged import resolve_write_mode
 
             self.paged_kv_write = resolve_write_mode(paged_kv_write or None)
+            # Shard the pool over the mesh's tp axis along KV heads
+            # (ops/paged.pool_sharding): per-device plane bytes drop to
+            # 1/tp, and every trace this engine drives pins a KVShardCtx
+            # (_trace_scope) so the paged decode ops run per-shard under
+            # shard_map. "auto" stands down (1 shard, bit-identical to the
+            # unsharded engine) whenever the mesh/geometry can't split.
+            self.kv_shards, self._kv_pool_sharding = self._resolve_kv_shard(kv_shard)
             # The in-place Pallas page append redirects OOB rows' aliased
             # tile fetch to page 0 (ops/pallas/kv_append.py) — reserve it
             # as a never-allocated sink so an OOB copy-through can never
@@ -1208,6 +1225,13 @@ class GenerateEngine(_EngineBase):
             # bf16; k/v/ks/vs for int8) — the page axis is always axis 1
             self._page_bytes = sum(
                 leaf.nbytes // self.total_pages for leaf in jax.tree.leaves(self.kv_cache)
+            )
+            # whole-pool LOGICAL footprint (.nbytes is global even for a
+            # sharded array); page_pool_stats and /debug/perf report the
+            # per-device slice (// kv_shards) so fleet sum-of-parts rollups
+            # stay exact on sharded engines
+            self._pool_bytes = sum(
+                leaf.nbytes for leaf in jax.tree.leaves(self.kv_cache)
             )
             host_budget = int(host_mb * (1 << 20))
             if host_budget and host_budget < self._page_bytes:
@@ -1308,15 +1332,22 @@ class GenerateEngine(_EngineBase):
             devices = getattr(self.tpu, "devices", None)
             dev_kind = (getattr(devices[0], "device_kind", None) if devices
                         else None) or getattr(self.tpu, "platform", "cpu")
+            # per-DEVICE pricing: a tp-sharded pool moves 1/kv_shards of
+            # every plane byte through each device, and the fleet rollup
+            # (sum-of-parts, metrics/perf.py) multiplies back by summing
+            # over devices — the gap vs the single-chip roofline is then
+            # the measured interconnect cost
+            shards = max(1, getattr(self, "kv_shards", 1))
             self.perf = PerfPlane(
                 CostModel(
                     n_params=sum(
                         leaf.size for leaf in jax.tree.leaves(self.params)),
                     weight_bytes=quantized_bytes(self.params),
-                    kv_bytes_per_pos=pool_bytes / max(1, positions),
-                    page_bytes=getattr(self, "_page_bytes", 0.0),
+                    kv_bytes_per_pos=pool_bytes / max(1, positions) / shards,
+                    page_bytes=getattr(self, "_page_bytes", 0.0) / shards,
                     page_size=page_size if kv_layout == "paged" else 0,
                     kv_dtype=self.kv_quantize or "bf16",
+                    kv_shards=shards,
                 ),
                 str(dev_kind))
         except Exception as e:  # pragma: no cover - meter must not gate serving
@@ -1662,7 +1693,9 @@ class GenerateEngine(_EngineBase):
                 else None) or getattr(self.tpu, "platform", "cpu")
         tuner = autotune.Autotuner(
             device_kind=str(kind), cache_file=autotune.cache_path(),
-            timer=self._autotune_timer, logger=self.logger, role=self.role)
+            timer=self._autotune_timer, logger=self.logger, role=self.role,
+            sharding=(f"tp{self.kv_shards}"
+                      if getattr(self, "kv_shards", 1) > 1 else ""))
         pallas_ok = kernel_platform()
         t0 = time.monotonic()
         n = self.num_slots
@@ -1752,13 +1785,24 @@ class GenerateEngine(_EngineBase):
                 "autotune: %s -> %s (%s, shapes %s, %s)", op, rec["backend"],
                 rec["source"], rec["shape"], rec.get("timings_ms") or "untimed")
 
-    @staticmethod
-    def _at_fn(op_fn, backend: str, *arrays):
+    def _at_fn(self, op_fn, backend: str, *arrays):
         """A timed autotune candidate: the op jitted over REAL device-shaped
         array arguments (arguments, not closure constants — XLA must not
-        fold the benchmark away) with the backend bound explicitly."""
+        fold the benchmark away) with the backend bound explicitly. On a
+        tp-sharded pool the candidate traces under the engine's KVShardCtx
+        so the timing races the per-shard program the serving traces will
+        actually run — that is what the sharding-scoped cache key pins."""
         jf = jax.jit(partial(op_fn, backend=backend))
-        return lambda: jf(*arrays)
+        ctx = self._kv_shard_ctx()
+        if ctx is None:
+            return lambda: jf(*arrays)
+        from gofr_tpu.ops.paged import kv_shard_scope
+
+        def run():
+            with kv_shard_scope(ctx):
+                return jf(*arrays)
+
+        return run
 
     def autotune_report(self) -> dict | None:
         """The warmup autotuner's decision table (None until warmup ran or
@@ -1821,6 +1865,7 @@ class GenerateEngine(_EngineBase):
                 "total_pages": getattr(self, "total_pages", 0),
                 "spec_tokens": self.spec_tokens,
                 "kv_quantize": self.kv_quantize,
+                "kv_shards": getattr(self, "kv_shards", 1),
                 "top_k": self.top_k,
                 "top_p": self.top_p,
             },
@@ -1845,10 +1890,19 @@ class GenerateEngine(_EngineBase):
             live = sum(s.pos for s in self.slots if s is not None)
         usable = max(1, self.total_pages - self._page_sink)
         covered = held * self.page_size
+        # Byte fields are SHARD-LOCAL (per-device): on a tp-sharded pool
+        # each device holds 1/kv_shards of every plane, and a fleet rollup
+        # that sums parts must see parts, not the logical-global footprint
+        # multiplied per engine. Occupancy/fragmentation are ratios over
+        # page COUNTS (replicated bookkeeping) and are shard-invariant.
+        shards = max(1, getattr(self, "kv_shards", 1))
         return {
             "total_pages": self.total_pages,
             "free_pages": free,
             "slot_pages": held,
+            "kv_shards": shards,
+            "page_bytes_device": getattr(self, "_page_bytes", 0) // shards,
+            "pool_bytes_device": getattr(self, "_pool_bytes", 0) // shards,
             "occupancy": round(1.0 - free / usable, 4),
             "fragmentation": round(1.0 - min(1.0, live / covered), 4)
             if covered else 0.0,
@@ -2256,13 +2310,24 @@ class GenerateEngine(_EngineBase):
 
     def _place_cache(self, cache):
         """Cache placement shared by the ctor and every rebuild site: under
-        lockstep the (process-local) cache must be placed as a replicated
-        GLOBAL array on the engine's mesh, or the first rebuilt-cache
-        program would re-place it differently from the other processes."""
+        lockstep the (process-local) cache must be placed as a GLOBAL array
+        on the engine's mesh, or the first rebuilt-cache program would
+        re-place it differently from the other processes. A tp-sharded pool
+        keeps its plane sharding (head axis split, everything else — spec
+        history — replicated); unsharded engines place replicated as
+        before."""
         if not self.lockstep_role:
             return cache
         from jax.sharding import NamedSharding, PartitionSpec as _P
 
+        if getattr(self, "kv_shards", 1) > 1:
+            from gofr_tpu.ops.paged import plane_partition_spec
+
+            def place(leaf):
+                spec = plane_partition_spec(leaf.ndim) if leaf.ndim >= 4 else _P()
+                return jax.device_put(leaf, NamedSharding(self.tpu.mesh, spec))
+
+            return jax.tree.map(place, cache)
         return jax.device_put(cache, NamedSharding(self.tpu.mesh, _P()))
 
     def _reset_device_state(self) -> None:
@@ -2313,6 +2378,7 @@ class GenerateEngine(_EngineBase):
             self.kv_layout, self.page_size if self.kv_layout == "paged" else 0,
             getattr(self, "total_pages", 0), self.spec_tokens,
             self.kv_quantize, self.top_k, self.top_p,
+            getattr(self, "kv_shards", 1),
         )
 
     def _fleet_admit(self) -> bool:
@@ -2447,6 +2513,63 @@ class GenerateEngine(_EngineBase):
         history plane is slot-indexed, not page-indexed."""
         return self.cache[0] if isinstance(self.cache, tuple) else self.cache
 
+    def _paged_make_fn(self):
+        if self.kv_quantize == "int4":
+            return self.family.make_paged_cache_q4
+        if self.kv_quantize:
+            return self.family.make_paged_cache_q
+        return self.family.make_paged_cache
+
+    def _resolve_kv_shard(self, kv_shard: str):
+        """(shards, pool NamedSharding) for ENGINE_KV_SHARD: 'off'/'0' → 1
+        (unsharded, today's placement bit-for-bit); 'auto' → the mesh's tp
+        size when the geometry can split (tp > 1, head counts divide, the
+        family's cache constructor takes a sharding); explicit 'tp' raises
+        when it can't — an operator who asked for sharding must not get a
+        silently replicated pool."""
+        mode = str(kv_shard or "auto").strip().lower()
+        if mode in ("", "0", "off", "none", "no"):
+            return 1, None
+        if mode not in ("auto", "1", "tp"):
+            raise ValueError(
+                f"unknown ENGINE_KV_SHARD {kv_shard!r}; use 'auto', 'tp' or 'off'")
+        import inspect
+
+        axis = "tp"
+        mesh = getattr(self.tpu, "mesh", None)
+        tp = 0
+        if mesh is not None and axis in getattr(mesh, "axis_names", ()):
+            tp = int(mesh.shape[axis])
+        hkv = int(getattr(self.cfg, "num_kv_heads", 0) or 0)
+        hq = int(getattr(self.cfg, "num_heads", 0) or 0)
+        try:
+            supports = "sharding" in inspect.signature(self._paged_make_fn()).parameters
+        except (TypeError, ValueError):
+            supports = False
+        why = None
+        if tp <= 1:
+            why = "mesh has no tp axis with more than one device"
+        elif not supports:
+            why = "family cache constructor takes no sharding"
+        elif hkv <= 0 or hkv % tp or hq <= 0 or hq % tp:
+            why = (f"head counts (num_heads={hq}, num_kv_heads={hkv}) do not "
+                   f"divide by tp={tp}")
+        if why is not None:
+            if mode == "tp":
+                raise ValueError(f"ENGINE_KV_SHARD=tp impossible: {why}")
+            return 1, None
+        from gofr_tpu.ops.paged import pool_sharding
+
+        return tp, pool_sharding(mesh, axis)
+
+    def _kv_shard_ctx(self):
+        """The paged.KVShardCtx this engine pins for its traces, or None."""
+        if getattr(self, "kv_shards", 1) <= 1:
+            return None
+        from gofr_tpu.ops.paged import KVShardCtx
+
+        return KVShardCtx(self.tpu.mesh, "tp", self.kv_shards)
+
     def _build_paged_cache(self):
         """One construction site for ctor AND crash-restart rebuild: the
         two must always agree on the cache kind (int4 vs int8 vs dense).
@@ -2454,17 +2577,24 @@ class GenerateEngine(_EngineBase):
         pytree the slot layout uses — (kv, hist), hist [num_slots, Hcap]
         int32 with Hcap = pages_per_slot * page_size — so the device keeps
         the prompt-lookup history and spec rounds ride the pipeline without
-        the host shipping history rows every dispatch (tpu/programs.py)."""
-        if self.kv_quantize == "int4":
-            make = self.family.make_paged_cache_q4
-        elif self.kv_quantize:
-            make = self.family.make_paged_cache_q
+        the host shipping history rows every dispatch (tpu/programs.py).
+        A sharded pool is allocated DIRECTLY under its NamedSharding (no
+        replicated transient); the hist plane is slot-indexed, not
+        head-indexed, so it stays replicated on the same mesh."""
+        make = self._paged_make_fn()
+        if self._kv_pool_sharding is not None:
+            kv = make(self.cfg, self.total_pages, self.page_size,
+                      sharding=self._kv_pool_sharding)
         else:
-            make = self.family.make_paged_cache
-        kv = make(self.cfg, self.total_pages, self.page_size)
+            kv = make(self.cfg, self.total_pages, self.page_size)
         if self.spec_tokens:
             hcap = self.pages_per_slot * self.page_size
-            return (kv, jnp.zeros((self.num_slots, hcap), jnp.int32))
+            hist = jnp.zeros((self.num_slots, hcap), jnp.int32)
+            if self._kv_pool_sharding is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as _P
+
+                hist = jax.device_put(hist, NamedSharding(self.tpu.mesh, _P()))
+            return (kv, hist)
         return kv
 
     def _ref_page(self, p: int) -> None:
@@ -3975,6 +4105,8 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
                                         conf.get_float("ENGINE_PREFIX_HOST_MB", 0.0))),
             spec_tokens=spec_tokens,
             kv_quantize=kv_quantize,
+            kv_shard=str(kw.pop("kv_shard",
+                                conf.get_or_default("ENGINE_KV_SHARD", "auto"))),
             prefill_attn_fn=prefill_attn,
             prefill_attn_divisor=sp_size if prefill_attn is not None else 1,
             lockstep_role=lockstep_role,

@@ -96,6 +96,13 @@ ACK_DTYPE_MISMATCH = 2
 ACK_ADAPTER_MISMATCH = 3
 ACK_EPOCH_MISMATCH = 4
 ACK_OK_STREAM = 5  # JOIN accepted, HANDOFF2 chunk frames on this connection
+# mesh-sharding JOIN gate: exported page payloads are LOGICAL (full-head)
+# rows either way, but a tp-degree mismatch means the two sides compiled
+# different decode programs over different per-device pool planes — the
+# import side's swap-in and byte accounting would silently disagree with
+# what the prefill side priced. Optional in the hello like the adapter
+# gates: absent means a pre-sharding peer (wildcard, implicitly tp=1).
+ACK_SHARD_MISMATCH = 6
 
 # the JOIN hello is a few dozen bytes of JSON; anything bigger is not ours
 _MAX_HELLO_BYTES = 4096
@@ -376,6 +383,7 @@ class HandoffExporter:
             "adapters": str(getattr(self.engine, "adapters_digest",
                                     lambda: "")()),
             "weights_epoch": int(getattr(self.engine, "weights_epoch", 0) or 0),
+            "kv_shards": int(getattr(self.engine, "kv_shards", 1) or 1),
         }
         if self.streams > 0:
             hello["version"] = PROTOCOL_VERSION
@@ -410,6 +418,13 @@ class HandoffExporter:
                     "decode worker rejected JOIN (ACK_EPOCH_MISMATCH): the "
                     "P/D sides are at different base-weight epochs (a live "
                     "hot-swap must land on both before pages move)")
+            if status == ACK_SHARD_MISMATCH:
+                raise HandoffClosed(
+                    "decode worker rejected JOIN (ACK_SHARD_MISMATCH): the "
+                    f"P/D sides shard the KV pool differently (local tp "
+                    f"degree {int(getattr(self.engine, 'kv_shards', 1) or 1)}"
+                    "; ENGINE_KV_SHARD and the mesh tp size must agree "
+                    "across the split)")
             raise HandoffClosed(
                 f"decode worker rejected JOIN (status {status}): "
                 f"kv dtype {engine_kv_dtype(self.engine)!r} does not match the "
@@ -972,6 +987,19 @@ class HandoffServer:
                             f"must land on both sides before pages move)")
                     conn.sendall(_I32.pack(ACK_EPOCH_MISMATCH))
                     return
+            if "kv_shards" in hello:
+                want_sh = int(getattr(self.engine, "kv_shards", 1) or 1)
+                got_sh = int(hello.get("kv_shards", 1) or 1)
+                if got_sh != want_sh:
+                    with self._lock:
+                        self._stats["rejected"] += 1
+                    if self.logger is not None:
+                        self.logger.warn(
+                            f"kv handoff JOIN rejected: peer pool tp degree "
+                            f"{got_sh} != local {want_sh} (ENGINE_KV_SHARD / "
+                            f"mesh tp size must agree across the P/D split)")
+                    conn.sendall(_I32.pack(ACK_SHARD_MISMATCH))
+                    return
             # chaos kv.handoff.hello, import side: drop AFTER the gates
             # but BEFORE the ACK — the dialer's JOIN wait times out
             if chaos.fire("kv.handoff.hello", side="import"):
@@ -1160,7 +1188,8 @@ class HandoffServer:
 
 __all__ = [
     "ACK_ADAPTER_MISMATCH", "ACK_DTYPE_MISMATCH", "ACK_EPOCH_MISMATCH",
-    "ACK_OK", "ACK_OK_STREAM", "ACK_REJECTED", "HandoffClosed",
+    "ACK_OK", "ACK_OK_STREAM", "ACK_REJECTED", "ACK_SHARD_MISMATCH",
+    "HandoffClosed",
     "HandoffExporter", "HandoffJob", "HandoffServer", "PROTOCOL_VERSION",
     "StreamTransfer", "chunk_parts", "decode_frame", "encode_frame",
     "engine_kv_dtype", "read_chunk",

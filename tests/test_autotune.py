@@ -265,6 +265,49 @@ def test_autotune_cache_round_trip(tmp_path):
                       {"xla": lambda: None, "pallas": lambda: None}) == "xla"
 
 
+def test_sharding_key_isolates_pins_and_stays_read_compatible(tmp_path):
+    """ISSUE 19 satellite: per-shard decode shapes change the winner, so a
+    tp-sharded engine must never adopt an unsharded pin (or vice versa) —
+    the ``|shard=`` suffix isolates them — while "" sharding keeps the
+    exact pre-feature key so existing cache files stay valid."""
+    # read-compat: no sharding -> the old key, byte for byte
+    base = autotune.entry_key("v5e", "paged_decode", "8x16", "bf16")
+    assert base == autotune.entry_key("v5e", "paged_decode", "8x16", "bf16",
+                                      sharding="")
+    assert "shard" not in base
+    sharded = autotune.entry_key("v5e", "paged_decode", "8x16", "bf16",
+                                 sharding="tp4")
+    assert sharded == base + "|shard=tp4"
+
+    # an unsharded engine's pin is STALE for a tp4 engine: same op/shape,
+    # fresh measurement under the sharded key, both pins coexist on disk
+    path = str(tmp_path / "autotune.json")
+    t1 = autotune.Autotuner(device_kind="v5e", cache_file=path,
+                            timer=_fake_timer([2e-3, 1e-3]))
+    assert t1.measure("paged_decode", "8x16", "bf16",
+                      {"xla": lambda: None, "pallas": lambda: None}) == "pallas"
+    t2 = autotune.Autotuner(device_kind="v5e", cache_file=path,
+                            sharding="tp4", timer=_fake_timer([1e-3, 2e-3]))
+    assert t2.measure("paged_decode", "8x16", "bf16",
+                      {"xla": lambda: None, "pallas": lambda: None}) == "xla"
+    assert t2.decisions["paged_decode"]["source"] == "measured"
+    assert t2.report()["sharding"] == "tp4"
+    doc = json.loads((tmp_path / "autotune.json").read_text())
+    assert doc["entries"][base]["backend"] == "pallas"
+    assert doc["entries"][sharded]["backend"] == "xla"
+
+    # and each geometry reloads its OWN pin from the shared file
+    def no_timer(fn):
+        raise AssertionError("re-timed despite a cache hit")
+
+    for sh, want in (("", "pallas"), ("tp4", "xla")):
+        t = autotune.Autotuner(device_kind="v5e", cache_file=path,
+                               sharding=sh, timer=no_timer)
+        assert t.measure("paged_decode", "8x16", "bf16",
+                         {"xla": lambda: None, "pallas": lambda: None}) == want
+        assert t.decisions["paged_decode"]["source"] == "cache"
+
+
 @pytest.mark.parametrize("content", [
     "not json at all {",
     json.dumps({"version": 999, "entries": {"k": {"backend": "pallas"}}}),

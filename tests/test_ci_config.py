@@ -484,6 +484,55 @@ def test_ci_runs_the_quality_smoke():
         assert arm in runs, f"verdict step never mentions the {arm} arm"
 
 
+def test_tp_paged_suite_is_in_quick_tier():
+    """ISSUE 19 satellite: the tensor-parallel paged-pool suite — token
+    exactness sharded-vs-single-device on all three KV dtypes, spec rounds
+    + preemption + host-tier swap-in on the sharded pool, per-device byte
+    accounting, the sharding-preserved-after-serving check, and the
+    ENGINE_KV_SHARD resolution gates — runs on the conftest-forced 8-CPU-
+    device mesh and must ride the `-m quick` CI job on every push."""
+    path = REPO / "tests" / "test_tp_paged.py"
+    assert path.exists(), "tests/test_tp_paged.py missing"
+    text = path.read_text()
+    assert "pytestmark = pytest.mark.quick" in text, (
+        "test_tp_paged.py must be quick-marked module-wide"
+    )
+    assert "test_tp_paged.py" not in QUICK_EXEMPT, (
+        "test_tp_paged.py must not be exempted from the quick tier"
+    )
+    # the tentpole's acceptance pieces: exactness on every dtype, the
+    # hard serving paths on the sharded pool, and honest accounting
+    assert "int8" in text and "int4" in text
+    assert "ENGINE_KV_SHARD" in text and "kv_shards" in text
+    assert "spec_tokens" in text and "app_tpu_preemptions" in text
+    assert "prefix_host_mb" in text and "swapin" in text
+    assert "kv_plane_bytes_per_position" in text
+    assert "pool_bytes_device" in text and "addressable_shards" in text
+    assert "assert_page_refs_consistent" in text
+
+
+def test_ci_runs_the_tp_smoke():
+    """ISSUE 19 judge: CI must run the replicated-vs-sharded pool A/B on a
+    forced 8-device host mesh and assert ALL THREE verdicts — token
+    exactness on both arms, per-device pool bytes ≈ 1/tp, and strictly
+    more pool pages at equal per-device HBM budget — otherwise the
+    capacity claim can rot between TPU rounds."""
+    ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+    job = ci["jobs"].get("bench-tp-smoke")
+    assert job, "ci.yml has no bench-tp-smoke job"
+    runs = " ".join(step.get("run", "") for step in job.get("steps", []))
+    assert "GOFR_BENCH_PLATFORM=cpu" in runs
+    assert "GOFR_BENCH_TP=1" in runs
+    assert "xla_force_host_platform_device_count=8" in runs
+    assert "bench.py" in runs
+    # the verdict step must check all three halves of the claim
+    assert "token_exact" in runs
+    assert "device_bytes_shrink_ok" in runs
+    assert "sharded_gt" in runs
+    for arm in ("replicated", "sharded"):
+        assert arm in runs, f"verdict step never mentions the {arm} arm"
+
+
 def test_ci_has_py310_compat_gate():
     """A py3.10 interpreter must compile the whole tree in CI: 3.12-only
     syntax (same-quote nested f-strings) passes every 3.12 job silently and

@@ -394,6 +394,69 @@ class TestAdapterEraJoinGates:
             pre.stop()
             dec.stop()
 
+    def test_join_rejects_mismatched_kv_shards(self, setup):
+        """ISSUE 19 satellite: page frames are SHARD-LOCAL views — a
+        tp-sharded prefill worker's pages are 1/tp-width slices a
+        replicated decode pool cannot splice. The tp-degree mismatch is
+        rejected at JOIN (ACK_SHARD_MISMATCH, before any frame moves)
+        with the sever leaking zero pages on either side."""
+        from gofr_tpu.models import ModelSpec
+        from gofr_tpu.tpu.engine import build_engine
+
+        cfg, params = setup
+        dec = make_engine(cfg, params, role="decode")  # kv_shards=1
+        # honestly-sharded dialer: tiny() heads (Hq=4, Hkv=2) split over tp:2
+        pre = build_engine(
+            ModelSpec("llama", cfg, task="generate"),
+            new_mock_container({"TPU_MESH": "tp:2", "TPU_DEVICES": "2",
+                                "ENGINE_KV_SHARD": "tp"}),
+            seed=7, slots=4, max_len=64, max_prefill_batch=2,
+            kv_layout="paged", page_size=8, total_pages=16,
+            role="prefill", handoff_target=dec.handoff_addr,
+            handoff_timeout_s=1.0)
+        try:
+            assert pre.kv_shards == 2 and dec.kv_shards == 1
+            with pytest.raises(DeadlineExceeded, match="handoff"):
+                pre.generate(PROMPT, max_new_tokens=4, timeout=300)
+            assert pre._handoff_exporter.stats()["failed"] == 1
+            assert dec._handoff_server.stats()["imported"] == 0
+            assert dec._handoff_server.stats()["rejected"] >= 1
+            assert dec._prefix.host_pages == 0
+            assert any("tp degree" in line
+                       for line in dec.container.logger.lines)
+            assert_page_refs_consistent(pre)
+            assert_page_refs_consistent(dec)
+        finally:
+            pre.stop()
+            dec.stop()
+
+    def test_pre_shard_hello_is_wildcard_on_unsharded_peer(self, setup):
+        """A pre-feature straggler whose hello omits kv_shards joins an
+        UNSHARDED decode worker (absent = wildcard, the same rolling-
+        upgrade contract the adapter/epoch gates follow)."""
+        import json as _json
+
+        cfg, params = setup
+        dec = make_engine(cfg, params, role="decode")
+        try:
+            host, port = dec.handoff_addr.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=5.0)
+            try:
+                hello = _json.dumps(
+                    {"kv_dtype": handoff.engine_kv_dtype(dec)}).encode()
+                s.sendall(handoff._MAGIC
+                          + handoff._I32.pack(len(hello)) + hello)
+                buf = b""
+                while len(buf) < 4:
+                    buf += s.recv(4 - len(buf))
+                (status,) = handoff._I32.unpack(buf)
+                assert status == handoff.ACK_OK
+            finally:
+                s.close()
+            assert dec._handoff_server.stats().get("rejected", 0) == 0
+        finally:
+            dec.stop()
+
     def test_join_rejects_mismatched_weights_epoch(self, setup):
         cfg, params = setup
         dec = make_engine(cfg, params, role="decode")

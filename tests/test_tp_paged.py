@@ -1,0 +1,275 @@
+"""Tensor-parallel paged-KV pool (ISSUE 19 tentpole): the pool's K/V and
+scale planes shard over the mesh's tp axis along the KV-head dimension,
+and every consumer is shard-aware — decode attention runs per-shard under
+shard_map with the reduce folded into the o-projection, writes/spec/
+preemption/prefix swap-in operate on shard-local views, and the byte
+accounting reports per-device numbers. The contract under test: a sharded
+engine serves token-for-token what the SAME configuration serves on a
+single device (the only valid comparison for int4, whose quantization
+legitimately shifts greedy ties vs a full-precision reference), holds
+1/tp of every plane per device, and keeps the page-refcount invariants
+through spec rounds, preemption-by-recompute, and host-tier swap-in.
+Runs on the conftest-forced 8-virtual-CPU-device mesh (jaxpin.pin_cpu)."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.container import new_mock_container
+from gofr_tpu.models import ModelSpec
+from gofr_tpu.ops.paged import kv_plane_bytes_per_position
+from gofr_tpu.testutil import (
+    assert_page_refs_consistent,
+    assert_paged_pool_consistent,
+    greedy_reference,
+    tiny_f32_llama,
+)
+from gofr_tpu.tpu.engine import build_engine
+
+pytestmark = pytest.mark.quick
+
+MESH = "dp:2,tp:4"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg, params = tiny_f32_llama()
+    return cfg, params, greedy_reference(cfg, params)
+
+
+def _build(cfg, config=None, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("max_prefill_batch", 2)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("page_size", 8)
+    container = new_mock_container(config)
+    return build_engine(ModelSpec("llama", cfg, task="generate"),
+                        container, seed=3, **kw)
+
+
+def _sharded(cfg, **kw):
+    return _build(cfg, {"TPU_MESH": MESH, "ENGINE_KV_SHARD": "tp"}, **kw)
+
+
+def _prompts(n=4):
+    return [[1 + (13 * i + j) % 200 for j in range(4 + i % 3)]
+            for i in range(n)]
+
+
+def _counter_sum(eng, name):
+    m = eng.metrics.get(name)
+    return sum(m._values.values()) if m is not None else 0
+
+
+# -- token exactness vs single device, all three pool dtypes -------------------
+
+
+@pytest.mark.parametrize("kvq", ["", "int8", "int4"])
+def test_sharded_serving_token_exact_vs_single_device(setup, kvq):
+    """The tentpole acceptance: for each KV dtype, the tp-sharded pool
+    serves exactly the tokens the same engine produces on one device —
+    the per-shard decode + o-projection psum changes nothing observable.
+    The dense pool must additionally match the incremental f32 greedy
+    reference (quantized pools compare same-dtype only)."""
+    cfg, params, ref = setup
+    prompts = _prompts()
+    kw = {"kv_quantize": kvq} if kvq else {}
+    ref_eng = _build(cfg, **kw)
+    try:
+        assert ref_eng.kv_shards == 1
+        want = [ref_eng.generate(p, max_new_tokens=8, timeout=300)["tokens"]
+                for p in prompts]
+    finally:
+        ref_eng.stop()
+    if not kvq:
+        assert want == [ref(p, 8) for p in prompts], (
+            "single-device dense engine diverged from the greedy reference")
+    eng = _sharded(cfg, **kw)
+    try:
+        assert eng.kv_shards == 4
+        for i, p in enumerate(prompts):
+            got = eng.generate(p, max_new_tokens=8, timeout=300)["tokens"]
+            assert got == want[i], (
+                f"request {i} diverged on the sharded {kvq or 'bf16'} pool: "
+                f"{got} != {want[i]}")
+        assert_page_refs_consistent(eng)
+    finally:
+        eng.stop()
+
+
+def test_pool_planes_sharded_over_tp_and_stay_sharded(setup):
+    """Every pool plane commits with the tp axis on the KV-head dim
+    (axis 2) and each device holds exactly Hkv/tp heads — and serving
+    must not silently reshard: donated step outputs keep the commitment,
+    else the capacity win evaporates after the first decode."""
+    cfg, params, _ = setup
+
+    def check(eng):
+        for leaf in jax.tree.leaves(eng.kv_cache):
+            spec = tuple(leaf.sharding.spec)
+            assert len(spec) > 2 and spec[2] == "tp", spec
+            for sh in leaf.addressable_shards:
+                assert sh.data.shape[2] == leaf.shape[2] // 4, (
+                    leaf.shape, sh.data.shape)
+
+    eng = _sharded(cfg)
+    try:
+        check(eng)
+        eng.generate(_prompts(1)[0], max_new_tokens=4, timeout=300)
+        check(eng)
+    finally:
+        eng.stop()
+
+
+# -- spec rounds + preemption + prefix swap-in on the sharded pool -------------
+
+
+def test_spec_and_preemption_on_sharded_pool(setup):
+    """Speculative rounds and preemption-by-recompute on the sharded pool:
+    spec writes and the requeued prompt's re-prefill both go through the
+    shard-local write path, and under a minimum-legal pool contention must
+    stay token-exact vs the greedy reference while the refcounts survive."""
+    cfg, params, ref = setup
+    rngs = np.random.RandomState(11)
+    prompts = []
+    for i in range(8):  # every 3rd arrival long enough to contend the pool
+        n = 15 + (i % 2) * 4 if i % 3 == 2 else 2 + i % 4
+        prompts.append([int(x) for x in rngs.randint(1, 200, size=n)])
+    want = [ref(p, 12) for p in prompts]
+    eng = _sharded(cfg, slots=3, total_pages=10, spec_tokens=2, decode_chunk=4)
+    try:
+        assert eng.kv_shards == 4 and eng.spec_tokens == 2
+        reqs = []
+        for p in prompts:  # paced arrivals, not one up-front burst
+            time.sleep(0.01)
+            reqs.append(eng.submit(p, max_new_tokens=12, timeout=300))
+        results = [r.result(300) for r in reqs]
+        assert _counter_sum(eng, "app_tpu_preemptions") >= 1, (
+            "pool was not small enough to exercise preemption")
+        for i, r in enumerate(results):
+            assert r["tokens"] == want[i], (
+                f"request {i} diverged under spec+preemption: "
+                f"{r['tokens']} != {want[i]}")
+        assert_page_refs_consistent(eng)
+    finally:
+        eng.stop()
+
+
+def test_prefix_spill_swapin_on_sharded_pool(setup):
+    """Host-tier spill and swap-in on the sharded pool: the spilled host
+    copy and the device_put promoting it back must round-trip the
+    SHARD-LOCAL views without ever materializing a replicated plane — a
+    warm hit after forced spill replays token-exactly."""
+    cfg, params, ref = setup
+    prompt = [(11 * i) % 190 + 1 for i in range(20)]  # 2 full pages @ 8
+    want = ref(prompt, 6)
+    eng = _sharded(cfg, total_pages=12, prefix_host_mb=8.0)
+    try:
+        cold = eng.generate(prompt, max_new_tokens=6, timeout=300)
+        assert cold["tokens"] == want, "cold sharded run diverged"
+        for r in range(5):  # distinct prompts until pressure spills
+            eng.generate([(r * 37 + 13 * i) % 180 + 2 for i in range(18)],
+                         max_new_tokens=4, timeout=300)
+        assert eng._prefix.host_pages > 0, "pool pressure never spilled"
+        warm = eng.generate(prompt, max_new_tokens=6, timeout=300)
+        assert warm["tokens"] == want, "host-tier swap-in changed tokens"
+        assert _counter_sum(eng, "app_tpu_prefix_swapin_pages_total") >= 1
+        assert_page_refs_consistent(eng)
+        assert_paged_pool_consistent(eng, slots_empty=True)
+    finally:
+        eng.stop()
+
+
+# -- per-device byte accounting ------------------------------------------------
+
+
+def test_kv_plane_bytes_shard_divisor():
+    """The analytic estimator's per-device mode: shards divides the head
+    count exactly (never pads) and composes with every dtype contract."""
+    for dt in ("bf16", "int8", "int4"):
+        full = kv_plane_bytes_per_position(2, 4, 8, dt, dense_bytes=4)
+        per = kv_plane_bytes_per_position(2, 4, 8, dt, dense_bytes=4, shards=4)
+        assert per * 4 == full, (dt, per, full)
+    with pytest.raises(ValueError, match="not divisible"):
+        kv_plane_bytes_per_position(2, 4, 8, shards=3)
+
+
+def test_page_pool_stats_report_shard_local_bytes(setup):
+    """/debug/perf and the pool gauges ride page_pool_stats: byte fields
+    must be SHARD-LOCAL (per-device) so a fleet rollup that sums parts
+    sees parts — and they must equal what is actually resident per
+    device, not a logical footprint divided on faith."""
+    cfg, params, _ = setup
+    eng = _sharded(cfg)
+    try:
+        stats = eng.page_pool_stats()
+        assert stats["kv_shards"] == 4
+        logical = sum(leaf.nbytes for leaf in jax.tree.leaves(eng.kv_cache))
+        assert stats["pool_bytes_device"] == logical // 4
+        assert stats["page_bytes_device"] == eng._page_bytes // 4
+        dev0 = jax.devices()[0]
+        resident = sum(
+            sh.data.nbytes for leaf in jax.tree.leaves(eng.kv_cache)
+            for sh in leaf.addressable_shards if sh.device == dev0)
+        assert resident == stats["pool_bytes_device"], (
+            "per-device gauge diverges from resident bytes")
+        assert eng.replay_config()["engine"]["kv_shards"] == 4
+        # and the DECLARED gauge actually reaches Prometheus exposition
+        # (an undeclared name is silently dropped by the registry)
+        cont = eng.container
+        cont.register_engine("gen", eng)
+        cont._sample_perf_metrics()
+        line = next(
+            ln for ln in cont.metrics.expose_text().splitlines()
+            if ln.startswith("app_tpu_kv_pool_device_bytes{"))
+        assert 'kv_shards="4"' in line and str(resident) in line, line
+    finally:
+        eng.stop()
+
+
+def test_unsharded_stats_are_unchanged(setup):
+    """ENGINE_KV_SHARD=off: kv_shards=1 and the per-device byte fields
+    equal the logical footprint — today's accounting bit-for-bit."""
+    cfg, params, _ = setup
+    eng = _build(cfg, {"TPU_MESH": MESH, "ENGINE_KV_SHARD": "off"})
+    try:
+        assert eng.kv_shards == 1
+        stats = eng.page_pool_stats()
+        assert stats["kv_shards"] == 1
+        assert stats["page_bytes_device"] == eng._page_bytes
+        assert stats["pool_bytes_device"] == sum(
+            leaf.nbytes for leaf in jax.tree.leaves(eng.kv_cache))
+    finally:
+        eng.stop()
+
+
+# -- resolution gates ----------------------------------------------------------
+
+
+def test_kv_shard_mode_gating(setup):
+    """'auto' stands down silently when the geometry can't split; an
+    explicit 'tp' request must raise instead of silently serving a
+    replicated pool; unknown modes are config errors."""
+    cfg, params, _ = setup
+    # no tp axis at all: auto -> unsharded, explicit -> error
+    eng = _build(cfg, {"TPU_MESH": "dp:2", "TPU_DEVICES": "2"})
+    try:
+        assert eng.kv_shards == 1
+    finally:
+        eng.stop()
+    with pytest.raises(ValueError, match="ENGINE_KV_SHARD=tp impossible"):
+        _build(cfg, {"TPU_MESH": "dp:2", "TPU_DEVICES": "2",
+                     "ENGINE_KV_SHARD": "tp"})
+    # tp=8 does not divide num_kv_heads=4: same split
+    eng = _build(cfg, {"TPU_MESH": "tp:8"})
+    try:
+        assert eng.kv_shards == 1
+    finally:
+        eng.stop()
+    with pytest.raises(ValueError, match="do not divide"):
+        _build(cfg, {"TPU_MESH": "tp:8", "ENGINE_KV_SHARD": "tp"})
+    with pytest.raises(ValueError, match="use 'auto', 'tp' or 'off'"):
+        _build(cfg, {"TPU_MESH": MESH, "ENGINE_KV_SHARD": "sideways"})
