@@ -126,6 +126,27 @@ Env knobs:
                               workload — archives chip-seconds/request at
                               equal attainment and per-arm token-exactness
     GOFR_BENCH_ADAPTERS_N     adapter count for the A/B (default 3)
+    GOFR_BENCH_CONTROLLER     1 = also run the online step-controller A/B
+                              (gofr_tpu.control): a three-phase shifting
+                              workload (burst → paced → trickle) replayed
+                              against EVERY static knob setting inside the
+                              boot envelope (pipeline depth × prefill
+                              batch) and against a controller-driven engine
+                              deliberately started at the pessimal setting
+                              so it must climb; per-arm attainment, bubble
+                              ratio and score (attainment × (1 − bubble))
+                              land in extra.controller, with the decision
+                              count, the final knob vector, token-exactness
+                              across all arms (knob moves are token-
+                              neutral by contract) and the meets_statics
+                              verdict
+    GOFR_BENCH_CONTROLLER_TOL relative score slack for meets_statics
+                              (default 0.25 — the CPU smoke's noise floor)
+    GOFR_BENCH_CONTROLLER_INTERVAL_S  controller tick seconds for the
+                              smoke (default 0.3)
+    GOFR_BENCH_CONTROLLER_SPAN_S  wall-clock span the paced + trickle
+                              phases stretch over (default 8 — room for
+                              ~span/interval controller evidence windows)
     GOFR_BENCH_ALLOW_CPU      1 = a TPU-probe CPU fallback stays a valid
                               (labelled) CPU run instead of failing loud
     GOFR_BENCH_PIPELINE       device pipeline depth (default 2; 1 = sync, up to 4)
@@ -1754,6 +1775,147 @@ def main() -> None:
             spec_ab["speedup"] = round(
                 spec_ab["on"]["req_per_s"] / max(spec_ab["off"]["req_per_s"], 1e-9), 3)
         extra["spec_ab"] = spec_ab
+
+    # Online step-controller A/B (gofr_tpu.control): does closing the perf
+    # plane into actuation actually pay? One shifting workload — a burst
+    # phase (high occupancy, prefill pressure), a paced phase, then a
+    # trickle (near-empty pipeline) — is replayed IDENTICALLY against every
+    # static (pipeline_depth, prefill_batch) setting inside the boot
+    # envelope and against a controlled engine that boots at the envelope
+    # ceiling but is immediately parked at the PESSIMAL corner via
+    # request_knobs, so any decent score REQUIRES the controller to climb
+    # (and guarantees the decision ring is non-empty). All arms run greedy,
+    # so token-exactness across every arm is the live proof that knob moves
+    # never touch the token stream; the static ceiling arm doubles as the
+    # CONTROL_ENABLE=0 off-path check (no controller object constructed).
+    if os.environ.get("GOFR_BENCH_CONTROLLER") == "1":
+        from gofr_tpu.container import new_mock_container as _ctl_container
+        from gofr_tpu.control.controller import StepController as _StepCtl
+        from gofr_tpu.tpu.engine import GenerateEngine
+
+        c_interval = float(os.environ.get(
+            "GOFR_BENCH_CONTROLLER_INTERVAL_S", "0.3"))
+        c_tol = float(os.environ.get("GOFR_BENCH_CONTROLLER_TOL", "0.25"))
+        # the trace must SPAN wall time, not just offer work: the
+        # controller ticks on real seconds, so the paced + trickle phases
+        # are stretched over c_span to leave room for ~c_span/interval
+        # evidence windows (a burst-only trace finishes in milliseconds on
+        # the tiny CPU model and the controller never gets to act)
+        c_span = float(os.environ.get("GOFR_BENCH_CONTROLLER_SPAN_S", "8"))
+        c_depth_max, c_batch_max = 2, 2
+        # the trace is built once; every arm replays the same arrival
+        # times, prompts and output lengths
+        c_n = max(12, n_requests)
+        c_tail = max(4, c_n // 2)
+        c_trace = []
+        t_cursor = 0.0
+        for count, gap in ((c_n, 0.0),
+                           (c_n, 0.5 * c_span / c_n),
+                           (c_tail, 0.5 * c_span / c_tail)):
+            for _ in range(count):
+                c_trace.append((
+                    t_cursor,
+                    rng.randint(1, cfg.vocab_size, size=prompt_len).tolist()))
+                t_cursor += gap
+            t_cursor += 2 * c_interval  # phase boundary breather
+
+        def _run_ctl_arm(depth_a: int, batch_a: int, controlled: bool) -> tuple:
+            cont = _ctl_container({
+                # smoke-speed control plane: sub-second ticks, a low
+                # evidence floor, and short cooldown/backoff so the
+                # compressed trace leaves room for several trials
+                "CONTROL_INTERVAL_S": str(c_interval),
+                "CONTROL_SUSTAIN_S": str(c_interval),
+                "CONTROL_COOLDOWN_S": str(c_interval),
+                "CONTROL_BACKOFF_S": str(4 * c_interval),
+                "CONTROL_MIN_STEPS": "4",
+                "CONTROL_EPSILON": "0.02",
+                "CONTROL_KNOBS": "pipeline_depth,prefill_batch",
+            })
+            ckw = dict(engine_kw(*best))
+            ckw.update(decode_pipeline=depth_a, max_prefill_batch=batch_a,
+                       control_enable=controlled)
+            eng = GenerateEngine(llama, cfg, params, cont, **ckw)
+            try:
+                eng.warmup()
+                eng.start()
+                eng.generate(c_trace[0][1], max_new_tokens=2, timeout=timeout)
+                if controlled:
+                    # pessimal start inside the envelope: the controller
+                    # has to earn its way back to the good corner
+                    eng.request_knobs(pipeline_depth=1, prefill_batch=1)
+                t0c = time.monotonic()
+                reqs = []
+                for t_at, p in c_trace:
+                    delay = t0c + t_at - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                    reqs.append(eng.submit(p, max_new_tokens=max_new,
+                                           timeout=timeout))
+                toks = [r.result(timeout)["tokens"] for r in reqs]
+                elapsed_c = time.monotonic() - t0c
+                bands = (eng.perf.band_totals(time.monotonic())
+                         if eng.perf is not None else {})
+                ev = _StepCtl._summarize(bands)
+                rep = eng.control_report()
+            finally:
+                eng.stop()
+            arm = {
+                "req_per_s": round(len(toks) / elapsed_c, 3),
+                "attainment": round(ev["attainment"], 6),
+                "bubble_ratio": round(ev["bubble_ratio"], 6),
+                "score": round(ev["score"], 6),
+            }
+            if controlled:
+                verdicts: dict[str, int] = {}
+                for dec in rep.get("decisions", []):
+                    verdicts[dec["verdict"]] = verdicts.get(
+                        dec["verdict"], 0) + 1
+                arm.update(enabled=rep.get("enabled", False),
+                           decisions=verdicts,
+                           final_knobs=rep.get(
+                               "knobs") and {k: v["value"]
+                                             for k, v in rep["knobs"].items()},
+                           oscillating=rep.get("oscillating"))
+            else:
+                # CONTROL_ENABLE=0 structural check: no controller object
+                arm["enabled"] = rep.get("enabled", False)
+            return arm, toks
+
+        ctl: dict = {"trace": {
+            "requests": len(c_trace), "phases": 3,
+            "span_s": round(t_cursor, 2),
+            "envelope": {"pipeline_depth": c_depth_max,
+                         "prefill_batch": c_batch_max},
+        }}
+        try:
+            tok_sets: dict[str, list] = {}
+            statics: dict[str, dict] = {}
+            for d_a in range(1, c_depth_max + 1):
+                for b_a in range(1, c_batch_max + 1):
+                    name = f"d{d_a}b{b_a}"
+                    statics[name], tok_sets[name] = _run_ctl_arm(
+                        d_a, b_a, False)
+            ctl["static"] = statics
+            ctl["controller"], tok_sets["controller"] = _run_ctl_arm(
+                c_depth_max, c_batch_max, True)
+            best_name = max(statics, key=lambda n: statics[n]["score"])
+            best_score = statics[best_name]["score"]
+            ctl["best_static"] = best_name
+            ctl["tolerance"] = c_tol
+            ctl["meets_statics"] = bool(
+                ctl["controller"]["score"] >= best_score * (1.0 - c_tol))
+            ref = tok_sets[f"d{c_depth_max}b{c_batch_max}"]
+            ctl["token_exact"] = bool(
+                all(t == ref for t in tok_sets.values()))
+            # the ceiling static arm IS the CONTROL_ENABLE=0 engine at the
+            # controller arm's boot config: identical tokens is the
+            # off-path bit-identity evidence
+            ctl["control_off_token_exact"] = bool(
+                tok_sets["controller"] == ref)
+            extra["controller"] = ctl
+        except Exception as e:  # noqa: BLE001
+            extra["controller"] = f"error: {e}"[:160]
 
     # KV-dtype three-way A/B (ISSUE 13): bf16 vs int8 vs int4 paged pools
     # under the same workload, archiving the decode-bandwidth story — pool

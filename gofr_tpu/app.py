@@ -301,6 +301,7 @@ class App:
             http_app.router.add_get("/debug/engine", self._debug_engine_handler)
             http_app.router.add_get("/debug/perf", self._debug_perf_handler)
             http_app.router.add_get("/debug/quality", self._debug_quality_handler)
+            http_app.router.add_get("/debug/control", self._debug_control_handler)
 
         for method, path, handler in self._routes:
             http_app.router.add_route(method, path, self._wrap(handler))
@@ -818,6 +819,26 @@ class App:
 
             fleet = {"totals": totals, **perf_mod.derive(totals)}
         return web.json_response({"data": {"engines": engines, "rollup": fleet}})
+
+    async def _debug_control_handler(self, request: web.Request) -> web.Response:
+        """GET /debug/control → the online step controller's live state
+        (gofr_tpu.control; docs/serving.md): per engine the knob vector
+        with each knob's allowed range and frozen flag, the persisted pins
+        for this replica's (kv dtype, device kind, shard) context, the
+        hysteresis gate internals, the in-progress trial, the last judged
+        evidence window, and the bounded decision ring — "who changed what
+        knob, when, and on what evidence" answered with nothing but curl.
+        Engines without a controller report {enabled: false} plus their
+        static knob vector so the fleet view stays uniform."""
+        engines = {}
+        for name, engine in self.container.engines.items():
+            report = getattr(engine, "control_report", None)
+            if callable(report):
+                engines[name] = report()
+        decisions = self.container.flight.controls(
+            limit=self._debug_limit(request))
+        return web.json_response(
+            {"data": {"engines": engines, "decisions": decisions}})
 
     async def _debug_quality_handler(self, request: web.Request) -> web.Response:
         """GET /debug/quality → the numerics/quality plane joined with the

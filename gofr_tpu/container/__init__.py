@@ -307,6 +307,18 @@ class Container:
         m.new_gauge("app_tpu_spec_accept_ratio",
                     "lifetime speculative-decode acceptance ratio (adapter) "
                     "— the cheapest always-on quality proxy")
+        # online step controller (gofr_tpu.control; docs/serving.md): the
+        # perf plane closed into actuation — decisions counted by verdict,
+        # the live knob vector exported per knob so dashboards can overlay
+        # knob moves on the MFU/bubble timelines they were judged by
+        m.new_counter("app_tpu_control_decisions_total",
+                      "step-controller decisions (verdict: try|commit|"
+                      "revert|resume|standdown)")
+        m.new_gauge("app_tpu_control_knob",
+                    "live value of one engine tuning knob (engine, knob)")
+        m.new_gauge("app_tpu_control_active",
+                    "1 when the engine's step controller is constructed and "
+                    "not stood down (engine)")
 
     def _sample_tpu_metrics(self, _registry=None) -> None:
         """Collect hook: live HBM gauges on every /metrics scrape (the
@@ -340,6 +352,21 @@ class Container:
             if prop > 0:
                 self.metrics.set_gauge("app_tpu_spec_accept_ratio",
                                        acc / prop, adapter=adapter)
+        # online-controller surface: knob vectors are engine attributes, so
+        # sampling them at scrape time (like the pool gauges) keeps the
+        # device loop free of metrics writes on the knob-apply path
+        for name, e in self._engines.items():
+            kv_fn = getattr(e, "knob_vector", None)
+            if not callable(kv_fn):
+                continue
+            for knob, value in kv_fn().items():
+                self.metrics.set_gauge("app_tpu_control_knob", value,
+                                       engine=name, knob=knob)
+            ctl = getattr(e, "_control", None)
+            self.metrics.set_gauge(
+                "app_tpu_control_active",
+                1 if (ctl is not None and ctl.standdown is None) else 0,
+                engine=name)
         self._sample_perf_metrics()
 
     def perf_totals(self) -> dict | None:
@@ -357,6 +384,23 @@ class Container:
 
         now = time.monotonic()
         return perf_mod.merge_totals(p.window_totals(now) for p in planes)
+
+    def knob_vectors(self) -> dict | None:
+        """Per-engine live tuning-knob vectors (engine.knob_vector), with a
+        ``_controlled`` marker where an online controller is actually
+        driving them — rides the gossip digest so /debug/fleet shows who
+        runs which tuning. None when no engine exposes knobs."""
+        out: dict = {}
+        for name, e in self._engines.items():
+            kv_fn = getattr(e, "knob_vector", None)
+            if not callable(kv_fn):
+                continue
+            vec = kv_fn()
+            ctl = getattr(e, "_control", None)
+            if ctl is not None and ctl.standdown is None:
+                vec["_controlled"] = 1
+            out[name] = vec
+        return out or None
 
     def _sample_perf_metrics(self) -> None:
         """Roofline gauges from the merged engine windows: numerators and

@@ -147,48 +147,45 @@ class ScaleDecider:
     explicit ``now`` so the quick-tier units drive it with fake clocks.
     Returns one of ``"out" | "in" | "hold" | "freeze"``; the executor
     reports actions back via :meth:`note_action` so cooldowns anchor on
-    what actually happened, not on what was decided."""
+    what actually happened, not on what was decided.
+
+    The hysteresis/sustain/cooldown/stale core lives in
+    :class:`gofr_tpu.control.hysteresis.HysteresisGate` (extracted from
+    here so the step-level knob controller damps flapping with the same
+    semantics); this class keeps only what is fleet-specific — the
+    hot/calm signal classification and the replica clamp."""
 
     def __init__(self, policy: AutoscalePolicy):
+        from gofr_tpu.control.hysteresis import HysteresisGate
+
         self.policy = policy
-        self._pressure_since: float | None = None
-        self._calm_since: float | None = None
-        self._last_action_at = float("-inf")
+        self._gate = HysteresisGate(
+            sustain_s=policy.sustain_s, idle_s=policy.idle_s,
+            cooldown_hot_s=policy.cooldown_out_s,
+            cooldown_calm_s=policy.cooldown_in_s,
+            stale_s=policy.stale_s)
+
+    @property
+    def _last_action_at(self) -> float:
+        # pre-extraction attribute, still read by drills/operators
+        return self._gate.last_action_at
 
     def note_action(self, now: float) -> None:
-        self._last_action_at = now
-        self._pressure_since = None
-        self._calm_since = None
+        self._gate.note_action(now)
 
     def decide(self, sig: FleetSignals, now: float) -> str:
         p = self.policy
-        if sig.age_s > p.stale_s:
-            # gossip silence / dead signal source: freeze — and forget the
-            # streaks, so decisions restart from scratch on fresh data
-            self._pressure_since = None
-            self._calm_since = None
-            return "freeze"
         hot = ((sig.burn is not None and sig.burn >= p.burn_out)
                or sig.predicted_wait_s >= p.wait_out_s)
         calm = ((sig.burn is None or sig.burn <= p.burn_in)
                 and sig.predicted_wait_s <= p.wait_in_s)
-        if hot:
-            self._calm_since = None
-            if self._pressure_since is None:
-                self._pressure_since = now
-        elif calm:
-            self._pressure_since = None
-            if self._calm_since is None:
-                self._calm_since = now
-        else:
-            # inside the hysteresis band: neither streak accumulates
-            self._pressure_since = None
-            self._calm_since = None
-        if (hot and now - self._pressure_since >= p.sustain_s
-                and now - self._last_action_at >= p.cooldown_out_s):
+        verdict = self._gate.decide(hot=hot, calm=calm, now=now,
+                                    age_s=sig.age_s)
+        if verdict == "freeze":
+            return "freeze"
+        if verdict == "hot":
             return "out" if sig.replicas < p.max_replicas else "hold"
-        if (calm and now - self._calm_since >= p.idle_s
-                and now - self._last_action_at >= p.cooldown_in_s):
+        if verdict == "calm":
             return "in" if sig.replicas > p.min_replicas else "hold"
         return "hold"
 

@@ -90,6 +90,7 @@ def _ls_from_json(pairs: Iterable[Iterable[str]]) -> LabelSet:
 
 def digest(registry: Registry, *, slo=None, inflight: int | None = None,
            perf: Mapping[str, Any] | None = None,
+           knobs: Mapping[str, Any] | None = None,
            counters: Iterable[str] = DIGEST_COUNTERS,
            histograms: Iterable[str] = DIGEST_HISTOGRAMS,
            gauges: Iterable[str] = DIGEST_GAUGES) -> dict[str, Any]:
@@ -127,6 +128,12 @@ def digest(registry: Registry, *, slo=None, inflight: int | None = None,
         # payload): exact numerator/denominator sums, so the router can
         # merge replicas the same way it merges SLO counts
         out["perf"] = dict(perf)
+    if knobs is not None:
+        # per-engine live tuning-knob vectors (engine.knob_vector, with the
+        # online controller's _controlled marker): /debug/fleet shows WHO
+        # runs which tuning, so a replica whose controller drifted from the
+        # fleet's pins is visible from the router
+        out["knobs"] = dict(knobs)
     return out
 
 

@@ -7,6 +7,13 @@ every device step (kind, wall time, occupancy, signature) into two bounded
 deques, so ``GET /debug/requests`` / ``GET /debug/engine`` can answer
 "what just happened" on a production box with nothing but curl.
 
+The online controller (gofr_tpu.control) adds a third ring: every
+try/commit/revert/resume/standdown decision lands in ``record_control``
+(served by ``GET /debug/control``), and step entries carry the active
+knob vector — so an anomaly bundle shows not just what the step did but
+which tuning it ran under, and a decision can be lined up against the
+steps it judged.
+
 Cost discipline: one uncontended lock acquisition + a dict append per
 completed request / device step — never per token. The lock exists only
 because ``list(deque)`` raises if another thread appends mid-iteration;
@@ -22,11 +29,14 @@ from typing import Any
 
 
 class FlightRecorder:
-    def __init__(self, max_requests: int = 256, max_steps: int = 512):
+    def __init__(self, max_requests: int = 256, max_steps: int = 512,
+                 max_controls: int = 128):
         self._requests: collections.deque[dict[str, Any]] = collections.deque(
             maxlen=max(1, int(max_requests)))
         self._steps: collections.deque[dict[str, Any]] = collections.deque(
             maxlen=max(1, int(max_steps)))
+        self._controls: collections.deque[dict[str, Any]] = collections.deque(
+            maxlen=max(1, int(max_controls)))
         self._lock = threading.Lock()
 
     # -- recording (engine side) -----------------------------------------------
@@ -39,7 +49,8 @@ class FlightRecorder:
                     signature: Any, backlog: int = 0, inflight: int = 0,
                     device_s: float | None = None, bytes_: float | None = None,
                     flops: float | None = None,
-                    bubble_s: float | None = None) -> None:
+                    bubble_s: float | None = None,
+                    knobs: dict[str, Any] | None = None) -> None:
         # With the unified async pipeline, steps are recorded at COMPLETION
         # (dequeue) time; `seconds` spans dispatch→fold and `inflight` is
         # the in-flight queue depth left after this entry was dequeued —
@@ -62,8 +73,15 @@ class FlightRecorder:
             entry["bytes"] = float(bytes_ or 0.0)
             entry["flops"] = float(flops or 0.0)
             entry["bubble"] = round(float(bubble_s or 0.0), 6)
+        if knobs:
+            entry["knobs"] = dict(knobs)
         with self._lock:
             self._steps.append(entry)
+
+    def record_control(self, decision: dict[str, Any]) -> None:
+        """One controller decision (already to_dict()-flattened)."""
+        with self._lock:
+            self._controls.append(decision)
 
     # -- inspection (debug endpoints / tests) ----------------------------------
 
@@ -78,5 +96,12 @@ class FlightRecorder:
         """Device steps, newest first."""
         with self._lock:
             out = list(self._steps)
+        out.reverse()
+        return out[:limit] if limit else out
+
+    def controls(self, limit: int | None = None) -> list[dict[str, Any]]:
+        """Controller decisions, newest first."""
+        with self._lock:
+            out = list(self._controls)
         out.reverse()
         return out[:limit] if limit else out

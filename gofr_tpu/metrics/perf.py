@@ -110,6 +110,25 @@ def device_peaks(device_kind: str) -> tuple[float, float] | None:
     return flops, bw
 
 
+# -- occupancy bands (step controller evidence keys) -------------------------
+
+# Slot-occupancy bands the control plane buckets evidence by: a knob that
+# wins at a packed batch ("hi") can lose at a near-empty one ("lo"), so
+# pins are per band. Boundaries are coarse on purpose — finer bands would
+# starve each bucket of evidence at the controller's tick cadence.
+OCCUPANCY_BANDS: tuple[tuple[str, float], ...] = (
+    ("lo", 0.35), ("mid", 0.70), ("hi", float("inf")))
+
+
+def occupancy_band(occupancy: float | None) -> str:
+    """Map a step's batch occupancy (0..1) to its evidence band."""
+    occ = 0.0 if occupancy is None else float(occupancy)
+    for name, hi in OCCUPANCY_BANDS:
+        if occ < hi:
+            return name
+    return OCCUPANCY_BANDS[-1][0]
+
+
 # -- shared bench/engine estimator ------------------------------------------
 
 
@@ -286,9 +305,15 @@ class _SumRing:
         for k, v in vals.items():
             bucket[k] = bucket.get(k, 0.0) + v
 
-    def sums(self, now: float) -> dict[str, float]:
+    def sums(self, now: float, since: float | None = None) -> dict[str, float]:
+        """Window sums; ``since`` (absolute seconds, same clock as ``add``)
+        additionally drops buckets that started at or before it — the
+        step controller reads per-tick deltas this way instead of the
+        full rolling window, at bucket granularity."""
         idx = int(now / self._width)
         lo = idx - self._buckets + 1
+        if since is not None:
+            lo = max(lo, int(since / self._width) + 1)
         out: dict[str, float] = {}
         for slot in range(self._buckets):
             if self._epoch[slot] < lo:
@@ -350,9 +375,12 @@ class PerfPlane:
 
     # -- fold side ----------------------------------------------------------
 
-    def note(self, p: StepPerf, now: float) -> StepPerf:
+    def note(self, p: StepPerf, now: float, band: str | None = None) -> StepPerf:
         """Account one folded step (engine `_record_step` calls this with
-        ``t_ready`` stamped). Returns ``p`` with residency filled."""
+        ``t_ready`` stamped). Returns ``p`` with residency filled.
+        ``band`` (an :func:`occupancy_band` label) additionally files the
+        step under its band-labeled window — the step controller's
+        evidence keys — without touching the kind-level accounting."""
         t_r = p.t_ready if p.t_ready is not None else now
         with self._lock:
             floor = self._gap_floor
@@ -362,14 +390,22 @@ class PerfPlane:
             p.device_s = max(t_r - max(p.t_dispatch, floor), 1e-9)
             p.fold_s = max(0.0, now - t_r)
             self._gap_floor = max(floor, t_r)
-            self._ring.add(
-                now,
-                **{f"{p.kind}.flops": p.flops,
-                   f"{p.kind}.bytes": p.bytes,
-                   f"{p.kind}.device_s": p.device_s,
-                   f"{p.kind}.steps": 1.0,
-                   "bubble_s": p.bubble_s,
-                   "busy_s": p.device_s})
+            vals = {f"{p.kind}.flops": p.flops,
+                    f"{p.kind}.bytes": p.bytes,
+                    f"{p.kind}.device_s": p.device_s,
+                    f"{p.kind}.steps": 1.0,
+                    "bubble_s": p.bubble_s,
+                    "busy_s": p.device_s}
+            if band is not None:
+                # "bd." prefix keeps band rows out of the kind rollups
+                # (window_totals filters them the way it filters "ad.")
+                bk = f"bd.{p.kind}|{band}"
+                vals[f"{bk}.flops"] = p.flops
+                vals[f"{bk}.bytes"] = p.bytes
+                vals[f"{bk}.device_s"] = p.device_s
+                vals[f"{bk}.steps"] = 1.0
+                vals[f"{bk}.bubble_s"] = p.bubble_s
+            self._ring.add(now, **vals)
         return p
 
     def note_adapters(self, ids: Iterable[str | None], p: StepPerf,
@@ -441,6 +477,10 @@ class PerfPlane:
             if key in ("bubble_s", "busy_s"):
                 continue
             kind, field = key.rsplit(".", 1)
+            if kind.startswith("bd."):
+                # band-labeled evidence rows (note(band=)) — read through
+                # band_totals by the step controller, never merged here
+                continue
             if kind.startswith("ad."):
                 # per-adapter attribution rows (note_adapters) — their own
                 # section, never mixed into the step kinds
@@ -460,6 +500,35 @@ class PerfPlane:
             "bubble": {"bubble_s": sums.get("bubble_s", 0.0),
                        "busy_s": sums.get("busy_s", 0.0)},
         }
+
+    def band_totals(self, now: float,
+                    since: float | None = None) -> dict[str, dict[str, float]]:
+        """The step controller's evidence view: per
+        ``kind|kv_dtype|band`` sums of FLOPs/bytes/device-seconds/steps
+        plus the per-step bubble in front, with capacity denominators
+        filled where peaks are known. ``since`` restricts the window to
+        buckets after that instant (same clock as ``note``) so ticks read
+        deltas, not the rolling window — evidence from before a knob
+        move never judges the move."""
+        peaks = device_peaks(self.device_kind)
+        with self._lock:
+            sums = self._ring.sums(now, since)
+        out: dict[str, dict[str, float]] = {}
+        for key, val in sums.items():
+            if not key.startswith("bd."):
+                continue
+            row, field = key[3:].rsplit(".", 1)
+            kind, band = row.split("|", 1)
+            rec = out.setdefault(
+                f"{kind}|{self.model.kv_dtype}|{band}",
+                {"flops": 0.0, "bytes": 0.0, "device_s": 0.0, "steps": 0.0,
+                 "bubble_s": 0.0, "flops_cap": 0.0, "bytes_cap": 0.0})
+            rec[field] = val
+        for rec in out.values():
+            if peaks is not None:
+                rec["flops_cap"] = rec["device_s"] * peaks[0]
+                rec["bytes_cap"] = rec["device_s"] * peaks[1]
+        return out
 
     def snapshot(self, now: float) -> dict[str, Any]:
         """JSON-safe operator view: model constants, resolved peaks, and

@@ -757,6 +757,11 @@ class Router:
                     from gofr_tpu.metrics import perf as perf_mod
 
                     d["perf"] = perf_mod.derive(r.digest["perf"])
+                if r.digest.get("knobs"):
+                    # who runs which tuning (the online controller's knob
+                    # vector per engine): a replica drifting from the
+                    # fleet's pins shows up right next to its attainment
+                    d["knobs"] = r.digest["knobs"]
             counts = per_replica.get(name)
             if counts:
                 sent = counts["home"] + counts["spill"]

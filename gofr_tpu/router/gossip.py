@@ -119,10 +119,12 @@ class GossipReporter:
                 from gofr_tpu.metrics import federation
 
                 perf_fn = getattr(self.container, "perf_totals", None)
+                knobs_fn = getattr(self.container, "knob_vectors", None)
                 snap["digest"] = federation.digest(
                     self.container.metrics,
                     slo=getattr(self.container, "slo", None),
                     perf=perf_fn() if callable(perf_fn) else None,
+                    knobs=knobs_fn() if callable(knobs_fn) else None,
                     inflight=sum(
                         int(getattr(e, "_inflight_requests", 0))
                         for e in self.container.engines.values()))

@@ -36,9 +36,13 @@ from gofr_tpu.http.errors import RequestTimeout
 from gofr_tpu.tpu.lockstep import TAG_DECODE, TAG_SPEC
 
 
-def _fold_spec(eng, toks, accs, meta, k, dev_s: float = 0.0) -> None:
+def _fold_spec(eng, toks, accs, meta, k, g, dev_s: float = 0.0) -> None:
     """Replay one spec round's device acceptance into slot state. Caller
-    holds the state lock. ``toks`` [k, n, g+1], ``accs`` [k, n]."""
+    holds the state lock. ``toks`` [k, n, g+1], ``accs`` [k, n]. ``g`` is
+    the round length AT DISPATCH (from the entry's signature): the step
+    controller may move ``eng.spec_tokens`` between dispatch and fold,
+    and this round's proposal accounting belongs to the g that priced
+    and shaped it."""
     now = time.monotonic()
     emitted = accepted = folded = trimmed = 0
     for i, s in meta:
@@ -59,7 +63,7 @@ def _fold_spec(eng, toks, accs, meta, k, dev_s: float = 0.0) -> None:
         kw = s.request.kw
         if dev_s:
             kw["_dev_decode_s"] = kw.get("_dev_decode_s", 0.0) + dev_s
-        kw["_spec_proposed"] = kw.get("_spec_proposed", 0) + k * eng.spec_tokens
+        kw["_spec_proposed"] = kw.get("_spec_proposed", 0) + k * g
         for kk in range(k):
             a = int(accs[kk, i])
             accepted += a
@@ -89,7 +93,7 @@ def _fold_spec(eng, toks, accs, meta, k, dev_s: float = 0.0) -> None:
     # discarded mid-flight (freed/preempted/cancelled) contributes to
     # neither side, keeping accepted/proposed a true acceptance rate
     eng.metrics.increment_counter(
-        "app_tpu_spec_proposed", k * eng.spec_tokens * folded)
+        "app_tpu_spec_proposed", k * g * folded)
     eng.metrics.increment_counter("app_tpu_spec_accepted", accepted)
     # over-claim policy waste, metered where it happens: pages claimed at
     # dispatch for drafts the fold rejected, and the rejected tokens
@@ -97,7 +101,7 @@ def _fold_spec(eng, toks, accs, meta, k, dev_s: float = 0.0) -> None:
     if trimmed:
         eng.metrics.increment_counter(
             "app_tpu_spec_pages_trimmed_total", trimmed)
-    rejected = k * eng.spec_tokens * folded - accepted
+    rejected = k * g * folded - accepted
     if rejected > 0:
         eng.metrics.increment_counter(
             "app_tpu_spec_tokens_rejected_total", rejected)
@@ -396,11 +400,13 @@ def process_decode(eng) -> bool:
         ads = ([s.adapter_id or "base" for _, s in meta]
                if eng._adapters_enabled else None)
         if kind == "spec":
+            # sig[3] is the round length g AT DISPATCH — the live
+            # eng.spec_tokens may already be a different (controller-
+            # moved) value by the time this round folds
             dev_s = eng._record_step(
                 "decode_spec", time.monotonic() - t0, occupancy,
-                ("decode_spec", n, k, eng.spec_tokens), pstep,
-                adapter_ids=ads)
-            _fold_spec(eng, toks, accs, meta, k, dev_s)
+                sig, pstep, adapter_ids=ads)
+            _fold_spec(eng, toks, accs, meta, k, sig[3], dev_s)
             return True
         dev_s = eng._record_step("decode", time.monotonic() - t0, occupancy,
                                  ("decode", n, k), pstep, adapter_ids=ads)

@@ -633,8 +633,13 @@ class _EngineBase:
         device_s = 0.0
         perf = getattr(self, "perf", None)
         if pstep is not None and perf is not None:
+            from gofr_tpu.metrics.perf import occupancy_band
+
             now_perf = time.monotonic()
-            perf.note(pstep, now_perf)
+            # band label keys the controller's evidence windows: the same
+            # knob can win at high occupancy and lose near-empty, so
+            # judgments (and persisted pins) are per occupancy band
+            perf.note(pstep, now_perf, band=occupancy_band(occupancy))
             if adapter_ids:
                 # per-adapter roofline attribution (metrics/perf.py): one
                 # id per dispatched lane ("base" for adapterless lanes), a
@@ -645,15 +650,21 @@ class _EngineBase:
             self.metrics.record_histogram(
                 "app_tpu_step_device_seconds", device_s, kind=kind)
         if self.flight is not None:
+            # active knob vector on every step entry: a replayed anomaly
+            # bundle shows WHICH tuning the anomalous step ran under
+            # (BatchEngine has no knobs — None elides the field)
+            kv_fn = getattr(self, "knob_vector", None)
+            knobs = kv_fn() if kv_fn is not None else None
             if pstep is not None:
                 self.flight.record_step(
                     kind, seconds, occupancy, signature,
                     self._backlog(), len(getattr(self, "_dq", ())),
                     device_s=device_s, bytes_=pstep.bytes,
-                    flops=pstep.flops, bubble_s=pstep.bubble_s)
+                    flops=pstep.flops, bubble_s=pstep.bubble_s, knobs=knobs)
             else:
                 self.flight.record_step(kind, seconds, occupancy, signature,
-                                        self._backlog(), len(getattr(self, "_dq", ())))
+                                        self._backlog(), len(getattr(self, "_dq", ())),
+                                        knobs=knobs)
         if self.qos is not None:
             self.qos.observe_step(seconds)  # feeds the queue-wait estimator
         if signature in self._compiled:
@@ -973,6 +984,7 @@ class GenerateEngine(_EngineBase):
         quality_top1_min: float = 0.9,
         quality_kl_max: float = 1.0,
         quality_recent: int = 32,
+        control_enable: bool = False,
     ):
         super().__init__(container, default_timeout=default_timeout, max_restarts=max_restarts)
         self.family = family
@@ -1076,8 +1088,28 @@ class GenerateEngine(_EngineBase):
         depth = pipeline_depth if pipeline_depth is not None else decode_pipeline
         self.pipeline_depth = max(1, min(4, int(depth)))
         self.decode_pipeline = self.pipeline_depth  # legacy alias (bench/tests)
+        # Online-controller knob state (gofr_tpu.control): boot values are
+        # the operator-provisioned CEILINGS — the step controller explores
+        # within [1 .. boot], never past what the deployment was sized for.
+        # ``prefill_chunk`` caps how much of a long prompt one chunked-
+        # prefill dispatch takes (_advance_chunked); it is always a member
+        # of prefill_buckets so the compiled-signature population stays the
+        # boot set. Foreign threads (controller ticks run on the device
+        # thread, but debug endpoints and bench drills do not) enqueue
+        # changes via request_knobs; the device loop drains them at its
+        # loop-top safe seam, the ONLY place knobs mutate.
+        self._boot_pipeline_depth = self.pipeline_depth
+        self._boot_prefill_batch = self.max_prefill_batch
+        self._boot_spec_tokens = self.spec_tokens
+        self.prefill_chunk = self.prefill_buckets[-1]
+        self._knob_requests: collections.deque = collections.deque()
+        self._control = None
         # cache slack one chunk can write past max_len: each spec round
         # writes up to spec_tokens+1 positions plus spec_tokens draft slots.
+        # Sized from the BOOT spec_tokens and never resized: the controller
+        # only lowers g below boot, so the dispatch-time masking bound
+        # (pos + chunk_span*inflight) and the paged over-claim stay
+        # conservative for every live g <= boot.
         chunk_span = (self.decode_chunk * (self.spec_tokens + 1) + self.spec_tokens
                       if self.spec_tokens else self.decode_chunk)
         self._chunk_span = chunk_span
@@ -1553,6 +1585,32 @@ class GenerateEngine(_EngineBase):
         self._decode_chunk = progs.decode_chunk
         if progs.spec_chunk is not None:
             self._spec_chunk_fn = progs.spec_chunk
+        # per-g spec program map for the controller's spec_tokens knob: the
+        # round length g is baked into the jitted spec round, so moving the
+        # knob swaps the compiled handle rather than re-tracing mid-flight.
+        # Build kwargs are kept so other g values (always < boot) compile
+        # lazily on first use (_spec_fn_for); only spec_chunk is taken from
+        # those rebuilds — every other program handle is g-independent.
+        self._progs_kw = dict(
+            kv_layout=kv_layout, top_k=top_k, top_p=top_p,
+            pages_per_slot=getattr(self, "pages_per_slot", 0),
+            page_size=page_size, cache_len=getattr(self, "_cache_len", 0),
+            prefill_attn_fn=prefill_attn_fn, draft=self._draft,
+            adapters=self._adapters_enabled)
+        self._spec_fns = ({self.spec_tokens: progs.spec_chunk}
+                          if progs.spec_chunk is not None else {})
+
+        # Online step controller (gofr_tpu.control, docs/serving.md): OFF
+        # by default — CONTROL_ENABLE=0 never constructs it, leaving the
+        # engine bit-identical to the pre-controller build (the quality-
+        # plane discipline). Lockstep replicas never get one either:
+        # leader-only knob moves would change compiled signatures the
+        # followers are not announced.
+        if control_enable and self.perf is not None and lockstep_role is None:
+            try:
+                self._control = self._build_controller(container)
+            except Exception as e:  # pragma: no cover - control must not gate serving
+                container.logger.warn(f"step controller disabled: {e}")
 
         # lockstep announcer, last: a fleet LEADER starts listening here
         # and blocks until FLEET_FOLLOWERS identical-fingerprint followers
@@ -3113,6 +3171,128 @@ class GenerateEngine(_EngineBase):
             out["addr"] = self.handoff_addr
         return out
 
+    # -- online knob actuation (gofr_tpu.control) ------------------------------
+
+    def _build_controller(self, container):
+        """Wire a StepController to this engine's knob seams. Each KnobSpec
+        APPLY enqueues through request_knobs — the controller ticks on the
+        device thread, so the change lands at the very next loop top, but
+        routing through the queue keeps one audited mutation path for
+        controller, debug endpoints, and bench drills alike."""
+        from gofr_tpu.control.controller import (ControlPolicy, KnobSpec,
+                                                 StepController)
+
+        policy = ControlPolicy.from_config(container.config)
+        specs = [
+            KnobSpec("pipeline_depth",
+                     tuple(range(1, self._boot_pipeline_depth + 1)),
+                     lambda: self.pipeline_depth,
+                     lambda v: self.request_knobs(pipeline_depth=v)),
+            KnobSpec("prefill_chunk", tuple(self.prefill_buckets),
+                     lambda: self.prefill_chunk,
+                     lambda v: self.request_knobs(prefill_chunk=v)),
+            KnobSpec("prefill_batch",
+                     tuple(range(1, self._boot_prefill_batch + 1)),
+                     lambda: self.max_prefill_batch,
+                     lambda v: self.request_knobs(prefill_batch=v)),
+        ]
+        if self._boot_spec_tokens:
+            # g=0 <-> g>0 is not a knob move (the spec carry changes the
+            # cache pytree and the dispatch path): explore [1 .. boot g]
+            specs.append(KnobSpec(
+                "spec_tokens", tuple(range(1, self._boot_spec_tokens + 1)),
+                lambda: self.spec_tokens,
+                lambda v: self.request_knobs(spec_tokens=v)))
+
+        def on_decision(d):
+            if self.flight is not None:
+                self.flight.record_control(d.to_dict())
+            self.metrics.increment_counter(
+                "app_tpu_control_decisions_total", 1, verdict=d.verdict)
+
+        return StepController(
+            policy, specs,
+            kv_dtype=self.perf.model.kv_dtype,
+            device_kind=self.perf.device_kind,
+            shard=f"tp{max(1, getattr(self, 'kv_shards', 1))}",
+            window_fn=self.perf.band_totals,
+            standdown_fn=lambda: "lockstep" if self.lockstep_role else None,
+            on_decision=on_decision,
+            logger=self.logger)
+
+    def _spec_fn_for(self, g: int):
+        """The compiled spec-round handle for round length ``g`` (g is a
+        static arg of the jitted program); builds and caches on first use."""
+        fn = self._spec_fns.get(g)
+        if fn is None:
+            progs = build_programs(self.family, self.cfg, spec_tokens=g,
+                                   **self._progs_kw)
+            fn = self._spec_fns[g] = progs.spec_chunk
+        return fn
+
+    def request_knobs(self, **knobs) -> None:
+        """Thread-safe: enqueue knob changes for the device loop to apply
+        at its loop-top safe seam (_apply_pending_knobs) — no dispatch is
+        in flight-construction there, so every dispatch snapshots a
+        consistent knob vector."""
+        self._knob_requests.append(dict(knobs))
+
+    def _apply_pending_knobs(self) -> None:
+        while self._knob_requests:
+            req = self._knob_requests.popleft()
+            for name, value in req.items():
+                try:
+                    self._apply_knob_now(name, value)
+                except Exception as e:  # a bad knob must never kill the loop
+                    self.logger.warn(f"knob {name}={value!r} rejected: {e}")
+
+    def _apply_knob_now(self, name: str, value) -> None:
+        """Device-thread only. Clamps every move to the boot ceiling (the
+        operator's provisioned envelope) and, for prefill_chunk, snaps to a
+        bucket member so next_bucket stays exact and the compiled-signature
+        population never grows past the boot set."""
+        v = int(value)
+        if name == "pipeline_depth":
+            self.pipeline_depth = max(1, min(v, self._boot_pipeline_depth))
+            self.decode_pipeline = self.pipeline_depth  # keep the alias true
+        elif name == "prefill_chunk":
+            allowed = [b for b in self.prefill_buckets if b <= v]
+            self.prefill_chunk = (allowed[-1] if allowed
+                                  else self.prefill_buckets[0])
+        elif name == "prefill_batch":
+            self.max_prefill_batch = max(1, min(v, self._boot_prefill_batch))
+        elif name == "spec_tokens":
+            if not self._boot_spec_tokens:
+                raise ValueError(
+                    "spec is off at boot; g=0<->g>0 changes the cache pytree")
+            g = max(1, min(v, self._boot_spec_tokens))
+            if g != self.spec_tokens:
+                # swap the compiled handle FIRST: a failed (re)build leaves
+                # the old g fully consistent. In-flight rounds fold with
+                # their dispatch-time g (decode._fold_spec reads sig), and
+                # _chunk_span stays at the boot worst case, so masking and
+                # paged over-claim remain conservative.
+                self._spec_chunk_fn = self._spec_fn_for(g)
+                self.spec_tokens = g
+        else:
+            raise ValueError(f"unknown knob {name!r}")
+
+    def knob_vector(self) -> dict[str, int]:
+        """Live knob values — stamped on flight-recorder steps, gossiped in
+        the fleet digest, and compared by the bench's exactness drill."""
+        out = {"pipeline_depth": self.pipeline_depth,
+               "prefill_chunk": self.prefill_chunk,
+               "prefill_batch": self.max_prefill_batch}
+        if self._boot_spec_tokens:
+            out["spec_tokens"] = self.spec_tokens
+        return out
+
+    def control_report(self) -> dict[str, Any]:
+        """/debug/control payload (app.py)."""
+        if self._control is None:
+            return {"enabled": False, "knobs": self.knob_vector()}
+        return self._control.report()
+
     def _loop(self) -> None:
         self._dq.clear()  # a restarted loop must not read a dead life's futures
         self._prev_last = None
@@ -3121,8 +3301,18 @@ class GenerateEngine(_EngineBase):
             self._pending_swapins = []  # staged by a dead life; never dispatch
         if getattr(self, "_pending_spills", None):
             self._pending_spills = []
-        depth = self.pipeline_depth
         while not self._stop.is_set() and not self._poisoned:
+            # loop-top safe seam: no dispatch is being constructed here, so
+            # queued knob changes (controller commits/reverts, debug pokes)
+            # land before anything snapshots them; the controller itself
+            # ticks right after, ON this thread, so its applies take effect
+            # at the very next iteration. ``depth`` is re-read every
+            # iteration — a live pipeline_depth move simply changes how far
+            # the drain below lets the queue refill.
+            self._apply_pending_knobs()
+            if self._control is not None:
+                self._control.maybe_tick(time.monotonic())
+            depth = self.pipeline_depth
             # One bounded in-flight device queue (self._dq): batched
             # prefill, chunked prefill, and decode/spec chunks all DISPATCH
             # here (enqueueing their device futures) and are read back +
@@ -3297,7 +3487,11 @@ class GenerateEngine(_EngineBase):
                 s.request.complete(error=RequestTimeout())
                 return True  # state changed; re-loop without idling
             offset = s.dispatched
-            chunk = min(s.prompt_len - offset, self.prefill_buckets[-1])
+            # prefill_chunk is the controller's chunked-prefill knob: a
+            # bucket member <= buckets[-1], so smaller values trade TTFT of
+            # the long prompt for tighter decode interleave without ever
+            # minting a new compiled signature
+            chunk = min(s.prompt_len - offset, self.prefill_chunk)
             lb = next_bucket(chunk, self.prefill_buckets)
             table_row = None
             if self.kv_layout == "paged":
@@ -4167,6 +4361,10 @@ def build_engine(spec: ModelSpec, container, **kw: Any):
             quality_recent=int(kw.pop(
                 "quality_recent",
                 conf.get_int("QUALITY_RECENT", 32))),
+            # online step controller (gofr_tpu.control): off by default —
+            # CONTROL_ENABLE=0 never constructs it (bit-identical off path)
+            control_enable=bool(kw.pop(
+                "control_enable", conf.get_int("CONTROL_ENABLE", 0))),
             **kw,
         )
 

@@ -636,3 +636,53 @@ def test_adapter_suite_is_in_quick_tier():
     assert "adopt_weights" in text and "zero_drop" in text
     assert "assert_page_refs_consistent" in text
     assert "epoch_of" in text  # the router-gossip epoch bump is asserted
+
+
+def test_control_suite_is_in_quick_tier():
+    """ISSUE 20 satellite: the online-controller suite — the extracted
+    HysteresisGate units plus the ScaleDecider-delegates proof, the
+    StepController trial loop on fake clocks (commit/revert/backoff,
+    oscillation freeze, stand-down, starved-window accumulation, pin
+    persistence + resume), the engine knob seams (boot-envelope clamps,
+    per-g spec handle swap), the mid-stream token-exactness drill, and
+    the metric-registration lint — is CPU-fast by construction and must
+    ride the `-m quick` CI job on every push."""
+    path = REPO / "tests" / "test_control.py"
+    assert path.exists(), "tests/test_control.py missing"
+    text = path.read_text()
+    assert "pytestmark = pytest.mark.quick" in text, (
+        "test_control.py must be quick-marked module-wide"
+    )
+    assert "test_control.py" not in QUICK_EXEMPT, (
+        "test_control.py must not be exempted from the quick tier"
+    )
+    # the tentpole's acceptance pieces are all covered: the shared damping
+    # core, the bounded trial loop with every failure edge, the safe-seam
+    # actuation contract, and the never-change-tokens invariant
+    assert "HysteresisGate" in text and "ScaleDecider" in text
+    assert "oscillat" in text and "standdown" in text
+    assert "no-evidence" in text and "resume" in text
+    assert "request_knobs" in text and "_apply_pending_knobs" in text
+    assert "token_exact" in text and "band_totals" in text
+    assert "never_registered" in text or "is_registered" in text
+
+
+def test_ci_runs_the_controller_smoke():
+    """ISSUE 20 judge: CI must run the controller-vs-static A/B as an
+    EXPLICIT CPU run and assert the closed-loop verdicts from the archive
+    — the controller arm starting from a pessimal knob vector meets the
+    best static arm within tolerance, its decision ring is non-empty, and
+    serving stays token-exact across every arm AND with the controller
+    off — otherwise the actuation harness can rot between TPU rounds."""
+    ci = yaml.safe_load((REPO / ".github" / "workflows" / "ci.yml").read_text())
+    job = ci["jobs"].get("bench-controller-smoke")
+    assert job, "ci.yml has no bench-controller-smoke job"
+    runs = " ".join(step.get("run", "") for step in job.get("steps", []))
+    assert "GOFR_BENCH_PLATFORM=cpu" in runs
+    assert "GOFR_BENCH_CONTROLLER=1" in runs
+    assert "bench.py" in runs
+    # the verdict step must check every half of the closed-loop claim
+    assert "meets_statics" in runs
+    assert "token_exact" in runs and "control_off_token_exact" in runs
+    assert "decisions" in runs
+    assert "bubble_ratio" in runs
